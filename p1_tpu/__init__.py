@@ -1,0 +1,20 @@
+"""p1-tpu: a TPU-native proof-of-work blockchain framework.
+
+A ground-up rebuild of the capabilities of the reference project `qzwlecr/p1`
+(see SURVEY.md — the reference checkout was unavailable, so parity is built
+against the driver-recorded capability model in /root/repo/BASELINE.json):
+
+- ``p1_tpu.core``    — block/header/transaction types, deterministic
+  serialization, difficulty/target math, genesis.
+- ``p1_tpu.hashx``   — the ``HashBackend`` plugin registry (BASELINE.json:5)
+  with CPU (hashlib), C++ native, NumPy, JAX and Pallas-TPU backends.
+- ``p1_tpu.miner``   — ``Miner.search_nonce()`` (BASELINE.json:5): the nonce
+  search as batched device steps; multi-chip sharding with a pmin first-hit
+  reduction over a ``jax.sharding.Mesh``.
+- ``p1_tpu.chain``   — chain validation, longest-chain fork choice with reorg,
+  persistence (checkpoint/resume), header-chain replay.
+- ``p1_tpu.mempool`` — pending-transaction pool.
+- ``p1_tpu.node``    — asyncio TCP p2p gossip node (blocks + txs, sync).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+from p1_tpu.core.header import (
+    HEADER_SIZE,
+    NONCE_OFFSET,
+    BlockHeader,
+    target_from_difficulty,
+    target_to_words,
+    meets_target,
+)
+from p1_tpu.core.tx import Transaction
+from p1_tpu.core.block import Block, merkle_root
+from p1_tpu.core.genesis import GENESIS_TIMESTAMP, make_genesis
+
+__all__ = [
+    "HEADER_SIZE",
+    "NONCE_OFFSET",
+    "BlockHeader",
+    "target_from_difficulty",
+    "target_to_words",
+    "meets_target",
+    "Transaction",
+    "Block",
+    "merkle_root",
+    "GENESIS_TIMESTAMP",
+    "make_genesis",
+]

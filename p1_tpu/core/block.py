@@ -1,0 +1,82 @@
+"""Block = header + transactions, with a SHA-256d merkle root.
+
+Capability parity: block assembly from the mempool and header-chain
+validation (BASELINE.json:5).  The merkle tree is the classic construction:
+leaves are txids, pairs are combined with SHA-256d, an odd node is paired
+with itself, and an empty transaction list has an all-zeros root.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+from p1_tpu.core.header import HEADER_SIZE, BlockHeader
+from p1_tpu.core.tx import Transaction
+
+EMPTY_MERKLE_ROOT = bytes(32)
+
+
+def merkle_root(txids: list[bytes]) -> bytes:
+    """Classic duplicate-last-odd-leaf merkle tree.
+
+    The duplication means ``[t1,t2,t3]`` and ``[t1,t2,t3,t3]`` share a root
+    (the CVE-2012-2459 malleability); chain validation therefore rejects
+    blocks containing duplicate txids — see p1_tpu.chain.
+    """
+    if not txids:
+        return EMPTY_MERKLE_ROOT
+    from p1_tpu.core.hashutil import sha256d
+
+    level = list(txids)
+    while len(level) > 1:
+        if len(level) % 2:
+            level.append(level[-1])
+        level = [
+            sha256d(level[i] + level[i + 1]) for i in range(0, len(level), 2)
+        ]
+    return level[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    header: BlockHeader
+    txs: tuple[Transaction, ...] = ()
+
+    def block_hash(self) -> bytes:
+        return self.header.block_hash()
+
+    def compute_merkle_root(self) -> bytes:
+        return merkle_root([tx.txid() for tx in self.txs])
+
+    def merkle_ok(self) -> bool:
+        return self.header.merkle_root == self.compute_merkle_root()
+
+    def serialize(self) -> bytes:
+        parts = [self.header.serialize(), struct.pack(">I", len(self.txs))]
+        for tx in self.txs:
+            raw = tx.serialize()
+            parts.append(struct.pack(">I", len(raw)))
+            parts.append(raw)
+        return b"".join(parts)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Block":
+        if len(data) < HEADER_SIZE + 4:
+            raise ValueError("truncated block")
+        header = BlockHeader.deserialize(data[:HEADER_SIZE])
+        (ntx,) = struct.unpack(">I", data[HEADER_SIZE : HEADER_SIZE + 4])
+        off = HEADER_SIZE + 4
+        txs = []
+        for _ in range(ntx):
+            if len(data) < off + 4:
+                raise ValueError("truncated block tx table")
+            (txlen,) = struct.unpack(">I", data[off : off + 4])
+            off += 4
+            if len(data) < off + txlen:
+                raise ValueError("truncated block tx")
+            txs.append(Transaction.deserialize(data[off : off + txlen]))
+            off += txlen
+        if off != len(data):
+            raise ValueError(f"{len(data) - off} trailing bytes after block")
+        return cls(header, tuple(txs))

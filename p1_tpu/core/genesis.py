@@ -1,0 +1,28 @@
+"""Deterministic genesis block.
+
+Capability parity: "genesis block, difficulty=16" (BASELINE.json:7).  The
+genesis block is fixed per (difficulty,) chain configuration: zero prev-hash,
+no transactions, a fixed timestamp, nonce 0.  Genesis is exempt from the PoW
+check (it anchors the chain by identity, not by work) — validation in
+``p1_tpu.chain`` special-cases height 0.
+"""
+
+from __future__ import annotations
+
+from p1_tpu.core.block import EMPTY_MERKLE_ROOT, Block
+from p1_tpu.core.header import BlockHeader
+
+GENESIS_VERSION = 1
+GENESIS_TIMESTAMP = 1735689600  # 2025-01-01T00:00:00Z, fixed forever
+
+
+def make_genesis(difficulty: int) -> Block:
+    header = BlockHeader(
+        version=GENESIS_VERSION,
+        prev_hash=bytes(32),
+        merkle_root=EMPTY_MERKLE_ROOT,
+        timestamp=GENESIS_TIMESTAMP,
+        difficulty=difficulty,
+        nonce=0,
+    )
+    return Block(header, ())

@@ -1,0 +1,15 @@
+"""Fast host-side SHA-256d for the validation path.
+
+Block ids, txids and merkle trees sit on the chain-sync/gossip hot path, so
+they use ``hashlib`` directly.  The pure-Python implementation in
+``p1_tpu.hashx.sha256_ref`` stays the *ground truth* for tests and the
+midstate computation only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def sha256d(data: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(data).digest()).digest()

@@ -1,0 +1,120 @@
+"""Block header: canonical 80-byte serialization and difficulty/target math.
+
+Capability parity: the reference's ``BlockHeader`` with deterministic byte
+serialization hashed by the miner (BASELINE.json:5 — "double-SHA-256 over a
+serialized ``BlockHeader`` with an incrementing nonce").  This is a new design,
+not a port: fields are fixed-width **big-endian** (network order) throughout,
+which keeps the device-side word view trivial — the header is exactly twenty
+uint32 words, and the nonce is word 19 (the last word of the second SHA-256
+chunk), so a TPU kernel can vary the nonce without any byte shuffling.
+
+Layout (80 bytes, the classic Bitcoin-style shape):
+
+    offset  size  field
+    0       4     version      (uint32 be)
+    4       32    prev_hash    (raw SHA-256d digest bytes)
+    36      32    merkle_root  (raw digest bytes)
+    68      4     timestamp    (uint32 be, unix seconds)
+    72      4     difficulty   (uint32 be — required leading zero bits, 0..255)
+    76      4     nonce        (uint32 be)
+
+Difficulty convention: an integer ``d`` meaning the block hash, read as a
+big-endian 256-bit integer, must be strictly less than ``2**(256-d)`` —
+i.e. it has at least ``d`` leading zero bits.  ``BASELINE.json:6-12`` sweeps
+``d`` in 16..28.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+HEADER_SIZE = 80
+NONCE_OFFSET = 76
+_PACK = struct.Struct(">I32s32sIII")
+assert _PACK.size == HEADER_SIZE
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockHeader:
+    version: int
+    prev_hash: bytes  # 32 raw bytes
+    merkle_root: bytes  # 32 raw bytes
+    timestamp: int
+    difficulty: int  # required leading zero bits of the block hash
+    nonce: int
+
+    def __post_init__(self) -> None:
+        if len(self.prev_hash) != 32:
+            raise ValueError(f"prev_hash must be 32 bytes, got {len(self.prev_hash)}")
+        if len(self.merkle_root) != 32:
+            raise ValueError(
+                f"merkle_root must be 32 bytes, got {len(self.merkle_root)}"
+            )
+        for name in ("version", "timestamp", "difficulty", "nonce"):
+            v = getattr(self, name)
+            if not 0 <= v <= 0xFFFFFFFF:
+                raise ValueError(f"{name}={v} out of uint32 range")
+        if self.difficulty > 255:
+            raise ValueError(f"difficulty={self.difficulty} out of range (0..255)")
+
+    def serialize(self) -> bytes:
+        return _PACK.pack(
+            self.version,
+            self.prev_hash,
+            self.merkle_root,
+            self.timestamp,
+            self.difficulty,
+            self.nonce,
+        )
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "BlockHeader":
+        if len(data) != HEADER_SIZE:
+            raise ValueError(f"header must be {HEADER_SIZE} bytes, got {len(data)}")
+        version, prev_hash, merkle_root, timestamp, difficulty, nonce = _PACK.unpack(
+            data
+        )
+        return cls(version, prev_hash, merkle_root, timestamp, difficulty, nonce)
+
+    def with_nonce(self, nonce: int) -> "BlockHeader":
+        return dataclasses.replace(self, nonce=nonce)
+
+    def with_timestamp(self, timestamp: int) -> "BlockHeader":
+        return dataclasses.replace(self, timestamp=timestamp)
+
+    def mining_prefix(self) -> bytes:
+        """The first 76 bytes — everything the nonce search holds constant."""
+        return self.serialize()[:NONCE_OFFSET]
+
+    def block_hash(self) -> bytes:
+        """SHA-256d of the serialized header (the block id)."""
+        from p1_tpu.core.hashutil import sha256d
+
+        return sha256d(self.serialize())
+
+
+def target_from_difficulty(difficulty: int) -> int:
+    """Target threshold: hash (as a big-endian 256-bit int) must be < this."""
+    if not 0 <= difficulty <= 255:
+        raise ValueError(f"difficulty={difficulty} out of range (0..255)")
+    return 1 << (256 - difficulty)
+
+
+def target_to_words(target: int) -> tuple[int, ...]:
+    """The 256-bit target as 8 big-endian uint32 words (device compare form)."""
+    if not 0 < target <= 1 << 256:
+        raise ValueError("target out of range")
+    # A target of exactly 2**256 (difficulty 0) clamps to all-ones: every hash
+    # is strictly below 2**256 anyway, and 8 words cannot represent 2**256.
+    t = min(target, (1 << 256) - 1)
+    return tuple((t >> (32 * (7 - i))) & 0xFFFFFFFF for i in range(8))
+
+
+def meets_target(block_hash: bytes, difficulty: int) -> bool:
+    """Host-side PoW check: does the hash have >= difficulty leading zero bits?"""
+    if len(block_hash) != 32:
+        raise ValueError("block hash must be 32 bytes")
+    if difficulty == 0:
+        return True
+    return int.from_bytes(block_hash, "big") < target_from_difficulty(difficulty)

@@ -1,0 +1,80 @@
+"""Minimal transaction type with deterministic serialization.
+
+Capability parity: the reference has a mempool of pending transactions feeding
+block assembly (BASELINE.json:5).  The exact reference tx format is unknown
+(reference checkout unavailable — SURVEY.md §0), so this is a deliberately
+simple account-model transfer: sender/recipient ids, amount, fee, and a
+sender-sequence number for uniqueness.  Deterministic big-endian serialization
+with length-prefixed ids; txid = SHA-256d of the serialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+_MAX_ID_LEN = 255
+
+
+@dataclasses.dataclass(frozen=True)
+class Transaction:
+    sender: str
+    recipient: str
+    amount: int
+    fee: int
+    seq: int  # per-sender sequence number (uniqueness / replay protection)
+
+    def __post_init__(self) -> None:
+        for name in ("sender", "recipient"):
+            raw = getattr(self, name).encode("utf-8")
+            if not 0 < len(raw) <= _MAX_ID_LEN:
+                raise ValueError(f"{name} must encode to 1..{_MAX_ID_LEN} bytes")
+        for name in ("amount", "fee", "seq"):
+            v = getattr(self, name)
+            if not 0 <= v <= 0xFFFFFFFFFFFFFFFF:
+                raise ValueError(f"{name}={v} out of uint64 range")
+
+    def serialize(self) -> bytes:
+        s = self.sender.encode("utf-8")
+        r = self.recipient.encode("utf-8")
+        return b"".join(
+            (
+                struct.pack(">B", len(s)),
+                s,
+                struct.pack(">B", len(r)),
+                r,
+                struct.pack(">QQQ", self.amount, self.fee, self.seq),
+            )
+        )
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Transaction":
+        tx, rest = cls.deserialize_prefix(data)
+        if rest:
+            raise ValueError(f"{len(rest)} trailing bytes after transaction")
+        return tx
+
+    @classmethod
+    def deserialize_prefix(cls, data: bytes) -> tuple["Transaction", bytes]:
+        """Parse one transaction off the front of ``data``; return (tx, rest)."""
+
+        def take(buf: bytes, n: int) -> tuple[bytes, bytes]:
+            if len(buf) < n:
+                raise ValueError("truncated transaction")
+            return buf[:n], buf[n:]
+
+        lb, data = take(data, 1)
+        s, data = take(data, lb[0])
+        lb, data = take(data, 1)
+        r, data = take(data, lb[0])
+        nums, data = take(data, 24)
+        amount, fee, seq = struct.unpack(">QQQ", nums)
+        return (
+            cls(s.decode("utf-8"), r.decode("utf-8"), amount, fee, seq),
+            data,
+        )
+
+    def txid(self) -> bytes:
+        from p1_tpu.core.hashutil import sha256d
+
+        return sha256d(self.serialize())

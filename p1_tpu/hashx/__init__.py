@@ -1,0 +1,34 @@
+from p1_tpu.hashx.backend import (
+    HashBackend,
+    SearchResult,
+    available_backends,
+    get_backend,
+    register,
+)
+
+# Import for registration side effects.
+from p1_tpu.hashx import cpu as _cpu  # noqa: F401
+from p1_tpu.hashx import numpy_backend as _numpy  # noqa: F401
+
+# Backends with heavy imports (JAX) or build steps (native .so) load lazily.
+from p1_tpu.hashx.backend import register_lazy as _register_lazy
+
+
+def _load_jax():
+    from p1_tpu.hashx import jax_backend
+
+    return jax_backend.JaxBackend
+
+
+_register_lazy("jax", _load_jax)
+# "tpu" (Pallas kernel) and "native" (C++ core) register here when their
+# modules land; advertising names whose modules don't exist yet would turn
+# get_backend into a ModuleNotFoundError trap.
+
+__all__ = [
+    "HashBackend",
+    "SearchResult",
+    "available_backends",
+    "get_backend",
+    "register",
+]

@@ -1,0 +1,79 @@
+"""JAX hash backend: the XLA-compiled nonce search (CPU or TPU).
+
+Capability parity: the ``JaxTPUBackend`` registry entry of the north star
+(BASELINE.json:5), in its pure-XLA form — the Pallas-kernel variant is the
+``tpu`` backend (pallas_backend.py).  ``search`` runs a host loop of jitted
+device steps with **async double-buffering**: step k+1 is dispatched before
+step k's 4-byte result is read back, so the device never idles on the host
+(JAX's async dispatch gives this for free as long as we delay
+``int()``-ing a result until the next step is enqueued).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from p1_tpu.core.header import target_from_difficulty, target_to_words
+from p1_tpu.hashx.backend import HashBackend, SearchResult, register
+from p1_tpu.hashx.jax_sha256 import jit_search_step
+from p1_tpu.hashx.sha256_ref import header_midstate, header_tail_words, sha256d
+
+_U32 = jnp.uint32
+
+
+@register("jax")
+class JaxBackend(HashBackend):
+    """XLA-compiled SHA-256d search on the default JAX device."""
+
+    def __init__(self, batch: int = 1 << 20, platform: str | None = None):
+        if batch <= 0 or batch & (batch - 1):
+            raise ValueError(f"batch must be a power of two, got {batch}")
+        self.batch = batch
+        self.platform = platform
+
+    def sha256d(self, data: bytes) -> bytes:
+        return sha256d(data)  # single digests stay on host
+
+    def _search_arrays(self, header_prefix: bytes, difficulty: int):
+        midstate = jnp.array(header_midstate(header_prefix), dtype=_U32)
+        tail = jnp.array(header_tail_words(header_prefix), dtype=_U32)
+        target = jnp.array(
+            target_to_words(target_from_difficulty(difficulty)), dtype=_U32
+        )
+        return midstate, tail, target
+
+    def search(
+        self, header_prefix: bytes, nonce_start: int, count: int, difficulty: int
+    ) -> SearchResult:
+        self._check_search_args(header_prefix, nonce_start, count, difficulty)
+        midstate, tail, target = self._search_arrays(header_prefix, difficulty)
+        step = jit_search_step(self.batch, self.platform)
+
+        # Batched scan with a one-step pipeline.  Each step covers
+        # [base, base+batch); a partial final step is masked on the host by
+        # re-checking the hit offset against the remaining count.
+        pending: list[tuple[int, int, object]] = []  # (base, valid, device idx)
+        done = 0
+        result: SearchResult | None = None
+        while done < count and result is None:
+            base = nonce_start + done
+            valid = min(self.batch, count - done)
+            idx = step(midstate, tail, target, _U32(base))
+            pending.append((base, valid, idx))
+            done += valid
+            if len(pending) > 1:
+                result = self._drain_one(pending, nonce_start)
+        while result is None and pending:
+            result = self._drain_one(pending, nonce_start)
+        if result is not None:
+            return result
+        return SearchResult(None, count)
+
+    def _drain_one(self, pending: list, nonce_start: int) -> SearchResult | None:
+        base, valid, idx = pending.pop(0)
+        offset = int(np.asarray(idx))  # blocks until this step is done
+        if offset < valid:
+            nonce = base + offset
+            return SearchResult(nonce, nonce - nonce_start + 1)
+        return None

@@ -1,0 +1,124 @@
+"""SHA-256d nonce search as pure JAX uint32 math.
+
+The device-side heart of the framework (BASELINE.json:5 — the miner's inner
+loop "becomes a vmapped Pallas SHA-256 kernel that evaluates millions of
+candidate nonces per device step").  This module is the XLA formulation: one
+uint32 lane per candidate nonce, all 64 rounds unrolled at trace time into
+straight-line vector ops that XLA tiles onto the TPU VPU (8x128 vregs).  The
+Pallas kernel (pallas_backend.py) reuses exactly this math inside a kernel
+body; on CPU the same functions run under the virtual-device test mesh.
+
+Design choices for TPU:
+
+- **Midstate**: the first 64 header bytes are nonce-independent, so the host
+  compresses chunk 1 once (sha256_ref.header_midstate) and the device only
+  runs chunk 2 + the full second pass — 2 compressions instead of 3.
+- **Static shapes**: the batch size is a trace-time constant; the host loop
+  re-invokes the jitted step with a new ``nonce_base`` scalar, so nothing
+  recompiles between steps.
+- **First-hit reduce**: each step returns ``min(lane index where hit, else
+  batch)`` — a single uint32 — keeping device->host traffic at 4 bytes per
+  step and making the multi-chip ``pmin`` reduction trivial.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from p1_tpu.hashx.sha256_ref import IV, K
+
+_U32 = jnp.uint32
+
+
+def _rotr(x: jax.Array, n: int) -> jax.Array:
+    return (x >> _U32(n)) | (x << _U32(32 - n))
+
+
+def _extend_schedule(w: list[jax.Array]) -> list[jax.Array]:
+    """Message-schedule expansion W16..W63 (in-place append, trace-time loop)."""
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> _U32(3))
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> _U32(10))
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    return w
+
+
+def _compress(state: Sequence[jax.Array], w: list[jax.Array]) -> list[jax.Array]:
+    """64 SHA-256 rounds, unrolled; returns state + compressed."""
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + _U32(K[i]) + w[i]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
+    return [x + y for x, y in zip(state, (a, b, c, d, e, f, g, h))]
+
+
+def sha256d_words(
+    midstate: jax.Array, tail: jax.Array, nonces: jax.Array
+) -> list[jax.Array]:
+    """SHA-256d digest words for a lane-vector of nonces.
+
+    midstate: (8,) uint32 chunk-1 state; tail: (3,) uint32 chunk-2 words 0..2;
+    nonces: (...,) uint32.  Returns 8 arrays shaped like ``nonces``.
+    """
+    zero = jnp.zeros_like(nonces)
+
+    def bc(word: jax.Array) -> jax.Array:
+        return jnp.broadcast_to(word.astype(_U32), nonces.shape)
+
+    # Pass 1, chunk 2: 16 tail bytes + nonce word + pad(0x80) + bitlen 640.
+    w = [bc(tail[0]), bc(tail[1]), bc(tail[2]), nonces]
+    w += [zero + _U32(0x80000000)] + [zero] * 10 + [zero + _U32(640)]
+    state1 = _compress([bc(m) for m in midstate], _extend_schedule(w))
+
+    # Pass 2: the 32-byte digest as one padded block (bitlen 256).
+    w2 = list(state1) + [zero + _U32(0x80000000)] + [zero] * 6 + [zero + _U32(256)]
+    iv = [jnp.full(nonces.shape, v, dtype=_U32) for v in IV]
+    return _compress(iv, _extend_schedule(w2))
+
+
+def below_target(digest_words: list[jax.Array], target_words: jax.Array) -> jax.Array:
+    """Lanes whose 256-bit big-endian digest is < the 8-word target."""
+    lt = jnp.zeros(digest_words[0].shape, dtype=jnp.bool_)
+    eq = jnp.ones(digest_words[0].shape, dtype=jnp.bool_)
+    for i in range(8):
+        tw = target_words[i]
+        lt = lt | (eq & (digest_words[i] < tw))
+        eq = eq & (digest_words[i] == tw)
+    return lt
+
+
+def first_hit_index(hits: jax.Array, batch: int) -> jax.Array:
+    """min(flat lane index where hit) or ``batch`` if no lane hit (uint32)."""
+    lanes = jnp.arange(batch, dtype=_U32).reshape(hits.shape)
+    return jnp.min(jnp.where(hits, lanes, _U32(batch)))
+
+
+def search_step(
+    midstate: jax.Array,
+    tail: jax.Array,
+    target_words: jax.Array,
+    nonce_base: jax.Array,
+    batch: int,
+) -> jax.Array:
+    """One device step: scan [nonce_base, nonce_base+batch) lanes, return
+    the first hit's offset from nonce_base, or ``batch`` if none."""
+    nonces = nonce_base + jnp.arange(batch, dtype=_U32)
+    hits = below_target(sha256d_words(midstate, tail, nonces), target_words)
+    return first_hit_index(hits, batch)
+
+
+@functools.cache
+def jit_search_step(batch: int, platform: str | None = None):
+    """Jitted ``search_step`` closed over a static batch size."""
+    fn = functools.partial(search_step, batch=batch)
+    device = jax.devices(platform)[0] if platform else None
+    return jax.jit(fn, device=device)

@@ -1,0 +1,3 @@
+from p1_tpu.miner.miner import MineStats, Miner
+
+__all__ = ["Miner", "MineStats"]

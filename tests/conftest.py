@@ -1,0 +1,16 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices.
+
+Multi-chip hardware is unavailable in CI; the sharded nonce-search path
+(shard_map + pmin over a Mesh) is exercised on a virtual 8-device CPU mesh
+instead (SURVEY.md §7 step 8).  These env vars must be set before the first
+``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,122 @@
+"""JAX backend: digest parity vs NumPy oracle, search parity vs cpu backend."""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from p1_tpu.core import BlockHeader, meets_target, target_from_difficulty, target_to_words
+from p1_tpu.hashx import get_backend
+from p1_tpu.hashx import sha256_ref
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from p1_tpu.hashx import jax_sha256  # noqa: E402
+
+# One shape-specialized compile shared by the digest tests; eager dispatch of
+# the unrolled 64-round trace is painfully slow on CPU.
+_digest_jit = jax.jit(jax_sha256.sha256d_words)
+
+
+def _prefix(seed: int) -> bytes:
+    rng = random.Random(seed)
+    return BlockHeader(
+        1, rng.randbytes(32), rng.randbytes(32), 1735689700, 8, 0
+    ).mining_prefix()
+
+
+def _arrays(prefix: bytes, difficulty: int):
+    midstate = jnp.array(sha256_ref.header_midstate(prefix), dtype=jnp.uint32)
+    tail = jnp.array(sha256_ref.header_tail_words(prefix), dtype=jnp.uint32)
+    target = jnp.array(
+        target_to_words(target_from_difficulty(difficulty)), dtype=jnp.uint32
+    )
+    return midstate, tail, target
+
+
+class TestJaxSha256:
+    def test_digest_words_match_reference(self):
+        prefix = _prefix(10)
+        midstate, tail, _ = _arrays(prefix, 8)
+        nonces = jnp.array([0, 1, 99999, 0xFFFFFFFF], dtype=jnp.uint32)
+        words = _digest_jit(midstate, tail, nonces)
+        for lane, nonce in enumerate([0, 1, 99999, 0xFFFFFFFF]):
+            expect = sha256_ref.sha256d(prefix + struct.pack(">I", nonce))
+            got = struct.pack(">8I", *(int(w[lane]) for w in words))
+            assert got == expect, f"nonce {nonce:#x}"
+
+    def test_search_step_finds_earliest(self):
+        prefix = _prefix(11)
+        difficulty = 8
+        midstate, tail, target = _arrays(prefix, difficulty)
+        batch = 1024
+        step = jax_sha256.jit_search_step(batch)
+        idx = int(step(midstate, tail, target, jnp.uint32(0)))
+        truth = get_backend("cpu").search(prefix, 0, batch, difficulty)
+        if truth.nonce is None:
+            assert idx == batch
+        else:
+            assert idx == truth.nonce
+
+    def test_search_step_no_hit_returns_batch(self):
+        prefix = _prefix(12)
+        midstate, tail, target = _arrays(prefix, 255)
+        step = jax_sha256.jit_search_step(1024)
+        assert int(step(midstate, tail, target, jnp.uint32(0))) == 1024
+
+    def test_nonce_base_wraps_uint32(self):
+        prefix = _prefix(13)
+        midstate, tail, _ = _arrays(prefix, 8)
+        # Lane math at the top of nonce space must wrap mod 2**32 like uint32.
+        nonces = jnp.uint32(0xFFFFFFFE) + jnp.arange(4, dtype=jnp.uint32)
+        words = _digest_jit(midstate, tail, nonces)
+        expect = sha256_ref.sha256d(prefix + struct.pack(">I", 1))
+        got = struct.pack(">8I", *(int(w[3]) for w in words))
+        assert got == expect
+
+
+class TestJaxBackend:
+    def test_registry_name(self):
+        backend = get_backend("jax", batch=4096)
+        assert backend.name == "jax"
+
+    def test_search_parity_with_cpu(self):
+        backend = get_backend("jax", batch=1024)
+        prefix = _prefix(14)
+        truth = get_backend("cpu").search(prefix, 0, 1 << 14, 10)
+        got = backend.search(prefix, 0, 1 << 14, 10)
+        assert got.nonce == truth.nonce
+        if got.nonce is not None:
+            assert got.hashes_done == truth.hashes_done  # earliest-hit count
+
+    def test_partial_final_batch_masked(self):
+        # count smaller than one device batch: hits past count must not report.
+        backend = get_backend("jax", batch=4096)
+        prefix = _prefix(15)
+        truth = get_backend("cpu").search(prefix, 0, 4096, 8)
+        assert truth.nonce is not None, "seed must produce a hit in 4096"
+        res = backend.search(prefix, 0, truth.nonce, 8)  # exclusive of the hit
+        assert res.nonce is None
+        res2 = backend.search(prefix, 0, truth.nonce + 1, 8)
+        assert res2.nonce == truth.nonce
+
+    def test_hit_meets_target(self):
+        backend = get_backend("jax", batch=1024)
+        prefix = _prefix(16)
+        res = backend.search(prefix, 0, 1 << 13, 9)
+        if res.nonce is not None:
+            digest = sha256_ref.sha256d(prefix + struct.pack(">I", res.nonce))
+            assert meets_target(digest, 9)
+
+    def test_nonzero_start(self):
+        backend = get_backend("jax", batch=1024)
+        prefix = _prefix(17)
+        truth = get_backend("cpu").search(prefix, 5000, 1 << 13, 9)
+        got = backend.search(prefix, 5000, 1 << 13, 9)
+        assert got.nonce == truth.nonce
+
+    def test_batch_validation(self):
+        with pytest.raises(ValueError):
+            get_backend("jax", batch=1000)  # not a power of two

@@ -1,0 +1,125 @@
+"""Miner: sealing headers, abort semantics, timestamp roll, determinism."""
+
+import threading
+
+import pytest
+
+from p1_tpu.core import BlockHeader, make_genesis, meets_target
+from p1_tpu.hashx.backend import HashBackend, SearchResult
+from p1_tpu.miner import Miner
+
+
+def _candidate(difficulty: int, seed: int = 0) -> BlockHeader:
+    genesis = make_genesis(difficulty)
+    return BlockHeader(
+        version=1,
+        prev_hash=genesis.block_hash(),
+        merkle_root=bytes(32),
+        timestamp=1735689700 + seed,
+        difficulty=difficulty,
+        nonce=0,
+    )
+
+
+def _backend(name):
+    if name == "jax":
+        from p1_tpu.hashx import get_backend
+
+        return get_backend("jax", batch=1024)  # keep CPU-test compiles small
+    return name
+
+
+@pytest.mark.parametrize("backend", ["cpu", "numpy", "jax"])
+def test_mines_valid_header(backend):
+    miner = Miner(backend=_backend(backend), chunk=1 << 12)
+    sealed = miner.search_nonce(_candidate(8))
+    assert sealed is not None
+    assert meets_target(sealed.block_hash(), 8)
+    assert miner.last_stats.hashes_done >= 1
+    assert miner.last_stats.hashes_per_sec > 0
+
+
+def test_deterministic_across_backends():
+    sealed = [
+        Miner(backend=_backend(b), chunk=1 << 12).search_nonce(_candidate(10, seed=3))
+        for b in ("cpu", "numpy", "jax")
+    ]
+    nonces = {s.nonce for s in sealed}
+    assert len(nonces) == 1, f"backends disagree: {nonces}"
+
+
+def test_abort_before_start():
+    abort = threading.Event()
+    abort.set()
+    miner = Miner(backend="cpu", chunk=256)
+    assert miner.search_nonce(_candidate(30), abort=abort) is None
+    assert miner.last_stats.aborted
+
+
+def test_abort_mid_search():
+    abort = threading.Event()
+
+    class SlowBackend(HashBackend):
+        """Never finds anything; sets abort after a few chunks."""
+
+        calls = 0
+
+        def sha256d(self, data):
+            raise NotImplementedError
+
+        def search(self, prefix, start, count, difficulty):
+            SlowBackend.calls += 1
+            if SlowBackend.calls >= 3:
+                abort.set()
+            return SearchResult(None, count)
+
+    miner = Miner(backend=SlowBackend(), chunk=1024)
+    assert miner.search_nonce(_candidate(30), abort=abort) is None
+    assert miner.last_stats.aborted
+    assert miner.last_stats.hashes_done == SlowBackend.calls * 1024
+
+
+def test_timestamp_roll_on_exhaustion():
+    class NeverHit(HashBackend):
+        def sha256d(self, data):
+            raise NotImplementedError
+
+        def search(self, prefix, start, count, difficulty):
+            return SearchResult(None, count)
+
+    miner = Miner(backend=NeverHit(), chunk=1 << 31, max_timestamp_rolls=2)
+    header = _candidate(30)
+    assert miner.search_nonce(header) is None
+    assert miner.last_stats.timestamp_rolls == 2
+    # 3 full sweeps of nonce space (initial + 2 rolls)
+    assert miner.last_stats.hashes_done == 3 * (1 << 32)
+
+
+def test_timestamp_roll_produces_valid_header():
+    class HitAfterRoll(HashBackend):
+        """Refuses the original timestamp's space; hits once rolled."""
+
+        def __init__(self, real):
+            self.real = real
+            self.sweeps = 0
+
+        def sha256d(self, data):
+            return self.real.sha256d(data)
+
+        def search(self, prefix, start, count, difficulty):
+            sweeps_before = self.sweeps
+            if start + count >= 1 << 32:
+                self.sweeps += 1
+            if sweeps_before < 1:
+                return SearchResult(None, count)
+            return self.real.search(prefix, start, count, difficulty)
+
+    from p1_tpu.hashx import get_backend
+
+    miner = Miner(backend=HitAfterRoll(get_backend("cpu")), chunk=1 << 31)
+    header = _candidate(8)
+    sealed = miner.search_nonce(header)
+    # The first full sweep is swallowed; the hit comes at timestamp+1.
+    assert sealed is not None
+    assert sealed.timestamp == header.timestamp + 1
+    assert meets_target(sealed.block_hash(), 8)
