@@ -2,15 +2,34 @@
 
 Multi-chip hardware is unavailable in CI; the sharded nonce-search path
 (shard_map + pmin over a Mesh) is exercised on a virtual 8-device CPU mesh
-instead (SURVEY.md §7 step 8).  These env vars must be set before the first
-``import jax`` anywhere in the test process.
+instead (SURVEY.md §7 step 8).
+
+Two traps this file defuses:
+
+- ``XLA_FLAGS`` must be in the environment before the first backend
+  initialization, so it is set at import time (conftest imports before any
+  test module).
+- This VM's axon sitecustomize calls ``jax.config.update("jax_platforms",
+  "axon,cpu")`` at interpreter start, which *overrides* any
+  ``JAX_PLATFORMS`` env var — forcing CPU requires an explicit config
+  update after import, not an env var.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionstart(session):
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
