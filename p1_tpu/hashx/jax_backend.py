@@ -26,7 +26,7 @@ _U32 = jnp.uint32
 class JaxBackend(HashBackend):
     """XLA-compiled SHA-256d search on the default JAX device."""
 
-    def __init__(self, batch: int = 1 << 20, platform: str | None = None):
+    def __init__(self, batch: int = 1 << 24, platform: str | None = None):
         if batch <= 0 or batch & (batch - 1):
             raise ValueError(f"batch must be a power of two, got {batch}")
         self.batch = batch

@@ -3,86 +3,129 @@
 The device-side heart of the framework (BASELINE.json:5 — the miner's inner
 loop "becomes a vmapped Pallas SHA-256 kernel that evaluates millions of
 candidate nonces per device step").  This module is the XLA formulation: one
-uint32 lane per candidate nonce, all 64 rounds unrolled at trace time into
-straight-line vector ops that XLA tiles onto the TPU VPU (8x128 vregs).  The
-Pallas kernel (pallas_backend.py) reuses exactly this math inside a kernel
-body; on CPU the same functions run under the virtual-device test mesh.
+uint32 lane per candidate nonce, vector ops that XLA tiles onto the TPU VPU
+(8x128 vregs).  The Pallas kernel (pallas_backend.py) reuses exactly this
+round math inside a kernel body; on CPU the same functions run under the
+virtual-device test mesh.
 
 Design choices for TPU:
 
 - **Midstate**: the first 64 header bytes are nonce-independent, so the host
   compresses chunk 1 once (sha256_ref.header_midstate) and the device only
   runs chunk 2 + the full second pass — 2 compressions instead of 3.
+- **Rolled rounds with an unroll knob**: the 64 SHA-256 rounds (with the
+  message-schedule extension fused in) run under ``lax.fori_loop`` carrying a
+  16-word rolling window — the whole double hash traces as ~2 round bodies
+  instead of 2x(48+64) unrolled steps, so XLA:CPU compiles in seconds rather
+  than tens of minutes (a 1-vCPU box never finished the unrolled trace).
+  ``unroll=`` re-expands the loop body for TPU throughput; with the window
+  carried as 16 separate arrays the rotation is pure re-binding, so an
+  unrolled body has static register assignments and no roll/concat traffic.
 - **Static shapes**: the batch size is a trace-time constant; the host loop
   re-invokes the jitted step with a new ``nonce_base`` scalar, so nothing
   recompiles between steps.
-- **First-hit reduce**: each step returns ``min(lane index where hit, else
-  batch)`` — a single uint32 — keeping device->host traffic at 4 bytes per
-  step and making the multi-chip ``pmin`` reduction trivial.
+- **First-hit reduce**: each step returns ``min(flat lane index where hit,
+  else batch)`` — a single uint32 — keeping device->host traffic at 4 bytes
+  per step and making the multi-chip ``pmin`` reduction trivial.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 from p1_tpu.hashx.sha256_ref import IV, K
 
 _U32 = jnp.uint32
+
+#: fori_loop unroll factor by platform.  CPU wants a tiny trace (compile
+#: time dominates on the 1-vCPU test box); TPU amortizes one compile over
+#: the whole mining session, so re-expanding the round body buys VPU
+#: throughput back.  16 rounds per body keeps the trace ~8x smaller than
+#: full unroll while giving XLA long straight-line stretches to fuse.
+_PLATFORM_UNROLL = {"cpu": 1, "tpu": 16, "axon": 16}
+
+
+def default_unroll(platform: str | None = None) -> int:
+    p = platform or jax.default_backend()
+    return _PLATFORM_UNROLL.get(p, 8)
 
 
 def _rotr(x: jax.Array, n: int) -> jax.Array:
     return (x >> _U32(n)) | (x << _U32(32 - n))
 
 
-def _extend_schedule(w: list[jax.Array]) -> list[jax.Array]:
-    """Message-schedule expansion W16..W63 (in-place append, trace-time loop)."""
-    for i in range(16, 64):
-        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> _U32(3))
-        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> _U32(10))
-        w.append(w[i - 16] + s0 + w[i - 7] + s1)
-    return w
+# A host-side constant (NOT a jnp array: creating one inside a trace and
+# caching it would leak a tracer); jnp indexes it as an implicit constant.
+_K_NP = np.asarray(K, dtype=np.uint32)
 
 
-def _compress(state: Sequence[jax.Array], w: list[jax.Array]) -> list[jax.Array]:
-    """64 SHA-256 rounds, unrolled; returns state + compressed."""
-    a, b, c, d, e, f, g, h = state
-    for i in range(64):
+def _compress(
+    state: tuple[jax.Array, ...], w16: tuple[jax.Array, ...], unroll: int
+) -> tuple[jax.Array, ...]:
+    """One SHA-256 compression over a 16-word chunk, rounds+extension fused.
+
+    The carry holds the sliding window ``w[i..i+15]`` as 16 separate arrays;
+    round ``i`` consumes ``w[i]`` (= window[0]) and appends
+    ``w[i+16] = w[i] + σ0(w[i+1]) + w[i+9] + σ1(w[i+14])`` — so rounds
+    16..63 see exactly the words the schedule extension would have produced,
+    without ever materializing a (64, batch) array in HBM.  The 16 extension
+    steps computed for rounds 48..63 feed nothing; that waste is ~12% of the
+    σ work and buys a single uniform round body.
+    """
+    ks = jnp.asarray(_K_NP)
+
+    def body(i, carry):
+        w, s = carry
+        a, b, c, d, e, f, g, h = s
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + _U32(K[i]) + w[i]
+        t1 = h + s1 + ch + ks[i] + w[0]
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = s0 + maj
-        a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
-    return [x + y for x, y in zip(state, (a, b, c, d, e, f, g, h))]
+        sig0 = _rotr(w[1], 7) ^ _rotr(w[1], 18) ^ (w[1] >> _U32(3))
+        sig1 = _rotr(w[14], 17) ^ _rotr(w[14], 19) ^ (w[14] >> _U32(10))
+        w_next = w[0] + sig0 + w[9] + sig1
+        return (
+            w[1:] + (w_next,),
+            (t1 + s0 + maj, a, b, c, d + t1, e, f, g),
+        )
+
+    _, out = lax.fori_loop(0, 64, body, (w16, tuple(state)), unroll=unroll)
+    return tuple(x + y for x, y in zip(state, out))
 
 
 def sha256d_words(
-    midstate: jax.Array, tail: jax.Array, nonces: jax.Array
+    midstate: jax.Array,
+    tail: jax.Array,
+    nonces: jax.Array,
+    unroll: int | None = None,
 ) -> list[jax.Array]:
     """SHA-256d digest words for a lane-vector of nonces.
 
     midstate: (8,) uint32 chunk-1 state; tail: (3,) uint32 chunk-2 words 0..2;
     nonces: (...,) uint32.  Returns 8 arrays shaped like ``nonces``.
     """
+    if unroll is None:
+        unroll = default_unroll()
     zero = jnp.zeros_like(nonces)
 
     def bc(word: jax.Array) -> jax.Array:
         return jnp.broadcast_to(word.astype(_U32), nonces.shape)
 
     # Pass 1, chunk 2: 16 tail bytes + nonce word + pad(0x80) + bitlen 640.
-    w = [bc(tail[0]), bc(tail[1]), bc(tail[2]), nonces]
-    w += [zero + _U32(0x80000000)] + [zero] * 10 + [zero + _U32(640)]
-    state1 = _compress([bc(m) for m in midstate], _extend_schedule(w))
+    w = (bc(tail[0]), bc(tail[1]), bc(tail[2]), nonces)
+    w += (zero + _U32(0x80000000),) + (zero,) * 10 + (zero + _U32(640),)
+    state1 = _compress(tuple(bc(m) for m in midstate), w, unroll)
 
     # Pass 2: the 32-byte digest as one padded block (bitlen 256).
-    w2 = list(state1) + [zero + _U32(0x80000000)] + [zero] * 6 + [zero + _U32(256)]
-    iv = [jnp.full(nonces.shape, v, dtype=_U32) for v in IV]
-    return _compress(iv, _extend_schedule(w2))
+    w2 = state1 + (zero + _U32(0x80000000),) + (zero,) * 6 + (zero + _U32(256),)
+    iv = tuple(jnp.full(nonces.shape, v, dtype=_U32) for v in IV)
+    return list(_compress(iv, w2, unroll))
 
 
 def below_target(digest_words: list[jax.Array], target_words: jax.Array) -> jax.Array:
@@ -108,17 +151,25 @@ def search_step(
     target_words: jax.Array,
     nonce_base: jax.Array,
     batch: int,
+    unroll: int | None = None,
 ) -> jax.Array:
     """One device step: scan [nonce_base, nonce_base+batch) lanes, return
     the first hit's offset from nonce_base, or ``batch`` if none."""
     nonces = nonce_base + jnp.arange(batch, dtype=_U32)
-    hits = below_target(sha256d_words(midstate, tail, nonces), target_words)
+    hits = below_target(sha256d_words(midstate, tail, nonces, unroll), target_words)
     return first_hit_index(hits, batch)
 
 
 @functools.cache
-def jit_search_step(batch: int, platform: str | None = None):
-    """Jitted ``search_step`` closed over a static batch size."""
-    fn = functools.partial(search_step, batch=batch)
+def jit_search_step(batch: int, platform: str | None = None, unroll: int | None = None):
+    """Jitted ``search_step`` closed over a static batch size.
+
+    ``unroll=None`` resolves per platform (see ``default_unroll``) before
+    the trace is cut, so CPU tests get the second-scale compile and TPU
+    keeps its throughput body.
+    """
+    if unroll is None:
+        unroll = default_unroll(platform)
+    fn = functools.partial(search_step, batch=batch, unroll=unroll)
     device = jax.devices(platform)[0] if platform else None
     return jax.jit(fn, device=device)

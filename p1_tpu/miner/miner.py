@@ -42,16 +42,21 @@ class Miner:
 
     ``chunk`` is the number of nonces requested per backend call — the abort
     granularity.  The JAX backends pipeline device steps *within* a chunk, so
-    the chunk should span several device batches.
+    the chunk should span several device batches; ``chunk=None`` derives
+    4x the backend's device batch when it has one (keeping the pipeline
+    full), else a CPU-friendly 2**22.
     """
 
     def __init__(
         self,
         backend: str | HashBackend = "cpu",
-        chunk: int = 1 << 22,
+        chunk: int | None = None,
         max_timestamp_rolls: int | None = None,
     ):
         self.backend = get_backend(backend) if isinstance(backend, str) else backend
+        if chunk is None:
+            batch = getattr(self.backend, "batch", None)
+            chunk = 4 * batch if batch else 1 << 22
         if chunk <= 0:
             raise ValueError("chunk must be positive")
         self.chunk = chunk
