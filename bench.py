@@ -1,0 +1,90 @@
+"""Benchmark: SHA-256d hashes/sec/chip + time-to-block at difficulty 20.
+
+The driver contract (run on the ambient platform — the real TPU chip when
+available): print ONE JSON line with the headline metric and the speedup
+over the CPU baseline.  Metrics per BASELINE.json:2 — "SHA-256d
+hashes/sec/chip; time-to-block at difficulty 20" — measured, not estimated;
+the ≥10x north-star target is BASELINE.json:5.
+
+Extra keys carry the sub-measurements (cpu baseline rate, per-config
+detail); the required keys stay exactly {metric, value, unit, vs_baseline}.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+# Difficulty no hash can meet: keeps throughput runs scanning the whole range.
+IMPOSSIBLE = 255
+
+
+def _throughput(backend, prefix: bytes, count: int, repeats: int = 3) -> float:
+    """Best-of-N hashes/sec scanning ``count`` nonces with no hits."""
+    backend.search(prefix, 0, min(count, 1 << 16), IMPOSSIBLE)  # warmup/compile
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = backend.search(prefix, 0, count, IMPOSSIBLE)
+        dt = time.perf_counter() - t0
+        best = max(best, res.hashes_done / dt)
+    return best
+
+
+def _time_to_block(miner, difficulty: int, blocks: int = 5) -> float:
+    """Median wall-clock seconds to seal a block at ``difficulty``."""
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.core.header import BlockHeader
+
+    tip = make_genesis(difficulty)
+    times = []
+    prev = tip.block_hash()
+    for height in range(1, blocks + 1):
+        header = BlockHeader(
+            1, prev, bytes(32), tip.header.timestamp + 60 * height, difficulty, 0
+        )
+        t0 = time.perf_counter()
+        sealed = miner.search_nonce(header)
+        times.append(time.perf_counter() - t0)
+        assert sealed is not None
+        prev = sealed.block_hash()
+    return statistics.median(times)
+
+
+def main() -> None:
+    import jax
+
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    platform = jax.default_backend()
+    prefix = make_genesis(20).header.mining_prefix()
+
+    cpu_hps = _throughput(get_backend("cpu"), prefix, 1 << 18, repeats=1)
+
+    device = get_backend("jax", batch=1 << 24)
+    device_hps = _throughput(device, prefix, 1 << 28)
+
+    ttb = _time_to_block(Miner(backend=device), difficulty=20)
+
+    print(
+        json.dumps(
+            {
+                "metric": "sha256d_hashes_per_sec_per_chip",
+                "value": round(device_hps),
+                "unit": "H/s",
+                "vs_baseline": round(device_hps / cpu_hps, 1),
+                "platform": platform,
+                "cpu_baseline_hps": round(cpu_hps),
+                "time_to_block_d20_s": round(ttb, 3),
+                "batch": 1 << 24,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

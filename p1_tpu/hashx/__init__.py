@@ -20,10 +20,17 @@ def _load_jax():
     return jax_backend.JaxBackend
 
 
+def _load_sharded():
+    from p1_tpu.hashx import sharded
+
+    return sharded.ShardedBackend
+
+
 _register_lazy("jax", _load_jax)
-# "tpu" (Pallas kernel) and "native" (C++ core) register here when their
-# modules land; advertising names whose modules don't exist yet would turn
-# get_backend into a ModuleNotFoundError trap.
+_register_lazy("sharded", _load_sharded)
+# "tpu" (Pallas kernel) registers here when its module lands; advertising
+# names whose modules don't exist yet would turn get_backend into a
+# ModuleNotFoundError trap.
 
 __all__ = [
     "HashBackend",

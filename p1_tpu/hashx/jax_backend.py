@@ -2,15 +2,20 @@
 
 Capability parity: the ``JaxTPUBackend`` registry entry of the north star
 (BASELINE.json:5), in its pure-XLA form — the Pallas-kernel variant is the
-``tpu`` backend (pallas_backend.py).  ``search`` runs a host loop of jitted
-device steps with **async double-buffering**: step k+1 is dispatched before
-step k's 4-byte result is read back, so the device never idles on the host
-(JAX's async dispatch gives this for free as long as we delay
-``int()``-ing a result until the next step is enqueued).
+``tpu`` backend (pallas_backend.py) and the multi-chip variant is the
+``sharded`` backend (sharded.py), both of which reuse this module's
+pipelined host loop.  ``search`` runs a host loop of jitted device steps
+with **async double-buffering**: step k+1 is dispatched before step k's
+4-byte result is read back, so the device never idles on the host (JAX's
+async dispatch gives this for free as long as we delay ``int()``-ing a
+result until the next step is enqueued).
 """
 
 from __future__ import annotations
 
+from typing import Callable
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -21,16 +26,36 @@ from p1_tpu.hashx.sha256_ref import header_midstate, header_tail_words, sha256d
 
 _U32 = jnp.uint32
 
+#: A step function: (midstate, tail, target, nonce_base) -> uint32 offset of
+#: the earliest hit in [nonce_base, nonce_base + step_span), or step_span.
+StepFn = Callable[..., jax.Array]
 
-@register("jax")
-class JaxBackend(HashBackend):
-    """XLA-compiled SHA-256d search on the default JAX device."""
+#: Default device-step batch by platform.  The TPU sweep peaked near 2**24
+#: per step (106 MH/s pipelined; 2**26 gains little and slows aborts); on
+#: CPU the fori_loop carry holds ~25 live uint32 arrays per lane, so 2**24
+#: would mean a ~2 GB working set and minutes between abort checks on a
+#: 1-vCPU box — 2**18 keeps both in the tens-of-MB / sub-second range.
+_PLATFORM_BATCH = {"cpu": 1 << 18, "tpu": 1 << 24, "axon": 1 << 24}
 
-    def __init__(self, batch: int = 1 << 24, platform: str | None = None):
-        if batch <= 0 or batch & (batch - 1):
-            raise ValueError(f"batch must be a power of two, got {batch}")
-        self.batch = batch
-        self.platform = platform
+
+def default_batch(platform: str | None = None) -> int:
+    p = platform or jax.default_backend()
+    return _PLATFORM_BATCH.get(p, 1 << 20)
+
+
+class PipelinedSearchMixin:
+    """The host loop shared by every device-stepped backend.
+
+    Subclasses provide ``step_span`` (nonces evaluated per device step) and
+    ``_make_step()`` (the jitted step function).  ``search`` then scans an
+    arbitrary range with a one-step pipeline and host-side masking of the
+    partial final step.
+    """
+
+    step_span: int
+
+    def _make_step(self) -> StepFn:
+        raise NotImplementedError
 
     def sha256d(self, data: bytes) -> bytes:
         return sha256d(data)  # single digests stay on host
@@ -48,17 +73,17 @@ class JaxBackend(HashBackend):
     ) -> SearchResult:
         self._check_search_args(header_prefix, nonce_start, count, difficulty)
         midstate, tail, target = self._search_arrays(header_prefix, difficulty)
-        step = jit_search_step(self.batch, self.platform)
+        step = self._make_step()
 
         # Batched scan with a one-step pipeline.  Each step covers
-        # [base, base+batch); a partial final step is masked on the host by
-        # re-checking the hit offset against the remaining count.
+        # [base, base+step_span); a partial final step is masked on the host
+        # by re-checking the hit offset against the remaining count.
         pending: list[tuple[int, int, object]] = []  # (base, valid, device idx)
         done = 0
         result: SearchResult | None = None
         while done < count and result is None:
             base = nonce_start + done
-            valid = min(self.batch, count - done)
+            valid = min(self.step_span, count - done)
             idx = step(midstate, tail, target, _U32(base))
             pending.append((base, valid, idx))
             done += valid
@@ -77,3 +102,20 @@ class JaxBackend(HashBackend):
             nonce = base + offset
             return SearchResult(nonce, nonce - nonce_start + 1)
         return None
+
+
+@register("jax")
+class JaxBackend(PipelinedSearchMixin, HashBackend):
+    """XLA-compiled SHA-256d search on a single JAX device."""
+
+    def __init__(self, batch: int | None = None, platform: str | None = None):
+        if batch is None:
+            batch = default_batch(platform)
+        if batch <= 0 or batch & (batch - 1):
+            raise ValueError(f"batch must be a power of two, got {batch}")
+        self.batch = batch
+        self.step_span = batch
+        self.platform = platform
+
+    def _make_step(self) -> StepFn:
+        return jit_search_step(self.batch, self.platform)

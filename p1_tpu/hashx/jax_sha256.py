@@ -124,7 +124,10 @@ def sha256d_words(
 
     # Pass 2: the 32-byte digest as one padded block (bitlen 256).
     w2 = state1 + (zero + _U32(0x80000000),) + (zero,) * 6 + (zero + _U32(256),)
-    iv = tuple(jnp.full(nonces.shape, v, dtype=_U32) for v in IV)
+    # Derive the IV lanes from ``zero`` (not jnp.full) so they inherit the
+    # nonces' varying-manual-axes under shard_map and the fori_loop carry
+    # types line up on multi-chip meshes.
+    iv = tuple(zero + _U32(v) for v in IV)
     return list(_compress(iv, w2, unroll))
 
 

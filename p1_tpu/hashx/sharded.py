@@ -1,0 +1,126 @@
+"""Multi-chip sharded nonce search: ``shard_map`` + ``pmin`` over a Mesh.
+
+Capability parity: the north star's pod-scale mode — "Nonce ranges shard
+across chips with a ``pmin``-based first-hit reduction so a v5e-8 pod
+presents as a single miner on the gossip network" (BASELINE.json:5; config 5
+at BASELINE.json:11).  TPU-first design: the mesh is a 1-D
+``jax.sharding.Mesh`` over all chips, each device scans a **contiguous,
+disjoint** block of the step's nonce range, and one ``lax.pmin`` over the
+per-device first-hit offsets (sentinel = whole span) rides the ICI to give
+the deterministic global earliest nonce — 4 bytes cross the ICI per step,
+nothing crosses per candidate.
+
+Contiguous blocks (device d owns ``[base + d*batch, base + (d+1)*batch)``)
+rather than interleaved strides keep the global offset a pure affine map of
+the local one, so the ``pmin`` argument *is* the earliest-nonce order and
+the result is bit-identical to a single-device scan of the same range —
+the mesh-parity tests assert exactly that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from p1_tpu.hashx.backend import HashBackend, register
+from p1_tpu.hashx.jax_backend import PipelinedSearchMixin, StepFn, default_batch
+from p1_tpu.hashx.jax_sha256 import default_unroll, search_step
+
+_U32 = jnp.uint32
+AXIS = "chips"
+
+
+def make_mesh(
+    n_devices: int | None = None, platform: str | None = None
+) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devices = jax.devices(platform) if platform else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (AXIS,))
+
+
+@functools.cache
+def jit_sharded_step(
+    mesh: Mesh, batch_per_device: int, unroll: int | None = None
+) -> StepFn:
+    """Jitted sharded step closed over mesh + per-device batch.
+
+    Signature matches ``jit_search_step``: (midstate, tail, target,
+    nonce_base) -> uint32 offset of the earliest hit in
+    [nonce_base, nonce_base + n_devices*batch_per_device), or the span.
+    All inputs are replicated (``P()``); the output is replicated too —
+    ``pmin`` makes it device-invariant, so any shard can be read back.
+    """
+    n = mesh.devices.size
+    span = n * batch_per_device
+    if span >= 1 << 32:
+        raise ValueError("step span must stay below uint32 nonce space")
+    if unroll is None:
+        # Resolve against the mesh's platform, not the ambient default
+        # backend: a CPU validation mesh on a TPU host must get the
+        # trace-tiny body, and vice versa.
+        unroll = default_unroll(mesh.devices.flat[0].platform)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P()),
+        out_specs=P(),
+    )
+    def step(midstate, tail, target, nonce_base):
+        d = lax.axis_index(AXIS).astype(_U32)
+        base = nonce_base + d * _U32(batch_per_device)
+        # ``base`` varies per device, so the whole hash dataflow is varying
+        # over the mesh axis; promote the replicated inputs to match, or the
+        # fori_loop carry in the compression rejects the mixed types.
+        midstate, tail, target = (
+            lax.pcast(x, AXIS, to="varying") for x in (midstate, tail, target)
+        )
+        off = search_step(midstate, tail, target, base, batch_per_device, unroll)
+        hit = off < _U32(batch_per_device)
+        global_off = jnp.where(hit, d * _U32(batch_per_device) + off, _U32(span))
+        return lax.pmin(global_off, AXIS)
+
+    return step
+
+
+@register("sharded")
+class ShardedBackend(PipelinedSearchMixin, HashBackend):
+    """SHA-256d search sharded over every chip of a device mesh.
+
+    ``batch`` is the per-device batch; one step evaluates
+    ``n_devices * batch`` nonces.  With one device this degrades gracefully
+    to the single-chip search (the ``pmin`` is a no-op), so the same backend
+    name works from a laptop CPU to a pod slice.
+    """
+
+    def __init__(
+        self,
+        batch: int | None = None,
+        n_devices: int | None = None,
+        platform: str | None = None,
+        unroll: int | None = None,
+    ):
+        self.mesh = make_mesh(n_devices, platform)
+        if batch is None:
+            batch = default_batch(self.mesh.devices.flat[0].platform)
+        if batch <= 0 or batch & (batch - 1):
+            raise ValueError(f"batch must be a power of two, got {batch}")
+        self.n_devices = self.mesh.devices.size
+        self.batch = batch
+        self.step_span = self.n_devices * batch
+        self.unroll = unroll
+
+    def _make_step(self) -> StepFn:
+        return jit_sharded_step(self.mesh, self.batch, self.unroll)

@@ -43,8 +43,9 @@ class Miner:
     ``chunk`` is the number of nonces requested per backend call — the abort
     granularity.  The JAX backends pipeline device steps *within* a chunk, so
     the chunk should span several device batches; ``chunk=None`` derives
-    4x the backend's device batch when it has one (keeping the pipeline
-    full), else a CPU-friendly 2**22.
+    4x the backend's ``step_span`` (the nonces one device step covers —
+    mesh-wide for the sharded backend) when it has one, keeping the
+    pipeline full, else a CPU-friendly 2**22.
     """
 
     def __init__(
@@ -55,8 +56,8 @@ class Miner:
     ):
         self.backend = get_backend(backend) if isinstance(backend, str) else backend
         if chunk is None:
-            batch = getattr(self.backend, "batch", None)
-            chunk = 4 * batch if batch else 1 << 22
+            span = getattr(self.backend, "step_span", None)
+            chunk = 4 * span if span else 1 << 22
         if chunk <= 0:
             raise ValueError("chunk must be positive")
         self.chunk = chunk

@@ -1,0 +1,139 @@
+"""Multi-chip sharded search: parity with single-device scan on the 8-device
+CPU mesh (conftest forces XLA_FLAGS=--xla_force_host_platform_device_count=8),
+exercising the shard_map + pmin path the driver's dryrun validates."""
+
+import random
+import struct
+
+import pytest
+
+from p1_tpu.core import BlockHeader, target_from_difficulty, target_to_words
+from p1_tpu.hashx import get_backend
+from p1_tpu.hashx import sha256_ref
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from p1_tpu.hashx import sharded  # noqa: E402
+
+
+def _prefix(seed: int) -> bytes:
+    rng = random.Random(seed)
+    return BlockHeader(
+        1, rng.randbytes(32), rng.randbytes(32), 1735689700, 8, 0
+    ).mining_prefix()
+
+
+def _arrays(prefix: bytes, difficulty: int):
+    midstate = jnp.array(sha256_ref.header_midstate(prefix), dtype=jnp.uint32)
+    tail = jnp.array(sha256_ref.header_tail_words(prefix), dtype=jnp.uint32)
+    target = jnp.array(
+        target_to_words(target_from_difficulty(difficulty)), dtype=jnp.uint32
+    )
+    return midstate, tail, target
+
+
+class TestMesh:
+    def test_make_mesh_all_devices(self):
+        mesh = sharded.make_mesh()
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == (sharded.AXIS,)
+
+    def test_make_mesh_subset(self):
+        assert sharded.make_mesh(4).devices.size == 4
+        with pytest.raises(ValueError):
+            sharded.make_mesh(64)
+
+
+class TestShardedStep:
+    def test_parity_with_cpu_scan(self):
+        # The sharded step over 8x256 lanes must report the same first hit
+        # as a host scan of the same 2048-nonce range.
+        prefix = _prefix(30)
+        difficulty = 8
+        mesh = sharded.make_mesh(8)
+        step = sharded.jit_sharded_step(mesh, 256)
+        midstate, tail, target = _arrays(prefix, difficulty)
+        got = int(step(midstate, tail, target, jnp.uint32(0)))
+        truth = get_backend("cpu").search(prefix, 0, 2048, difficulty)
+        if truth.nonce is None:
+            assert got == 2048
+        else:
+            assert got == truth.nonce
+
+    def test_hit_on_non_first_device(self):
+        # Pick a difficulty/seed whose earliest hit lands past device 0's
+        # block so the pmin really crosses devices.
+        difficulty = 10
+        for seed in range(40, 60):
+            prefix = _prefix(seed)
+            truth = get_backend("cpu").search(prefix, 0, 2048, difficulty)
+            if truth.nonce is not None and truth.nonce >= 256:
+                break
+        else:
+            pytest.fail("no seed with a hit past device 0's block")
+        mesh = sharded.make_mesh(8)
+        step = sharded.jit_sharded_step(mesh, 256)
+        midstate, tail, target = _arrays(prefix, difficulty)
+        assert int(step(midstate, tail, target, jnp.uint32(0))) == truth.nonce
+
+    def test_no_hit_returns_span(self):
+        prefix = _prefix(31)
+        mesh = sharded.make_mesh(8)
+        step = sharded.jit_sharded_step(mesh, 256)
+        midstate, tail, target = _arrays(prefix, 255)
+        assert int(step(midstate, tail, target, jnp.uint32(0))) == 2048
+
+    def test_span_overflow_rejected(self):
+        mesh = sharded.make_mesh(8)
+        with pytest.raises(ValueError):
+            sharded.jit_sharded_step(mesh, 1 << 29)
+
+
+class TestShardedBackend:
+    def test_registry(self):
+        backend = get_backend("sharded", batch=256)
+        assert backend.name == "sharded"
+        assert backend.n_devices == 8
+        assert backend.step_span == 8 * 256
+
+    def test_search_parity_with_cpu(self):
+        backend = get_backend("sharded", batch=256)
+        prefix = _prefix(32)
+        truth = get_backend("cpu").search(prefix, 0, 1 << 13, 9)
+        got = backend.search(prefix, 0, 1 << 13, 9)
+        assert got.nonce == truth.nonce
+        if got.nonce is not None:
+            assert got.hashes_done == truth.hashes_done
+
+    def test_partial_final_step_masked(self):
+        backend = get_backend("sharded", batch=256)
+        prefix = _prefix(33)
+        truth = get_backend("cpu").search(prefix, 0, 1 << 12, 8)
+        assert truth.nonce is not None, "seed must hit within 4096"
+        res = backend.search(prefix, 0, truth.nonce, 8)  # exclusive of the hit
+        assert res.nonce is None
+        res2 = backend.search(prefix, 0, truth.nonce + 1, 8)
+        assert res2.nonce == truth.nonce
+
+    def test_single_device_mesh_degrades(self):
+        backend = get_backend("sharded", batch=256, n_devices=1)
+        prefix = _prefix(34)
+        truth = get_backend("cpu").search(prefix, 0, 4096, 8)
+        got = backend.search(prefix, 0, 4096, 8)
+        assert got.nonce == truth.nonce
+
+    def test_mines_valid_header(self):
+        from p1_tpu.core import meets_target
+        from p1_tpu.miner import Miner
+
+        backend = get_backend("sharded", batch=256)
+        miner = Miner(backend=backend, chunk=1 << 13)
+        header = BlockHeader(1, bytes(32), bytes(32), 1735689700, 10, 0)
+        sealed = miner.search_nonce(header)
+        assert sealed is not None
+        assert meets_target(sealed.block_hash(), 10)
+        digest = sha256_ref.sha256d(
+            sealed.mining_prefix() + struct.pack(">I", sealed.nonce)
+        )
+        assert digest == sealed.block_hash()
