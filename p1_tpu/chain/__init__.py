@@ -1,0 +1,23 @@
+from p1_tpu.chain.chain import AddResult, AddStatus, Chain
+from p1_tpu.chain.replay import (
+    ReplayReport,
+    generate_headers,
+    replay_device,
+    replay_host,
+)
+from p1_tpu.chain.store import ChainStore, save_chain
+from p1_tpu.chain.validate import ValidationError, check_block
+
+__all__ = [
+    "AddResult",
+    "AddStatus",
+    "Chain",
+    "ChainStore",
+    "ReplayReport",
+    "ValidationError",
+    "check_block",
+    "generate_headers",
+    "replay_device",
+    "replay_host",
+    "save_chain",
+]

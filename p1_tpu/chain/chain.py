@@ -1,0 +1,227 @@
+"""The block index: longest-chain fork choice with reorg support.
+
+Capability parity: the reference's chain layer — "chain-validation code
+paths" and "longest-chain" resolution on the gossip network
+(BASELINE.json:5,10).  Design:
+
+- Every valid block is indexed by hash with its height and **cumulative
+  work** (2**difficulty per block — equal to chain length at the fixed
+  difficulty the benchmark configs use, but correct if difficulty ever
+  varies).  Fork choice = most cumulative work; ties keep the current tip
+  (first-seen), so two honest nodes converge as soon as one branch pulls
+  ahead.
+- Blocks whose parent is unknown wait in an **orphan pool** keyed by
+  prev-hash (gossip delivers out of order); connecting a parent drains its
+  orphans recursively.
+- ``add_block`` reports what happened — including the reorg's removed/added
+  block lists so the mempool can resurrect transactions from abandoned
+  blocks and the miner knows to abort a stale search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterator
+
+from p1_tpu.core.block import Block
+from p1_tpu.core.genesis import make_genesis
+from p1_tpu.chain.validate import ValidationError, check_block
+
+
+class AddStatus(enum.Enum):
+    ACCEPTED = "accepted"  # extends a known block (tip may or may not move)
+    DUPLICATE = "duplicate"  # already indexed
+    ORPHAN = "orphan"  # parent unknown; parked in the orphan pool
+    REJECTED = "rejected"  # failed validation
+
+
+@dataclasses.dataclass(frozen=True)
+class AddResult:
+    status: AddStatus
+    reason: str = ""
+    #: Set when the tip moved.  ``removed`` is the abandoned branch
+    #: (old-tip-first), ``added`` the new one (fork-point-first); a plain
+    #: extension has removed=() and added=(block,).
+    removed: tuple[Block, ...] = ()
+    added: tuple[Block, ...] = ()
+
+    @property
+    def tip_changed(self) -> bool:
+        return bool(self.added)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Entry:
+    block: Block
+    height: int
+    work: int  # cumulative, including this block
+
+
+class Chain:
+    """Block index + fork choice for one chain configuration."""
+
+    def __init__(self, difficulty: int, genesis: Block | None = None):
+        self.difficulty = difficulty
+        self.genesis = genesis if genesis is not None else make_genesis(difficulty)
+        ghash = self.genesis.block_hash()
+        self._index: dict[bytes, _Entry] = {
+            ghash: _Entry(self.genesis, 0, 1 << difficulty)
+        }
+        self._tip_hash = ghash
+        self._orphans: dict[bytes, list[Block]] = {}  # prev_hash -> waiting blocks
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def tip(self) -> Block:
+        return self._index[self._tip_hash].block
+
+    @property
+    def tip_hash(self) -> bytes:
+        return self._tip_hash
+
+    @property
+    def height(self) -> int:
+        return self._index[self._tip_hash].height
+
+    def __contains__(self, block_hash: bytes) -> bool:
+        return block_hash in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def get(self, block_hash: bytes) -> Block | None:
+        entry = self._index.get(block_hash)
+        return entry.block if entry else None
+
+    def height_of(self, block_hash: bytes) -> int:
+        return self._index[block_hash].height
+
+    def main_chain(self) -> Iterator[Block]:
+        """Genesis-first iteration of the current best chain."""
+        path = list(self._walk_back(self._tip_hash))
+        yield from reversed(path)
+
+    def locator(self, dense: int = 10) -> list[bytes]:
+        """Hashes from tip back to genesis: the last ``dense`` blocks one by
+        one, then exponentially spaced — the classic sync locator shape."""
+        out = []
+        h = self._tip_hash
+        step = 1
+        while True:
+            out.append(h)
+            if self._index[h].height == 0:
+                return out
+            if len(out) >= dense:
+                step *= 2
+            for _ in range(step):
+                if self._index[h].height == 0:
+                    break
+                h = self._index[h].block.header.prev_hash
+
+    def blocks_after(self, locator: list[bytes], limit: int = 500) -> list[Block]:
+        """Main-chain blocks after the first locator hash we recognize."""
+        start_height = 0
+        for h in locator:
+            entry = self._index.get(h)
+            if entry and self._on_main_chain(h):
+                start_height = entry.height + 1
+                break
+        main = list(self.main_chain())
+        return main[start_height : start_height + limit]
+
+    # -- mutation --------------------------------------------------------
+
+    def add_block(self, block: Block) -> AddResult:
+        """Index ``block`` (and any orphans it unblocks); report the outcome.
+
+        The reorg paths in the result describe the net tip movement of the
+        whole call — computed once against the tip as it was on entry, so
+        an orphan cascade that moves the tip twice still reports one
+        coherent removed/added pair.
+        """
+        old_tip = self._tip_hash
+        status, reason = self._insert(block)
+        if status is not AddStatus.ACCEPTED:
+            return AddResult(status, reason=reason)
+
+        # A newly indexed block may be the missing parent of parked orphans.
+        pending = [block.block_hash()]
+        while pending:
+            for orphan in self._orphans.pop(pending.pop(), []):
+                st, _ = self._insert(orphan)
+                if st is AddStatus.ACCEPTED:
+                    pending.append(orphan.block_hash())
+
+        removed: tuple[Block, ...] = ()
+        added: tuple[Block, ...] = ()
+        if self._tip_hash != old_tip:
+            removed, added = self._reorg_paths(old_tip, self._tip_hash)
+        return AddResult(AddStatus.ACCEPTED, removed=removed, added=added)
+
+    def _insert(self, block: Block) -> tuple[AddStatus, str]:
+        """Validate + index one block and advance the tip by work."""
+        bhash = block.block_hash()
+        if bhash in self._index:
+            return AddStatus.DUPLICATE, ""
+        prev = self._index.get(block.header.prev_hash)
+        if prev is None:
+            self._orphans.setdefault(block.header.prev_hash, []).append(block)
+            return AddStatus.ORPHAN, ""
+        try:
+            check_block(block, self.difficulty)
+        except ValidationError as e:
+            return AddStatus.REJECTED, str(e)
+        entry = _Entry(
+            block, prev.height + 1, prev.work + (1 << block.header.difficulty)
+        )
+        self._index[bhash] = entry
+        if entry.work > self._index[self._tip_hash].work:
+            self._tip_hash = bhash
+        return AddStatus.ACCEPTED, ""
+
+    # -- internals -------------------------------------------------------
+
+    def _walk_back(self, block_hash: bytes) -> Iterator[Block]:
+        """Tip-first walk to genesis."""
+        h = block_hash
+        while True:
+            entry = self._index[h]
+            yield entry.block
+            if entry.height == 0:
+                return
+            h = entry.block.header.prev_hash
+
+    def _on_main_chain(self, block_hash: bytes) -> bool:
+        entry = self._index[block_hash]
+        h = self._tip_hash
+        while True:
+            cur = self._index[h]
+            if cur.height < entry.height:
+                return False
+            if h == block_hash:
+                return True
+            if cur.height == 0:
+                return False
+            h = cur.block.header.prev_hash
+
+    def _reorg_paths(
+        self, old_tip: bytes, new_tip: bytes
+    ) -> tuple[tuple[Block, ...], tuple[Block, ...]]:
+        """(removed old-tip-first, added fork-point-first) between two tips."""
+        a, b = old_tip, new_tip
+        removed: list[Block] = []
+        added: list[Block] = []
+        while self._index[a].height > self._index[b].height:
+            removed.append(self._index[a].block)
+            a = self._index[a].block.header.prev_hash
+        while self._index[b].height > self._index[a].height:
+            added.append(self._index[b].block)
+            b = self._index[b].block.header.prev_hash
+        while a != b:
+            removed.append(self._index[a].block)
+            added.append(self._index[b].block)
+            a = self._index[a].block.header.prev_hash
+            b = self._index[b].block.header.prev_hash
+        return tuple(removed), tuple(reversed(added))

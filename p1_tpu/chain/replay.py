@@ -1,0 +1,151 @@
+"""Chain replay: generate and verify long header chains (benchmark config 3).
+
+Capability parity: "chain replay: verify 10k-block header chain (hash-only,
+no mining)" (BASELINE.json:9).  TPU-first: verification packs the whole
+chain into one (N, 20) uint32 array and runs PoW + prev-hash linkage as a
+single batched device computation (``verify_header_chain``) — segmented at
+a fixed size so one compiled program serves any chain length.  A host
+(hashlib) path provides the oracle and the CPU baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from p1_tpu.core.hashutil import sha256d
+from p1_tpu.core.header import BlockHeader, meets_target
+from p1_tpu.core.genesis import make_genesis
+
+
+def generate_headers(
+    n: int, difficulty: int, backend=None, progress=None
+) -> list[BlockHeader]:
+    """Mine an ``n``-header chain (genesis first) at ``difficulty``.
+
+    Header-only mining: empty merkle root, timestamps stepping one second.
+    ``backend`` is any HashBackend (default cpu); low difficulties make
+    10k-header generation cheap enough for a test fixture.
+    """
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    miner = Miner(backend=backend if backend is not None else get_backend("cpu"))
+    headers = [make_genesis(difficulty).header]
+    for height in range(1, n):
+        draft = BlockHeader(
+            version=1,
+            prev_hash=headers[-1].block_hash(),
+            merkle_root=bytes(32),
+            timestamp=headers[-1].timestamp + 1,
+            difficulty=difficulty,
+            nonce=0,
+        )
+        sealed = miner.search_nonce(draft)
+        assert sealed is not None
+        headers.append(sealed)
+        if progress is not None:
+            progress(height)
+    return headers
+
+
+def headers_to_words(headers: list[BlockHeader]) -> np.ndarray:
+    """(N, 20) big-endian uint32 view of serialized headers."""
+    raw = b"".join(h.serialize() for h in headers)
+    return np.frombuffer(raw, dtype=">u4").astype(np.uint32).reshape(-1, 20)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplayReport:
+    n_headers: int
+    valid: bool
+    first_invalid: int | None  # header index, None when valid
+    elapsed_s: float
+    method: str
+
+    @property
+    def headers_per_sec(self) -> float:
+        return self.n_headers / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+
+def replay_host(headers: list[BlockHeader]) -> ReplayReport:
+    """Sequential hashlib verification: PoW + prev-hash linkage."""
+    t0 = time.perf_counter()
+    prev_digest = bytes(32)
+    first_invalid = None
+    difficulty = headers[0].difficulty if headers else 0
+    for i, header in enumerate(headers):
+        digest = sha256d(header.serialize())
+        pow_ok = i == 0 or meets_target(digest, difficulty)
+        diff_ok = header.difficulty == difficulty
+        if not (pow_ok and diff_ok and header.prev_hash == prev_digest):
+            first_invalid = i
+            break
+        prev_digest = digest
+    return ReplayReport(
+        len(headers),
+        first_invalid is None,
+        first_invalid,
+        time.perf_counter() - t0,
+        "host",
+    )
+
+
+def replay_device(
+    headers: list[BlockHeader], segment: int = 4096, platform: str | None = None
+) -> ReplayReport:
+    """Batched device verification in fixed-size segments.
+
+    Each segment checks PoW for all its headers and linkage both within the
+    segment and across the segment boundary (via the previous segment's
+    last digest, recomputed on host — one hash per 4096).  The final short
+    segment is padded with copies of its last header; padding lanes are
+    linked+valid by construction except pad lane 0's PoW, so invalid
+    indices past the real length are clamped off on host.
+    """
+    import jax.numpy as jnp
+
+    from p1_tpu.core.header import target_from_difficulty, target_to_words
+    from p1_tpu.hashx.jax_sha256 import jit_verify_chain
+
+    if not headers:
+        raise ValueError("empty chain")
+    difficulty = headers[0].difficulty
+    target = jnp.asarray(
+        target_to_words(target_from_difficulty(difficulty)), jnp.uint32
+    )
+    words = headers_to_words(headers)
+    n = len(headers)
+    step = jit_verify_chain(segment, platform)
+
+    t0 = time.perf_counter()
+    first_invalid = None
+    prev_digest_words = jnp.zeros((8,), jnp.uint32)  # genesis links to zero
+    for base in range(0, n, segment):
+        chunk = words[base : base + segment]
+        valid_len = chunk.shape[0]
+        if valid_len < segment:
+            pad = np.repeat(chunk[-1:], segment - valid_len, axis=0)
+            chunk = np.concatenate([chunk, pad], axis=0)
+        idx = int(
+            step(
+                jnp.asarray(chunk),
+                target,
+                prev_digest_words,
+                jnp.asarray(base == 0),
+                jnp.uint32(difficulty),
+            )
+        )
+        if idx < valid_len:
+            first_invalid = base + idx
+            break
+        # Host-hash the segment's last real header to seed the next link.
+        last = sha256d(headers[base + valid_len - 1].serialize())
+        prev_digest_words = jnp.asarray(
+            np.frombuffer(last, dtype=">u4").astype(np.uint32)
+        )
+    return ReplayReport(
+        n, first_invalid is None, first_invalid, time.perf_counter() - t0, "device"
+    )

@@ -1,0 +1,108 @@
+"""Chain persistence: append-only block log + resume from tip.
+
+The blockchain analog of checkpoint/resume (SURVEY.md §5): every block the
+node accepts is appended to a length-prefixed record log; on restart the
+log replays through ``Chain.add_block`` — full validation, fork choice,
+and orphan handling included — so a corrupt or truncated tail degrades to
+"resume from the last good block" rather than a poisoned index.  Records
+keep insertion order, which preserves first-seen tie-breaks and means
+side branches survive restarts too.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from pathlib import Path
+
+from p1_tpu.chain.chain import AddStatus, Chain
+from p1_tpu.core.block import Block
+
+_LEN = struct.Struct(">I")
+MAGIC = b"P1TPUCHN"
+
+
+class ChainStore:
+    """Append-only block log backing one node's chain."""
+
+    def __init__(self, path: str | os.PathLike):
+        self.path = Path(path)
+        self._fh: io.BufferedWriter | None = None
+
+    def append(self, block: Block) -> None:
+        if self._fh is None:
+            new = not self.path.exists() or self.path.stat().st_size == 0
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            if not new:
+                # Drop any truncated tail record (crash mid-append) before
+                # writing behind it, or its stale length prefix would point
+                # into the new records and corrupt the whole log.
+                good_end = self._scan_good_end(self.path.read_bytes())
+                if good_end < self.path.stat().st_size:
+                    os.truncate(self.path, good_end)
+            self._fh = open(self.path, "ab")
+            if new:
+                self._fh.write(MAGIC)
+        raw = block.serialize()
+        self._fh.write(_LEN.pack(len(raw)))
+        self._fh.write(raw)
+        self._fh.flush()
+
+    @staticmethod
+    def _scan_good_end(data: bytes) -> int:
+        """Byte offset just past the last whole record."""
+        if not data.startswith(MAGIC):
+            raise ValueError("not a chain store")
+        off = len(MAGIC)
+        while off + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, off)
+            if off + _LEN.size + n > len(data):
+                break
+            off += _LEN.size + n
+        return off
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def load_blocks(self) -> list[Block]:
+        """All decodable records, stopping cleanly at a truncated tail."""
+        if not self.path.exists():
+            return []
+        data = self.path.read_bytes()
+        if not data.startswith(MAGIC):
+            raise ValueError(f"{self.path} is not a chain store")
+        out = []
+        off = len(MAGIC)
+        end = self._scan_good_end(data)  # truncated tail: keep what's whole
+        while off < end:
+            (n,) = _LEN.unpack_from(data, off)
+            out.append(Block.deserialize(data[off + _LEN.size : off + _LEN.size + n]))
+            off += _LEN.size + n
+        return out
+
+    def load_chain(self, difficulty: int) -> Chain:
+        """Rebuild a validated chain from the log (skipping the genesis
+        record, which the Chain constructor provides)."""
+        chain = Chain(difficulty)
+        for block in self.load_blocks():
+            if block.block_hash() == chain.genesis.block_hash():
+                continue
+            chain.add_block(block)
+        return chain
+
+
+def save_chain(chain: Chain, path: str | os.PathLike) -> None:
+    """Snapshot a chain's main branch to a fresh store (tooling aid; nodes
+    normally append incrementally as blocks arrive)."""
+    p = Path(path)
+    if p.exists():
+        p.unlink()
+    store = ChainStore(p)
+    try:
+        for block in chain.main_chain():
+            store.append(block)
+    finally:
+        store.close()

@@ -1,0 +1,39 @@
+"""Stateless block validation rules.
+
+Capability parity: the reference's "chain-validation code paths"
+(BASELINE.json:5).  Rules enforced here need no chain context beyond the
+expected difficulty; linkage/height rules live in ``chain.py`` where the
+block index is.
+"""
+
+from __future__ import annotations
+
+from p1_tpu.core.block import Block
+from p1_tpu.core.header import meets_target
+
+
+class ValidationError(Exception):
+    """A block or header failed consensus validation."""
+
+
+def check_block(block: Block, expected_difficulty: int, *, is_genesis: bool = False) -> None:
+    """Raise ``ValidationError`` unless ``block`` is internally valid.
+
+    Checks: declared difficulty matches the chain's, proof-of-work meets the
+    target (waived for genesis, which anchors by identity), the merkle root
+    commits to exactly these transactions, and no txid appears twice —
+    the duplicate-txid rejection promised at p1_tpu/core/block.py:25
+    (CVE-2012-2459: duplicating the odd tail leaf forges a same-root block).
+    """
+    header = block.header
+    if header.difficulty != expected_difficulty:
+        raise ValidationError(
+            f"difficulty {header.difficulty} != chain difficulty {expected_difficulty}"
+        )
+    if not is_genesis and not meets_target(block.block_hash(), header.difficulty):
+        raise ValidationError("proof of work does not meet target")
+    txids = [tx.txid() for tx in block.txs]
+    if len(set(txids)) != len(txids):
+        raise ValidationError("duplicate txid in block")
+    if block.compute_merkle_root() != header.merkle_root:
+        raise ValidationError("merkle root mismatch")

@@ -131,6 +131,88 @@ def sha256d_words(
     return list(_compress(iv, w2, unroll))
 
 
+def sha256d_headers(
+    header_words: jax.Array, unroll: int | None = None
+) -> list[jax.Array]:
+    """SHA-256d digests for a batch of full 80-byte headers.
+
+    Unlike the nonce search (fixed prefix, varying nonce), every header
+    differs in all 20 words, so all 3 compressions run on device: chunk 1
+    (words 0..15), chunk 2 (words 16..19 + padding, bitlen 640), then the
+    second pass over the 32-byte digest.  This is the chain-replay hot loop
+    (BASELINE.json:9 — "verify 10k-block header chain, hash-only") as one
+    batched device computation: ``header_words`` is (N, 20) uint32, returns
+    8 arrays of shape (N,).
+    """
+    if unroll is None:
+        unroll = default_unroll()
+    n = header_words.shape[0]
+    zero = jnp.zeros((n,), dtype=_U32)
+
+    w1 = tuple(header_words[:, i] for i in range(16))
+    iv = tuple(zero + _U32(v) for v in IV)
+    state1 = _compress(iv, w1, unroll)
+
+    w2 = tuple(header_words[:, i] for i in range(16, 20))
+    w2 += (zero + _U32(0x80000000),) + (zero,) * 10 + (zero + _U32(640),)
+    state2 = _compress(state1, w2, unroll)
+
+    w3 = state2 + (zero + _U32(0x80000000),) + (zero,) * 6 + (zero + _U32(256),)
+    return list(_compress(iv, w3, unroll))
+
+
+def verify_header_chain(
+    header_words: jax.Array,
+    target_words: jax.Array,
+    prev_digest: jax.Array,
+    genesis_first: jax.Array,
+    difficulty: jax.Array,
+    unroll: int | None = None,
+) -> jax.Array:
+    """Index of the first invalid header in a linked batch, or N if all pass.
+
+    ``header_words``: (N, 20) uint32 — consecutive headers of one chain
+    segment.  A header is valid iff its declared difficulty field (word 18)
+    equals ``difficulty``, its SHA-256d meets ``target_words`` AND its
+    prev-hash field (words 1..8) equals the previous header's digest.
+    ``prev_digest``: (8,) digest of the header before the segment (for i=0).
+    ``genesis_first``: scalar bool — when true, header 0 is a genesis block:
+    linkage (zero prev-hash) is still enforced via ``prev_digest`` but the
+    PoW check is waived (genesis anchors by identity, not work).
+    """
+    digests = sha256d_headers(header_words, unroll)
+    n = header_words.shape[0]
+    pow_ok = below_target(digests, target_words)
+    pow_ok = pow_ok.at[0].set(pow_ok[0] | genesis_first)
+    # The difficulty field itself is consensus data: a header claiming a
+    # different difficulty than the chain's must be flagged even if its
+    # hash happens to meet the real target (word 18 = difficulty, see
+    # p1_tpu/core/header.py layout).
+    pow_ok = pow_ok & (header_words[:, 18] == difficulty)
+
+    link_ok = jnp.ones((n,), dtype=jnp.bool_)
+    for w in range(8):
+        claimed = header_words[:, 1 + w]
+        actual = jnp.concatenate(
+            [prev_digest[w][None], digests[w][:-1]]
+        )
+        link_ok = link_ok & (claimed == actual)
+
+    ok = pow_ok & link_ok
+    idx = jnp.arange(n, dtype=_U32)
+    return jnp.min(jnp.where(ok, _U32(n), idx))
+
+
+@functools.cache
+def jit_verify_chain(n: int, platform: str | None = None, unroll: int | None = None):
+    """Jitted ``verify_header_chain`` for segments of exactly ``n`` headers."""
+    if unroll is None:
+        unroll = default_unroll(platform)
+    fn = functools.partial(verify_header_chain, unroll=unroll)
+    device = jax.devices(platform)[0] if platform else None
+    return jax.jit(fn, device=device)
+
+
 def below_target(digest_words: list[jax.Array], target_words: jax.Array) -> jax.Array:
     """Lanes whose 256-bit big-endian digest is < the 8-word target."""
     lt = jnp.zeros(digest_words[0].shape, dtype=jnp.bool_)

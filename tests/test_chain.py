@@ -1,0 +1,294 @@
+"""Chain: validation, fork choice with reorg, orphans, replay, persistence."""
+
+import pytest
+
+from p1_tpu.chain import (
+    AddStatus,
+    Chain,
+    ChainStore,
+    ValidationError,
+    check_block,
+    generate_headers,
+    replay_device,
+    replay_host,
+    save_chain,
+)
+from p1_tpu.core import Block, BlockHeader, Transaction, make_genesis, merkle_root
+from p1_tpu.hashx import get_backend
+from p1_tpu.miner import Miner
+
+DIFF = 8  # cheap enough to mine dozens of blocks with hashlib
+_MINER = Miner(backend=get_backend("cpu"))
+
+
+def _mine_child(parent: Block, txs=(), ts_offset: int = 1, version: int = 1) -> Block:
+    """Seal a valid child block of ``parent``."""
+    header = BlockHeader(
+        version=version,
+        prev_hash=parent.block_hash(),
+        merkle_root=merkle_root([tx.txid() for tx in txs]),
+        timestamp=parent.header.timestamp + ts_offset,
+        difficulty=parent.header.difficulty,
+        nonce=0,
+    )
+    sealed = _MINER.search_nonce(header)
+    assert sealed is not None
+    return Block(sealed, tuple(txs))
+
+
+@pytest.fixture(scope="module")
+def chain_blocks():
+    """Genesis + 3 mined main-chain blocks + a 5-block competing fork off
+    genesis (mined once per module; chain state is rebuilt per test)."""
+    genesis = make_genesis(DIFF)
+    main = [genesis]
+    for _ in range(3):
+        main.append(_mine_child(main[-1]))
+    fork = [genesis]
+    for _ in range(5):
+        # version=2 differentiates fork headers from main ones at h+1
+        fork.append(_mine_child(fork[-1], version=2))
+    return main, fork
+
+
+class TestValidate:
+    def test_valid_block_passes(self, chain_blocks):
+        main, _ = chain_blocks
+        check_block(main[1], DIFF)
+
+    def test_wrong_difficulty(self, chain_blocks):
+        main, _ = chain_blocks
+        with pytest.raises(ValidationError, match="difficulty"):
+            check_block(main[1], DIFF + 1)
+
+    def test_bad_pow(self):
+        genesis = make_genesis(DIFF)
+        header = BlockHeader(
+            1, genesis.block_hash(), bytes(32), genesis.header.timestamp + 1, DIFF, 0
+        )
+        # nonce 0 is (with overwhelming odds for this fixed header) not a hit
+        from p1_tpu.core import meets_target
+
+        assert not meets_target(header.block_hash(), DIFF)
+        with pytest.raises(ValidationError, match="proof of work"):
+            check_block(Block(header, ()), DIFF)
+
+    def test_merkle_mismatch(self, chain_blocks):
+        main, _ = chain_blocks
+        tx = Transaction("a", "b", 1, 0, 0)
+        forged = Block(main[1].header, (tx,))
+        with pytest.raises(ValidationError, match="merkle"):
+            check_block(forged, DIFF)
+
+    def test_duplicate_txid_rejected(self):
+        # CVE-2012-2459: [t1, t2, t3, t3] shares a merkle root with
+        # [t1, t2, t3] (odd tail duplicated) -- the duplicate form must be
+        # rejected even though the root matches.
+        genesis = make_genesis(DIFF)
+        t1 = Transaction("a", "b", 1, 0, 0)
+        t2 = Transaction("c", "d", 2, 0, 0)
+        t3 = Transaction("e", "f", 3, 0, 0)
+        dup = (t1, t2, t3, t3)
+        assert merkle_root([t.txid() for t in dup]) == merkle_root(
+            [t.txid() for t in (t1, t2, t3)]
+        )
+        block = _mine_child(genesis, txs=dup)
+        with pytest.raises(ValidationError, match="duplicate txid"):
+            check_block(block, DIFF)
+
+    def test_genesis_pow_waived(self):
+        check_block(make_genesis(DIFF), DIFF, is_genesis=True)
+
+
+class TestForkChoice:
+    def test_linear_growth(self, chain_blocks):
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        for block in main[1:]:
+            res = chain.add_block(block)
+            assert res.status is AddStatus.ACCEPTED
+            assert res.added == (block,)
+            assert res.removed == ()
+        assert chain.height == 3
+        assert chain.tip == main[3]
+        assert list(chain.main_chain()) == main
+
+    def test_duplicate(self, chain_blocks):
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        chain.add_block(main[1])
+        assert chain.add_block(main[1]).status is AddStatus.DUPLICATE
+
+    def test_invalid_rejected(self, chain_blocks):
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        bad = Block(main[1].header, (Transaction("a", "b", 1, 0, 0),))
+        res = chain.add_block(bad)
+        assert res.status is AddStatus.REJECTED
+        assert "merkle" in res.reason
+
+    def test_shorter_fork_does_not_move_tip(self, chain_blocks):
+        main, fork = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        for block in main[1:]:
+            chain.add_block(block)
+        res = chain.add_block(fork[1])  # height 1 vs tip height 3
+        assert res.status is AddStatus.ACCEPTED
+        assert not res.tip_changed
+        assert chain.tip == main[3]
+
+    def test_reorg_to_heavier_fork(self, chain_blocks):
+        main, fork = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        for block in main[1:]:
+            chain.add_block(block)
+        # feed the 5-block fork; tip must flip when it passes 3
+        for block in fork[1:4]:
+            res = chain.add_block(block)
+            assert not res.tip_changed  # 1,2,3 tie or trail: first-seen holds
+        res = chain.add_block(fork[4])
+        assert res.tip_changed
+        assert res.removed == tuple(reversed(main[1:]))
+        assert res.added == tuple(fork[1:5])
+        assert chain.tip == fork[4]
+        assert chain.height == 4
+
+    def test_orphan_then_connect(self, chain_blocks):
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        assert chain.add_block(main[2]).status is AddStatus.ORPHAN
+        assert chain.add_block(main[3]).status is AddStatus.ORPHAN
+        assert chain.height == 0
+        res = chain.add_block(main[1])  # parent arrives: cascade connects
+        assert res.status is AddStatus.ACCEPTED
+        assert chain.height == 3
+        assert res.added == tuple(main[1:])
+        assert chain.tip == main[3]
+
+    def test_locator_and_blocks_after(self, chain_blocks):
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        for block in main[1:]:
+            chain.add_block(block)
+        loc = chain.locator()
+        assert loc[0] == chain.tip_hash
+        assert loc[-1] == main[0].block_hash()
+        peer = Chain(DIFF, genesis=main[0])
+        missing = chain.blocks_after(peer.locator())
+        assert missing == main[1:]
+
+
+class TestReplay:
+    @pytest.fixture(scope="class")
+    def headers(self):
+        return generate_headers(64, DIFF)
+
+    def test_host_replay_valid(self, headers):
+        report = replay_host(headers)
+        assert report.valid and report.first_invalid is None
+        assert report.n_headers == 64
+
+    def test_device_replay_valid(self, headers):
+        report = replay_device(headers, segment=16)
+        assert report.valid, f"first invalid: {report.first_invalid}"
+
+    def test_device_matches_host_on_corruption(self, headers):
+        # Corrupt one nonce mid-chain: both paths must flag that index
+        # (PoW breaks there, and linkage breaks at the next header).
+        bad = list(headers)
+        bad[37] = bad[37].with_nonce(bad[37].nonce ^ 1)
+        host = replay_host(bad)
+        device = replay_device(bad, segment=16)
+        assert not host.valid and not device.valid
+        assert host.first_invalid == device.first_invalid == 37
+
+    def test_device_flags_broken_link(self, headers):
+        bad = list(headers)
+        # Re-mine header 21 onto the wrong parent (height 19's hash).
+        draft = bad[21].with_nonce(0)
+        import dataclasses as dc
+
+        draft = dc.replace(draft, prev_hash=bad[19].block_hash())
+        sealed = _MINER.search_nonce(draft)
+        bad[21] = sealed
+        host = replay_host(bad)
+        device = replay_device(bad, segment=16)
+        assert host.first_invalid == device.first_invalid == 21
+
+    def test_partial_segment_padding(self, headers):
+        # 64 headers with segment 24 -> final segment is 16 real + 8 pad.
+        report = replay_device(headers, segment=24)
+        assert report.valid
+
+    def test_difficulty_field_corruption_flagged_by_both(self, headers):
+        # A difficulty-0 field makes any hash "meet target" -- both paths
+        # must still flag it (the declared difficulty is consensus data).
+        import dataclasses as dc
+
+        bad = list(headers)
+        bad[41] = dc.replace(bad[41], difficulty=0)
+        host = replay_host(bad)
+        device = replay_device(bad, segment=16)
+        assert host.first_invalid == device.first_invalid == 41
+
+
+class TestPersistence:
+    def test_roundtrip(self, chain_blocks, tmp_path):
+        main, fork = chain_blocks
+        store = ChainStore(tmp_path / "chain.dat")
+        chain = Chain(DIFF, genesis=main[0])
+        for block in main[1:] + fork[1:]:
+            res = chain.add_block(block)
+            if res.status is AddStatus.ACCEPTED:
+                store.append(block)
+        store.close()
+
+        resumed = ChainStore(tmp_path / "chain.dat").load_chain(DIFF)
+        assert resumed.tip_hash == chain.tip_hash
+        assert resumed.height == chain.height
+        assert len(resumed) == len(chain)  # side branches survive too
+
+    def test_truncated_tail_recovers(self, chain_blocks, tmp_path):
+        main, _ = chain_blocks
+        path = tmp_path / "chain.dat"
+        store = ChainStore(path)
+        for block in main[1:]:
+            store.append(block)
+        store.close()
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-7])  # crash mid-append
+        resumed = ChainStore(path).load_chain(DIFF)
+        assert resumed.height == 2  # last whole record survives
+
+    def test_append_after_truncated_tail(self, chain_blocks, tmp_path):
+        # Appending to a store with a garbage partial tail must first drop
+        # the tail, or its stale length prefix poisons every later load.
+        main, _ = chain_blocks
+        path = tmp_path / "chain.dat"
+        store = ChainStore(path)
+        store.append(main[1])
+        store.append(main[2])
+        store.close()
+        path.write_bytes(path.read_bytes()[:-7])  # crash mid-append of [2]
+        store = ChainStore(path)
+        store.append(main[2])
+        store.append(main[3])
+        store.close()
+        resumed = ChainStore(path).load_chain(DIFF)
+        assert resumed.height == 3
+        assert resumed.tip_hash == main[3].block_hash()
+
+    def test_save_chain_snapshot(self, chain_blocks, tmp_path):
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        for block in main[1:]:
+            chain.add_block(block)
+        save_chain(chain, tmp_path / "snap.dat")
+        resumed = ChainStore(tmp_path / "snap.dat").load_chain(DIFF)
+        assert list(resumed.main_chain()) == main
+
+    def test_wrong_magic(self, tmp_path):
+        path = tmp_path / "junk.dat"
+        path.write_bytes(b"not a chain store")
+        with pytest.raises(ValueError, match="not a chain store"):
+            ChainStore(path).load_blocks()
