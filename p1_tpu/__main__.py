@@ -1,0 +1,5 @@
+import sys
+
+from p1_tpu.cli import main
+
+sys.exit(main())

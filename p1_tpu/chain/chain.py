@@ -7,9 +7,12 @@ paths" and "longest-chain" resolution on the gossip network
 - Every valid block is indexed by hash with its height and **cumulative
   work** (2**difficulty per block — equal to chain length at the fixed
   difficulty the benchmark configs use, but correct if difficulty ever
-  varies).  Fork choice = most cumulative work; ties keep the current tip
-  (first-seen), so two honest nodes converge as soon as one branch pulls
-  ahead.
+  varies).  Fork choice = most cumulative work; ties resolve to the
+  lexicographically smaller tip hash.  The tie-break makes fork choice a
+  **pure function of the block set** — gossip floods every block, so any
+  two nodes that have seen the same blocks pick the same tip, and a
+  quiesced network converges deterministically instead of deadlocking on
+  equal-work first-seen tips.
 - Blocks whose parent is unknown wait in an **orphan pool** keyed by
   prev-hash (gossip delivers out of order); connecting a parent drains its
   orphans recursively.
@@ -45,6 +48,11 @@ class AddResult:
     #: extension has removed=() and added=(block,).
     removed: tuple[Block, ...] = ()
     added: tuple[Block, ...] = ()
+    #: Every block newly indexed by this call, insertion order: the
+    #: triggering block plus any orphans it unblocked.  This is what
+    #: persistence must append — ``added`` alone misses side branches and
+    #: cascaded orphans.
+    connected: tuple[Block, ...] = ()
 
     @property
     def tip_changed(self) -> bool:
@@ -147,18 +155,25 @@ class Chain:
             return AddResult(status, reason=reason)
 
         # A newly indexed block may be the missing parent of parked orphans.
+        connected = [block]
         pending = [block.block_hash()]
         while pending:
             for orphan in self._orphans.pop(pending.pop(), []):
                 st, _ = self._insert(orphan)
                 if st is AddStatus.ACCEPTED:
+                    connected.append(orphan)
                     pending.append(orphan.block_hash())
 
         removed: tuple[Block, ...] = ()
         added: tuple[Block, ...] = ()
         if self._tip_hash != old_tip:
             removed, added = self._reorg_paths(old_tip, self._tip_hash)
-        return AddResult(AddStatus.ACCEPTED, removed=removed, added=added)
+        return AddResult(
+            AddStatus.ACCEPTED,
+            removed=removed,
+            added=added,
+            connected=tuple(connected),
+        )
 
     def _insert(self, block: Block) -> tuple[AddStatus, str]:
         """Validate + index one block and advance the tip by work."""
@@ -177,7 +192,10 @@ class Chain:
             block, prev.height + 1, prev.work + (1 << block.header.difficulty)
         )
         self._index[bhash] = entry
-        if entry.work > self._index[self._tip_hash].work:
+        tip = self._index[self._tip_hash]
+        if entry.work > tip.work or (
+            entry.work == tip.work and bhash < self._tip_hash
+        ):
             self._tip_hash = bhash
         return AddStatus.ACCEPTED, ""
 

@@ -1,0 +1,370 @@
+"""Command line: run nodes, miners, replays, and benchmarks.
+
+SURVEY.md §7 step 7 — every benchmark config reproducible from one command
+(BASELINE.json:6-12):
+
+  config 1/2: p1 mine   --difficulty 16 --blocks 10 --backend jax
+  config 3:   p1 replay --n 10000 --difficulty 12
+  config 4:   p1 net    --nodes 4 --difficulty 20 --duration 10
+  one node:   p1 node   --port 9444 --peers host:port --mine
+  headline:   p1 bench
+
+(``p1`` = ``python -m p1_tpu``.)  Structured logs go to stderr; metric
+output is JSON on stdout, one object per line, so the driver and shell
+pipelines can consume it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import statistics
+import sys
+import time
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--difficulty", type=int, default=16)
+    p.add_argument(
+        "--backend",
+        default="cpu",
+        help="hash backend registry name (cpu, numpy, jax, sharded, ...)",
+    )
+    p.add_argument("--batch", type=int, default=None, help="device batch override")
+    p.add_argument("--chunk", type=int, default=None, help="miner abort granularity")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="p1_tpu", description="TPU-native proof-of-work blockchain node"
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("mine", help="mine N blocks from genesis (configs 1/2)")
+    _add_common(p)
+    p.add_argument("--blocks", type=int, default=10)
+
+    p = sub.add_parser("replay", help="generate+verify a header chain (config 3)")
+    _add_common(p)
+    p.add_argument("--n", type=int, default=10_000)
+    p.add_argument("--method", choices=["host", "device", "both"], default="both")
+    p.add_argument("--out", default=None, help="write generated headers here")
+    p.add_argument("--verify", default=None, help="verify this header file instead")
+
+    p = sub.add_parser("node", help="run one p2p node")
+    _add_common(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument("--peers", nargs="*", default=[], help="host:port ...")
+    p.add_argument("--no-mine", action="store_true")
+    p.add_argument("--store", default=None, help="chain persistence path")
+    p.add_argument("--duration", type=float, default=None, help="exit after N s")
+    p.add_argument(
+        "--deadline",
+        default=None,
+        help="unix time to stop mining at (overrides --duration; lets a "
+        "multi-process net quiesce simultaneously), or 'stdin' to print a "
+        "ready line and read the deadline from stdin once the parent has "
+        "seen every node come up (interpreter startup on a loaded host "
+        "can cost many seconds, so parent-computed wall times are unsafe)",
+    )
+    p.add_argument("--status-interval", type=float, default=10.0)
+
+    p = sub.add_parser("net", help="N-node localhost net (config 4)")
+    _add_common(p)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--duration", type=float, default=10.0)
+    p.add_argument("--base-port", type=int, default=19444)
+
+    sub.add_parser("bench", help="headline benchmark (one JSON line)")
+    return parser
+
+
+# -- mine ----------------------------------------------------------------
+
+
+def cmd_mine(args) -> int:
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.core.header import BlockHeader
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    kwargs = {"batch": args.batch} if args.batch else {}
+    miner = Miner(backend=get_backend(args.backend, **kwargs), chunk=args.chunk)
+    tip = make_genesis(args.difficulty).header
+    times, hashes = [], 0
+    for height in range(1, args.blocks + 1):
+        draft = BlockHeader(
+            1, tip.block_hash(), bytes(32), tip.timestamp + 1, args.difficulty, 0
+        )
+        t0 = time.perf_counter()
+        sealed = miner.search_nonce(draft)
+        dt = time.perf_counter() - t0
+        assert sealed is not None
+        times.append(dt)
+        hashes += miner.last_stats.hashes_done
+        logging.info(
+            "block height=%d nonce=%d t=%.3fs hps=%.0f",
+            height,
+            sealed.nonce,
+            dt,
+            miner.last_stats.hashes_per_sec,
+        )
+        tip = sealed
+    total = sum(times)
+    print(
+        json.dumps(
+            {
+                "config": "mine",
+                "backend": args.backend,
+                "difficulty": args.difficulty,
+                "blocks": args.blocks,
+                "hashes_per_sec": round(hashes / total) if total else 0,
+                "time_to_block_s": round(statistics.median(times), 4),
+                "total_s": round(total, 3),
+            }
+        )
+    )
+    return 0
+
+
+# -- replay --------------------------------------------------------------
+
+
+def cmd_replay(args) -> int:
+    from p1_tpu.chain import generate_headers, replay_device, replay_host
+    from p1_tpu.core.header import HEADER_SIZE, BlockHeader
+    from p1_tpu.hashx import get_backend
+
+    if args.verify:
+        raw = open(args.verify, "rb").read()
+        if len(raw) % HEADER_SIZE:
+            print(f"{args.verify}: not a multiple of {HEADER_SIZE} bytes", file=sys.stderr)
+            return 2
+        headers = [
+            BlockHeader.deserialize(raw[i : i + HEADER_SIZE])
+            for i in range(0, len(raw), HEADER_SIZE)
+        ]
+    else:
+        kwargs = {"batch": args.batch} if args.batch else {}
+        backend = get_backend(args.backend, **kwargs)
+        t0 = time.perf_counter()
+        headers = generate_headers(args.n, args.difficulty, backend=backend)
+        logging.info("generated %d headers in %.1fs", args.n, time.perf_counter() - t0)
+        if args.out:
+            with open(args.out, "wb") as fh:
+                for h in headers:
+                    fh.write(h.serialize())
+
+    reports = []
+    if args.method in ("host", "both"):
+        reports.append(replay_host(headers))
+    if args.method in ("device", "both"):
+        reports.append(replay_device(headers))
+        reports.append(replay_device(headers))  # warm (compile amortized)
+    ok = all(r.valid for r in reports)
+    print(
+        json.dumps(
+            {
+                "config": "replay",
+                "n_headers": len(headers),
+                "valid": ok,
+                "first_invalid": next(
+                    (r.first_invalid for r in reports if not r.valid), None
+                ),
+                "results": [
+                    {
+                        "method": r.method,
+                        "headers_per_sec": round(r.headers_per_sec),
+                        "elapsed_s": round(r.elapsed_s, 4),
+                    }
+                    for r in reports
+                ],
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+# -- node ----------------------------------------------------------------
+
+
+async def _run_node(args) -> int:
+    from p1_tpu.config import NodeConfig
+    from p1_tpu.node import Node
+
+    config = NodeConfig(
+        difficulty=args.difficulty,
+        backend=args.backend,
+        host=args.host,
+        port=args.port,
+        peers=tuple(args.peers),
+        mine=not args.no_mine,
+        store_path=args.store,
+        batch=args.batch,
+        chunk=args.chunk,
+    )
+    node = Node(config)
+    await node.start()
+    try:
+        if args.deadline is not None or args.duration is not None:
+            if args.deadline == "stdin":
+                print(json.dumps({"ready": node.port}), flush=True)
+                loop = asyncio.get_running_loop()
+                line = await loop.run_in_executor(None, sys.stdin.readline)
+                deadline = float(line.strip())
+            elif args.deadline is not None:
+                deadline = float(args.deadline)
+            else:
+                deadline = time.time() + args.duration
+            window = max(0.0, deadline - time.time())
+            logging.info("mining window: %.2fs until deadline", window)
+            await asyncio.sleep(window)
+            # Quiesce: stop producing, then wait for the gossip backlog to
+            # drain (GIL-bound mining starves the event loop, so a fixed
+            # sleep can undershoot): exit once the chain has been stable
+            # for a full second, or after 20s regardless.
+            await node.stop_mining()
+            await node.request_sync()
+            t_end = time.monotonic() + 20.0
+            stable = (node.chain.tip_hash, node.metrics.blocks_accepted)
+            stable_since = time.monotonic()
+            while time.monotonic() < t_end:
+                await asyncio.sleep(0.1)
+                now_state = (node.chain.tip_hash, node.metrics.blocks_accepted)
+                if now_state != stable:
+                    stable, stable_since = now_state, time.monotonic()
+                    await node.request_sync()
+                elif time.monotonic() - stable_since >= 1.0:
+                    break
+        else:
+            while True:
+                await asyncio.sleep(args.status_interval)
+                print(json.dumps(node.status()), flush=True)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        print(json.dumps(node.status()), flush=True)
+        await node.stop()
+    return 0
+
+
+def cmd_node(args) -> int:
+    try:
+        return asyncio.run(_run_node(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+# -- net -----------------------------------------------------------------
+
+
+def cmd_net(args) -> int:
+    """Spawn N `p1_tpu node` subprocesses in a full mesh and check they
+    converge on one tip (benchmark config 4, BASELINE.json:10)."""
+    import subprocess
+
+    ports = [args.base_port + i for i in range(args.nodes)]
+    procs = []
+    for i, port in enumerate(ports):
+        cmd = [
+            sys.executable,
+            "-m",
+            "p1_tpu",
+            "node",
+            "--port",
+            str(port),
+            "--difficulty",
+            str(args.difficulty),
+            "--backend",
+            args.backend,
+            "--deadline",
+            "stdin",
+        ]
+        if args.chunk:
+            cmd += ["--chunk", str(args.chunk)]
+        if args.batch:
+            cmd += ["--batch", str(args.batch)]
+        peers = [f"127.0.0.1:{p}" for p in ports[:i]]
+        if peers:
+            cmd += ["--peers", *peers]
+        procs.append(
+            subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True
+            )
+        )
+    statuses = []
+    try:
+        # Readiness handshake: interpreter startup can cost many seconds on
+        # a loaded host, so a deadline computed before the children exist
+        # could expire before they boot.  Every child prints a ready line;
+        # only then does the shared mining deadline start counting.
+        for proc in procs:
+            ready = json.loads(proc.stdout.readline())
+            assert "ready" in ready, ready
+        deadline = time.time() + args.duration
+        for proc in procs:
+            proc.stdin.write(f"{deadline!r}\n")
+            proc.stdin.flush()  # leave stdin open: communicate() closes it
+        for proc in procs:
+            out, _ = proc.communicate(timeout=args.duration + 120)
+            lines = (out or "").strip().splitlines()
+            if not lines:
+                raise RuntimeError(f"node pid {proc.pid} produced no status output")
+            statuses.append(json.loads(lines[-1]))
+    finally:
+        for proc in procs:  # never leave orphaned miners holding the ports
+            if proc.poll() is None:
+                proc.kill()
+    tips = {s["tip"] for s in statuses}
+    result = {
+        "config": "net",
+        "nodes": args.nodes,
+        "difficulty": args.difficulty,
+        "converged": len(tips) == 1,
+        "height": max(s["height"] for s in statuses),
+        "blocks_mined_total": sum(s["blocks_mined"] for s in statuses),
+        "reorgs_total": sum(s["reorgs"] for s in statuses),
+        "statuses": statuses,
+    }
+    print(json.dumps(result))
+    return 0 if result["converged"] else 1
+
+
+def cmd_bench(args) -> int:
+    # bench.py lives at the repo root (the driver contract), one level above
+    # the package — resolve it by path so `p1 bench` works from any cwd.
+    import importlib.util
+    from pathlib import Path
+
+    bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("bench", bench_path)
+    assert spec is not None and spec.loader is not None
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        stream=sys.stderr,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    handler = {
+        "mine": cmd_mine,
+        "replay": cmd_replay,
+        "node": cmd_node,
+        "net": cmd_net,
+        "bench": cmd_bench,
+    }[args.cmd]
+    return handler(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

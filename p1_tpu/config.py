@@ -1,0 +1,31 @@
+"""Node/miner configuration (SURVEY.md §7 step 7: one config dataclass).
+
+Everything a node process needs: chain parameters, hash backend choice,
+p2p identity and peer list, persistence path, mining switches.  The CLI
+(p1_tpu/cli.py) builds one of these from flags; tests build them directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeConfig:
+    difficulty: int = 16
+    backend: str = "cpu"  # hash backend registry name (cpu/numpy/jax/sharded)
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral (tests); CLI defaults to 9444
+    peers: tuple[str, ...] = ()  # "host:port" dial targets
+    mine: bool = True
+    store_path: str | None = None  # chain log; None = in-memory only
+    max_block_txs: int = 1000
+    batch: int | None = None  # device batch override for jax/sharded
+    chunk: int | None = None  # miner abort granularity (nonces per call)
+
+    def peer_addrs(self) -> list[tuple[str, int]]:
+        out = []
+        for peer in self.peers:
+            host, _, port = peer.rpartition(":")
+            out.append((host or "127.0.0.1", int(port)))
+        return out

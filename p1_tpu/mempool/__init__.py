@@ -1,0 +1,3 @@
+from p1_tpu.mempool.mempool import Mempool
+
+__all__ = ["Mempool"]

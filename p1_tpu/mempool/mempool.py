@@ -1,0 +1,59 @@
+"""Mempool: pending transactions feeding block assembly.
+
+Capability parity: the reference's mempool (BASELINE.json:5).  Fee-priority
+selection with insertion-order tie-breaks (deterministic for tests), txid
+dedup for gossip, eviction of mined transactions, and resurrection of
+transactions from blocks a reorg abandoned — wired to the removed/added
+paths ``Chain.add_block`` reports.
+"""
+
+from __future__ import annotations
+
+from p1_tpu.core.block import Block
+from p1_tpu.core.tx import Transaction
+
+
+class Mempool:
+    """Txid-keyed pending-transaction pool."""
+
+    def __init__(self, max_txs: int = 100_000):
+        self.max_txs = max_txs
+        self._txs: dict[bytes, Transaction] = {}  # insertion-ordered
+
+    def __len__(self) -> int:
+        return len(self._txs)
+
+    def __contains__(self, txid: bytes) -> bool:
+        return txid in self._txs
+
+    def add(self, tx: Transaction) -> bool:
+        """Admit ``tx``; False if already known or the pool is full."""
+        txid = tx.txid()
+        if txid in self._txs or len(self._txs) >= self.max_txs:
+            return False
+        self._txs[txid] = tx
+        return True
+
+    def select(self, max_txs: int = 1000) -> list[Transaction]:
+        """Highest-fee-first block candidates (insertion order on ties —
+        dict order is insertion order, so enumerate() supplies the rank)."""
+        ranked = sorted(
+            enumerate(self._txs.values()), key=lambda iv: (-iv[1].fee, iv[0])
+        )
+        return [tx for _, tx in ranked[:max_txs]]
+
+    def apply_block_delta(
+        self, removed: tuple[Block, ...], added: tuple[Block, ...]
+    ) -> None:
+        """Sync the pool with a tip movement reported by ``Chain.add_block``.
+
+        Transactions in newly-connected blocks leave the pool; transactions
+        from abandoned blocks come back (unless the new branch also
+        confirmed them — eviction runs last to win that race).
+        """
+        for block in removed:
+            for tx in block.txs:
+                self.add(tx)
+        for block in added:
+            for tx in block.txs:
+                self._txs.pop(tx.txid(), None)

@@ -1,0 +1,4 @@
+from p1_tpu.node.node import Node, NodeMetrics
+from p1_tpu.node.protocol import Hello, MsgType
+
+__all__ = ["Node", "NodeMetrics", "Hello", "MsgType"]

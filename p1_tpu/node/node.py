@@ -1,0 +1,412 @@
+"""The p2p node: gossip, chain sync, and the mining loop.
+
+Capability parity: the reference's "p2p node … gossip network …
+longest-chain" (BASELINE.json:5,10); benchmark config 4 is four of these on
+localhost.  Design (SURVEY.md §5):
+
+- **Single-threaded asyncio core** — every chain/mempool/peer mutation
+  happens on the event loop, so there are no data races by construction.
+  The only other thread is the miner's ``run_in_executor`` worker, which
+  touches nothing but its own ``HashBackend`` and a ``threading.Event``.
+- **Push gossip**: a new block or tx is pushed whole to every peer (the
+  chain dedups blocks, the mempool dedups txs, so floods terminate).
+  Out-of-order arrivals park in the chain's orphan pool and a GETBLOCKS
+  locator sync backfills the gap.
+- **Mining abort on new tip**: the in-flight ``search_nonce`` holds a
+  ``threading.Event``; any tip movement sets it, the worker returns, and
+  the loop reassembles against the new tip — stale work dies in one chunk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+
+from p1_tpu.chain import AddStatus, Chain, ChainStore
+from p1_tpu.config import NodeConfig
+from p1_tpu.core.block import Block, merkle_root
+from p1_tpu.core.header import BlockHeader
+from p1_tpu.core.tx import Transaction
+from p1_tpu.mempool import Mempool
+from p1_tpu.miner import Miner
+from p1_tpu.node import protocol
+from p1_tpu.node.protocol import Hello, MsgType
+
+log = logging.getLogger("p1_tpu.node")
+
+SYNC_BATCH = 500
+#: Byte budget for one BLOCKS reply — safely under protocol.MAX_FRAME so a
+#: sync reply is never a frame the receiver is guaranteed to reject.
+SYNC_BYTES = 8 << 20
+RECONNECT_DELAY_S = 0.5
+GOSSIP_SEND_TIMEOUT_S = 5.0
+
+
+@dataclasses.dataclass
+class NodeMetrics:
+    """Counters surfaced by ``Node.metrics()`` (SURVEY.md §5 metrics)."""
+
+    blocks_mined: int = 0
+    blocks_accepted: int = 0
+    blocks_rejected: int = 0
+    reorgs: int = 0
+    txs_accepted: int = 0
+    hashes_done: int = 0
+    mine_elapsed_s: float = 0.0
+    last_block_time_s: float = 0.0
+
+    @property
+    def hashes_per_sec(self) -> float:
+        return self.hashes_done / self.mine_elapsed_s if self.mine_elapsed_s else 0.0
+
+
+class _Peer:
+    def __init__(self, writer: asyncio.StreamWriter, label: str):
+        self.writer = writer
+        self.label = label
+        self.synced_once = False
+
+    async def send(self, payload: bytes) -> None:
+        await protocol.write_frame(self.writer, payload)
+
+
+class Node:
+    """One blockchain node: chain + mempool + p2p + (optionally) a miner."""
+
+    def __init__(self, config: NodeConfig, miner: Miner | None = None):
+        self.config = config
+        self.chain = Chain(config.difficulty)
+        self.mempool = Mempool()
+        self.metrics = NodeMetrics()
+        self.store = ChainStore(config.store_path) if config.store_path else None
+        if miner is not None:
+            self.miner = miner
+        else:
+            kwargs = {"batch": config.batch} if config.batch else {}
+            from p1_tpu.hashx import get_backend
+
+            self.miner = Miner(
+                backend=get_backend(config.backend, **kwargs), chunk=config.chunk
+            )
+        self._peers: dict[asyncio.StreamWriter, _Peer] = {}
+        self._server: asyncio.Server | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._sessions: set[asyncio.Task] = set()  # live inbound handlers
+        self._abort = None  # threading.Event of the in-flight search
+        self._mine_task: asyncio.Task | None = None
+        self._running = False
+        self.port: int | None = None  # bound listen port (after start)
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.store is not None:
+            restored = self.store.load_chain(self.config.difficulty)
+            # Re-adding through a fresh chain keeps validation authoritative.
+            for block in restored.main_chain():
+                if block.block_hash() != self.chain.genesis.block_hash():
+                    self.chain.add_block(block)
+            if self.chain.height:
+                log.info(
+                    "resumed chain height=%d tip=%s",
+                    self.chain.height,
+                    self.chain.tip_hash.hex()[:16],
+                )
+        self._running = True
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.config.host, self.config.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("listening on %s:%d", self.config.host, self.port)
+        for host, port in self.config.peer_addrs():
+            self._tasks.append(asyncio.create_task(self._dial_loop(host, port)))
+        if self.config.mine:
+            self.start_mining()
+
+    async def stop(self) -> None:
+        self._running = False
+        self._abort_inflight_search()
+        # Cancel inbound session handlers along with our own tasks BEFORE
+        # waiting on the server: Python 3.12's Server.wait_closed() blocks
+        # until every connection handler returns, and handlers sit in
+        # read_frame() indefinitely.
+        pending = [*self._tasks, *self._sessions]
+        for task in pending:
+            task.cancel()
+        await asyncio.gather(*pending, return_exceptions=True)
+        self._tasks.clear()
+        self._sessions.clear()
+        for writer in list(self._peers):
+            writer.close()
+        self._peers.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if self.store is not None:
+            self.store.close()
+
+    def start_mining(self) -> None:
+        """Start the mining loop on a running node (idempotent)."""
+        if self._running and self._mine_task is None:
+            self._mine_task = asyncio.create_task(self._mine_loop())
+            self._tasks.append(self._mine_task)
+
+    async def stop_mining(self) -> None:
+        """Stop the mining loop but keep the node gossiping (tests/CLI)."""
+        if self._mine_task is not None:
+            self._mine_task.cancel()
+            self._abort_inflight_search()
+            try:
+                await self._mine_task
+            except asyncio.CancelledError:
+                pass
+            if self._mine_task in self._tasks:
+                self._tasks.remove(self._mine_task)
+            self._mine_task = None
+
+    # -- p2p ------------------------------------------------------------
+
+    def _hello(self) -> bytes:
+        return protocol.encode_hello(
+            Hello(
+                self.chain.genesis.block_hash(),
+                self.chain.height,
+                self.port or 0,
+            )
+        )
+
+    async def _on_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        assert task is not None
+        self._sessions.add(task)
+        try:
+            await self._peer_session(reader, writer, "in")
+        finally:
+            self._sessions.discard(task)
+
+    async def _dial_loop(self, host: str, port: int) -> None:
+        """Keep one outbound connection to a configured peer alive."""
+        while self._running:
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError:
+                await asyncio.sleep(RECONNECT_DELAY_S)
+                continue
+            await self._peer_session(reader, writer, f"out:{host}:{port}")
+            await asyncio.sleep(RECONNECT_DELAY_S)
+
+    async def _peer_session(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, label: str
+    ) -> None:
+        peer = _Peer(writer, label)
+        try:
+            await peer.send(self._hello())
+            payload = await protocol.read_frame(reader)
+            mtype, hello = protocol.decode(payload)
+            if mtype is not MsgType.HELLO:
+                raise ValueError("expected HELLO")
+            if hello.genesis_hash != self.chain.genesis.block_hash():
+                raise ValueError("genesis mismatch")
+            self._peers[writer] = peer
+            log.info("peer %s connected (their height %d)", label, hello.tip_height)
+            if hello.tip_height > self.chain.height:
+                await peer.send(protocol.encode_getblocks(self.chain.locator()))
+            while self._running:
+                payload = await protocol.read_frame(reader)
+                await self._dispatch(peer, payload)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            ValueError,
+            OSError,
+        ) as e:
+            log.info("peer %s closed: %s", label, e)
+        finally:
+            self._peers.pop(writer, None)
+            writer.close()
+
+    async def _dispatch(self, peer: _Peer, payload: bytes) -> None:
+        mtype, body = protocol.decode(payload)
+        if mtype is MsgType.BLOCK:
+            await self._handle_block(body, origin=peer)
+        elif mtype is MsgType.TX:
+            await self._handle_tx(body, origin=peer)
+        elif mtype is MsgType.GETBLOCKS:
+            blocks = self.chain.blocks_after(body, limit=SYNC_BATCH)
+            # Cap the reply by encoded bytes too: with ~half-KB txs a
+            # 500-block batch can exceed the receiver's frame cap, which
+            # would wedge sync in a reconnect loop.
+            capped, total = [], 0
+            for blk in blocks:
+                total += len(blk.serialize()) + 4
+                if capped and total > SYNC_BYTES:
+                    break
+                capped.append(blk)
+            await peer.send(protocol.encode_blocks(capped))
+        elif mtype is MsgType.BLOCKS:
+            accepted_any = False
+            for block in body:
+                res = await self._handle_block(block, origin=peer, gossip=False)
+                accepted_any |= res.status is AddStatus.ACCEPTED
+            # Progress was made and the batch was non-empty: there may be
+            # more behind it (an empty/duplicate reply ends the loop).
+            if accepted_any and body:
+                await peer.send(protocol.encode_getblocks(self.chain.locator()))
+        elif mtype is MsgType.HELLO:
+            pass  # late HELLO: ignore
+
+    async def _gossip(self, payload: bytes, skip: _Peer | None = None) -> None:
+        """Send to all peers concurrently; a stalled peer times out and is
+        dropped instead of blocking propagation (and the mining loop)."""
+
+        async def send_one(peer: _Peer) -> None:
+            try:
+                await asyncio.wait_for(
+                    peer.send(payload), timeout=GOSSIP_SEND_TIMEOUT_S
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                peer.writer.close()  # reader loop will reap it
+
+        targets = [p for p in self._peers.values() if p is not skip]
+        if targets:
+            await asyncio.gather(*(send_one(p) for p in targets))
+
+    # -- chain/mempool handlers -----------------------------------------
+
+    async def _handle_block(
+        self, block: Block, origin: _Peer | None = None, gossip: bool = True
+    ):
+        res = self.chain.add_block(block)
+        if res.status is AddStatus.ACCEPTED:
+            self.metrics.blocks_accepted += 1
+            if self.store is not None:
+                for connected in res.connected:  # incl. cascaded orphans
+                    self.store.append(connected)
+            if res.tip_changed:
+                if res.removed:
+                    self.metrics.reorgs += 1
+                self.mempool.apply_block_delta(res.removed, res.added)
+                self._abort_inflight_search()
+                tip = self.chain.tip
+                log.info(
+                    "tip height=%d hash=%s nonce=%d txs=%d reorg=%d source=%s",
+                    self.chain.height,
+                    tip.block_hash().hex()[:16],
+                    tip.header.nonce,
+                    len(tip.txs),
+                    len(res.removed),
+                    origin.label if origin else "local",
+                )
+            if gossip:
+                await self._gossip(protocol.encode_block(block), skip=origin)
+        elif res.status is AddStatus.ORPHAN and origin is not None:
+            await origin.send(protocol.encode_getblocks(self.chain.locator()))
+        elif res.status is AddStatus.REJECTED:
+            self.metrics.blocks_rejected += 1
+            log.warning(
+                "rejected block from %s: %s",
+                origin.label if origin else "local",
+                res.reason,
+            )
+        return res
+
+    async def _handle_tx(self, tx: Transaction, origin: _Peer | None = None) -> None:
+        if self.mempool.add(tx):
+            self.metrics.txs_accepted += 1
+            await self._gossip(protocol.encode_tx(tx), skip=origin)
+
+    async def submit_tx(self, tx: Transaction) -> None:
+        """Local API: inject a transaction (CLI/tests)."""
+        await self._handle_tx(tx, origin=None)
+
+    async def request_sync(self) -> None:
+        """Ask every peer for blocks past our locator.  Used at quiesce: a
+        push dropped in the final instant (send timeout, reconnect window)
+        leaves no descendant to trigger an orphan backfill, so tips could
+        stay split on a same-height tie without this pull."""
+        if self._peers:
+            await self._gossip(protocol.encode_getblocks(self.chain.locator()))
+
+    # -- mining ----------------------------------------------------------
+
+    def _abort_inflight_search(self) -> None:
+        if self._abort is not None:
+            self._abort.set()
+
+    def _assemble(self) -> Block:
+        tip = self.chain.tip
+        txs = tuple(self.mempool.select(self.config.max_block_txs))
+        header = BlockHeader(
+            version=1,
+            prev_hash=tip.block_hash(),
+            merkle_root=merkle_root([tx.txid() for tx in txs]),
+            timestamp=max(tip.header.timestamp + 1, int(time.time())),
+            difficulty=self.config.difficulty,
+            nonce=0,
+        )
+        return Block(header, txs)
+
+    async def _mine_loop(self) -> None:
+        try:
+            await self._mine_loop_inner()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # A silently dead miner looks like a healthy idle node; make
+            # the failure loud (stop() retrieves the task with
+            # return_exceptions=True, so nothing else would surface it).
+            log.exception("mining loop died")
+            raise
+
+    async def _mine_loop_inner(self) -> None:
+        import threading
+
+        loop = asyncio.get_running_loop()
+        while self._running:
+            candidate = self._assemble()
+            self._abort = threading.Event()
+            t0 = time.perf_counter()
+            sealed = await loop.run_in_executor(
+                None, self.miner.search_nonce, candidate.header, self._abort
+            )
+            stats = self.miner.last_stats
+            self.metrics.hashes_done += stats.hashes_done
+            self.metrics.mine_elapsed_s += stats.elapsed_s
+            if sealed is None:
+                continue  # aborted: tip moved under us, reassemble
+            block = Block(sealed, candidate.txs)
+            self.metrics.blocks_mined += 1
+            self.metrics.last_block_time_s = time.perf_counter() - t0
+            log.info(
+                "mined height=%d nonce=%d txs=%d t=%.3fs hps=%.0f",
+                self.chain.height + 1,
+                sealed.nonce,
+                len(block.txs),
+                self.metrics.last_block_time_s,
+                stats.hashes_per_sec,
+            )
+            await self._handle_block(block, origin=None)
+            await asyncio.sleep(0)  # let gossip/tx handlers breathe
+
+    # -- introspection ---------------------------------------------------
+
+    def peer_count(self) -> int:
+        return len(self._peers)
+
+    def status(self) -> dict:
+        """The two BASELINE metrics + node state, JSON-ready."""
+        return {
+            "height": self.chain.height,
+            "tip": self.chain.tip_hash.hex(),
+            "peers": self.peer_count(),
+            "mempool": len(self.mempool),
+            "hashes_per_sec": round(self.metrics.hashes_per_sec),
+            "time_to_block_s": round(self.metrics.last_block_time_s, 3),
+            "blocks_mined": self.metrics.blocks_mined,
+            "blocks_accepted": self.metrics.blocks_accepted,
+            "reorgs": self.metrics.reorgs,
+        }

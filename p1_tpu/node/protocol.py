@@ -1,0 +1,137 @@
+"""P2P wire protocol: length-prefixed binary frames.
+
+Capability parity: the reference's gossip protocol (BASELINE.json:5,10).
+Frame = 4-byte big-endian payload length + 1-byte message type + payload.
+Deterministic binary payloads reuse the core serializers, so a message's
+bytes are exactly the consensus bytes — nothing to re-canonicalize.
+
+Messages:
+
+- HELLO:     genesis hash (32) + tip height (4) + listen port (2).
+             Sent both ways on connect; genesis mismatch = disconnect.
+- BLOCK:     one serialized block (push gossip).
+- TX:        one serialized transaction (push gossip).
+- GETBLOCKS: u16 count + count * 32-byte locator hashes (sync request).
+- BLOCKS:    u16 count + count * (u32 len + serialized block) (sync reply).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import struct
+
+from p1_tpu.core.block import Block
+from p1_tpu.core.tx import Transaction
+
+MAX_FRAME = 32 << 20  # hard cap against hostile length prefixes
+_LEN = struct.Struct(">I")
+_HELLO = struct.Struct(">32sIH")
+
+
+class MsgType(enum.IntEnum):
+    HELLO = 1
+    BLOCK = 2
+    TX = 3
+    GETBLOCKS = 4
+    BLOCKS = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class Hello:
+    genesis_hash: bytes
+    tip_height: int
+    listen_port: int
+
+
+def encode_hello(h: Hello) -> bytes:
+    return bytes([MsgType.HELLO]) + _HELLO.pack(
+        h.genesis_hash, h.tip_height, h.listen_port
+    )
+
+
+def encode_block(block: Block) -> bytes:
+    return bytes([MsgType.BLOCK]) + block.serialize()
+
+
+def encode_tx(tx: Transaction) -> bytes:
+    return bytes([MsgType.TX]) + tx.serialize()
+
+
+def encode_getblocks(locator: list[bytes]) -> bytes:
+    if len(locator) > 0xFFFF:
+        raise ValueError("locator too long")
+    return (
+        bytes([MsgType.GETBLOCKS])
+        + struct.pack(">H", len(locator))
+        + b"".join(locator)
+    )
+
+
+def encode_blocks(blocks: list[Block]) -> bytes:
+    parts = [bytes([MsgType.BLOCKS]), struct.pack(">H", len(blocks))]
+    for block in blocks:
+        raw = block.serialize()
+        parts.append(_LEN.pack(len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode(payload: bytes):
+    """(MsgType, decoded body) for one frame payload; raises ValueError on
+    malformed input — the peer loop treats that as a protocol violation."""
+    if not payload:
+        raise ValueError("empty frame")
+    try:
+        mtype = MsgType(payload[0])
+    except ValueError as e:
+        raise ValueError(f"unknown message type {payload[0]}") from e
+    body = payload[1:]
+    if mtype is MsgType.HELLO:
+        if len(body) != _HELLO.size:
+            raise ValueError("bad HELLO size")
+        return mtype, Hello(*_HELLO.unpack(body))
+    if mtype is MsgType.BLOCK:
+        return mtype, Block.deserialize(body)
+    if mtype is MsgType.TX:
+        return mtype, Transaction.deserialize(body)
+    if mtype is MsgType.GETBLOCKS:
+        if len(body) < 2:
+            raise ValueError("bad GETBLOCKS")
+        (n,) = struct.unpack_from(">H", body)
+        if len(body) != 2 + 32 * n:
+            raise ValueError("bad GETBLOCKS size")
+        return mtype, [body[2 + 32 * i : 2 + 32 * (i + 1)] for i in range(n)]
+    if mtype is MsgType.BLOCKS:
+        if len(body) < 2:
+            raise ValueError("bad BLOCKS")
+        (n,) = struct.unpack_from(">H", body)
+        off = 2
+        blocks = []
+        for _ in range(n):
+            if len(body) < off + _LEN.size:
+                raise ValueError("truncated BLOCKS")
+            (blen,) = _LEN.unpack_from(body, off)
+            off += _LEN.size
+            if len(body) < off + blen:
+                raise ValueError("truncated BLOCKS entry")
+            blocks.append(Block.deserialize(body[off : off + blen]))
+            off += blen
+        if off != len(body):
+            raise ValueError("trailing bytes in BLOCKS")
+        return mtype, blocks
+    raise AssertionError(mtype)
+
+
+async def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)) + payload)
+    await writer.drain()
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    header = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds cap")
+    return await reader.readexactly(n)

@@ -142,16 +142,44 @@ class TestForkChoice:
         chain = Chain(DIFF, genesis=main[0])
         for block in main[1:]:
             chain.add_block(block)
-        # feed the 5-block fork; tip must flip when it passes 3
-        for block in fork[1:4]:
-            res = chain.add_block(block)
-            assert not res.tip_changed  # 1,2,3 tie or trail: first-seen holds
+        # Strictly lighter fork blocks never move the tip.
+        for block in fork[1:3]:
+            assert not chain.add_block(block).tip_changed
+        # Equal work at height 3: deterministic tie-break by smaller hash.
+        chain.add_block(fork[3])
+        expected_at_tie = min(main[3], fork[3], key=lambda b: b.block_hash())
+        assert chain.tip == expected_at_tie
+        # fork[4] is strictly heavier: tip must be fork[4] on every node.
         res = chain.add_block(fork[4])
-        assert res.tip_changed
-        assert res.removed == tuple(reversed(main[1:]))
-        assert res.added == tuple(fork[1:5])
         assert chain.tip == fork[4]
         assert chain.height == 4
+        if expected_at_tie is main[3]:  # the reorg happened just now
+            assert res.removed == tuple(reversed(main[1:]))
+            assert res.added == tuple(fork[1:5])
+
+    def test_equal_work_tiebreak_is_order_independent(self, chain_blocks):
+        # Two nodes seeing the same blocks in different orders must agree.
+        main, fork = chain_blocks
+        a = Chain(DIFF, genesis=main[0])
+        b = Chain(DIFF, genesis=main[0])
+        blocks = main[1:4] + fork[1:4]
+        for block in blocks:
+            a.add_block(block)
+        for block in reversed(blocks):
+            b.add_block(block)
+        assert a.tip_hash == b.tip_hash
+
+    def test_connected_reports_cascaded_orphans(self, chain_blocks):
+        # Persistence appends res.connected; it must include orphans the
+        # triggering block unblocked, or restarts lose the chain suffix.
+        main, _ = chain_blocks
+        chain = Chain(DIFF, genesis=main[0])
+        chain.add_block(main[2])  # orphan
+        chain.add_block(main[3])  # orphan
+        res = chain.add_block(main[1])
+        assert res.connected == (main[1], main[2], main[3])
+        plain = chain.add_block(_mine_child(main[3], ts_offset=99))
+        assert len(plain.connected) == 1
 
     def test_orphan_then_connect(self, chain_blocks):
         main, _ = chain_blocks

@@ -1,0 +1,61 @@
+"""CLI: each benchmark config shape runs from one command (SURVEY §7.7)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def _run(*argv, timeout=110):
+    proc = subprocess.run(
+        [sys.executable, "-m", "p1_tpu", *argv],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+class TestCli:
+    def test_mine_config1(self):
+        out = _run("mine", "--difficulty", "10", "--blocks", "3", "--backend", "cpu")
+        assert out["blocks"] == 3
+        assert out["hashes_per_sec"] > 0
+        assert out["time_to_block_s"] >= 0
+
+    def test_replay_config3(self):
+        out = _run(
+            "replay", "--n", "64", "--difficulty", "8", "--method", "host"
+        )
+        assert out["valid"] and out["n_headers"] == 64
+
+    def test_net_config4_smoke(self):
+        out = _run(
+            "net",
+            "--nodes",
+            "2",
+            "--difficulty",
+            "12",
+            "--duration",
+            "2",
+            "--chunk",
+            "16384",
+            "--base-port",
+            "29444",
+        )
+        assert out["converged"], out
+        assert out["height"] >= 1
+
+    def test_unknown_backend_fails_cleanly(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "mine", "--backend", "nope"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            cwd="/root/repo",
+        )
+        assert proc.returncode != 0
+        assert "nope" in proc.stderr

@@ -1,0 +1,255 @@
+"""P2P node: handshake, gossip, sync, convergence, faults, restart.
+
+In-process asyncio harness: each test spins real Nodes on ephemeral
+localhost ports (the standard localhost form of the reference's 4-peer
+distributed config, BASELINE.json:10) and polls for convergence with a
+deadline.  Difficulty 12 keeps cpu mining at a few ms/block.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from p1_tpu.config import NodeConfig
+from p1_tpu.core import Transaction
+from p1_tpu.node import Node
+
+DIFF = 12
+CHUNK = 1 << 14  # fine-grained abort so stop() never waits long
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def wait_until(cond, timeout=20.0, interval=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+def _config(peers=(), **kw) -> NodeConfig:
+    kw.setdefault("difficulty", DIFF)
+    kw.setdefault("chunk", CHUNK)
+    kw.setdefault("mine", False)
+    return NodeConfig(peers=tuple(peers), **kw)
+
+
+async def start_mesh(n: int, **kw) -> list[Node]:
+    """n nodes, each dialing all earlier ones (full mesh)."""
+    nodes: list[Node] = []
+    for _ in range(n):
+        peers = [f"127.0.0.1:{node.port}" for node in nodes]
+        node = Node(_config(peers=peers, **kw))
+        await node.start()
+        nodes.append(node)
+    return nodes
+
+
+async def stop_all(nodes) -> None:
+    for node in nodes:
+        await node.stop()
+
+
+class TestHandshake:
+    def test_two_nodes_connect(self):
+        async def scenario():
+            nodes = await start_mesh(2)
+            try:
+                assert await wait_until(
+                    lambda: all(n.peer_count() >= 1 for n in nodes)
+                )
+            finally:
+                await stop_all(nodes)
+
+        run(scenario())
+
+    def test_genesis_mismatch_rejected(self):
+        async def scenario():
+            a = Node(_config(difficulty=12))
+            await a.start()
+            b = Node(_config(difficulty=13, peers=[f"127.0.0.1:{a.port}"]))
+            await b.start()
+            try:
+                await asyncio.sleep(0.3)
+                assert a.peer_count() == 0
+                assert b.peer_count() == 0
+            finally:
+                await stop_all([a, b])
+
+        run(scenario())
+
+
+class TestGossip:
+    def test_tx_propagates_transitively(self):
+        async def scenario():
+            # Chain topology a <- b <- c: a tx injected at a must reach c.
+            a = Node(_config())
+            await a.start()
+            b = Node(_config(peers=[f"127.0.0.1:{a.port}"]))
+            await b.start()
+            c = Node(_config(peers=[f"127.0.0.1:{b.port}"]))
+            await c.start()
+            try:
+                assert await wait_until(
+                    lambda: a.peer_count() and c.peer_count()
+                )
+                tx = Transaction("alice", "bob", 5, 1, 0)
+                await a.submit_tx(tx)
+                assert await wait_until(lambda: tx.txid() in c.mempool)
+                assert tx.txid() in b.mempool
+            finally:
+                await stop_all([a, b, c])
+
+        run(scenario())
+
+    def test_mined_blocks_propagate(self):
+        async def scenario():
+            nodes = await start_mesh(2)
+            miner_node = nodes[0]
+            try:
+                assert await wait_until(lambda: miner_node.peer_count())
+                tx = Transaction("alice", "bob", 5, 1, 0)
+                await nodes[1].submit_tx(tx)
+                await wait_until(lambda: tx.txid() in miner_node.mempool)
+                miner_node.start_mining()  # mine exactly on node 0
+                assert await wait_until(lambda: nodes[1].chain.height >= 3)
+                await miner_node.stop_mining()
+                assert await wait_until(
+                    lambda: nodes[1].chain.tip_hash == miner_node.chain.tip_hash
+                )
+                # the mined tx landed in a block and left both mempools
+                assert tx.txid() not in miner_node.mempool
+                assert tx.txid() not in nodes[1].mempool
+            finally:
+                await stop_all(nodes)
+
+        run(scenario())
+
+
+class TestConvergence:
+    def test_four_miners_converge(self):
+        async def scenario():
+            nodes = await start_mesh(4, mine=True)
+            try:
+                assert await wait_until(
+                    lambda: min(n.chain.height for n in nodes) >= 3
+                )
+                for node in nodes:
+                    await node.stop_mining()
+                assert await wait_until(
+                    lambda: len({n.chain.tip_hash for n in nodes}) == 1,
+                    timeout=10,
+                ), {n.port: (n.chain.height, n.chain.tip_hash.hex()[:8]) for n in nodes}
+                heights = {n.chain.height for n in nodes}
+                assert len(heights) == 1 and heights.pop() >= 3
+            finally:
+                await stop_all(nodes)
+
+        run(scenario())
+
+    def test_late_joiner_syncs(self):
+        async def scenario():
+            a = Node(_config(mine=True))
+            await a.start()
+            try:
+                assert await wait_until(lambda: a.chain.height >= 5)
+                await a.stop_mining()
+                b = Node(_config(peers=[f"127.0.0.1:{a.port}"]))
+                await b.start()
+                try:
+                    assert await wait_until(
+                        lambda: b.chain.tip_hash == a.chain.tip_hash
+                    )
+                    assert b.chain.height == a.chain.height
+                finally:
+                    await b.stop()
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+    def test_peer_death_and_recovery(self):
+        async def scenario():
+            nodes = await start_mesh(3, mine=True)
+            victim = nodes[2]
+            try:
+                assert await wait_until(
+                    lambda: min(n.chain.height for n in nodes) >= 2
+                )
+                await victim.stop()  # kill one peer mid-mine
+                survivors = nodes[:2]
+                h = max(n.chain.height for n in survivors)
+                assert await wait_until(
+                    lambda: min(n.chain.height for n in survivors) >= h + 2
+                )
+                for node in survivors:
+                    await node.stop_mining()
+                assert await wait_until(
+                    lambda: len({n.chain.tip_hash for n in survivors}) == 1
+                )
+            finally:
+                await stop_all(nodes[:2])
+
+        run(scenario())
+
+
+class TestRestart:
+    def test_restart_resumes_and_catches_up(self, tmp_path):
+        async def scenario():
+            store = tmp_path / "node_a.dat"
+            a = Node(_config(mine=True, store_path=str(store)))
+            await a.start()
+            try:
+                assert await wait_until(lambda: a.chain.height >= 3)
+            finally:
+                await a.stop()
+            saved_height, saved_tip = a.chain.height, a.chain.tip_hash
+
+            # Restart from the store: chain state must come back.
+            a2 = Node(_config(store_path=str(store)))
+            await a2.start()
+            try:
+                assert a2.chain.height == saved_height
+                assert a2.chain.tip_hash == saved_tip
+            finally:
+                await a2.stop()
+
+        run(scenario())
+
+
+class TestMempoolUnit:
+    def test_fee_priority_and_dedup(self):
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        cheap = Transaction("a", "b", 1, 1, 0)
+        rich = Transaction("c", "d", 1, 9, 0)
+        assert pool.add(cheap) and pool.add(rich)
+        assert not pool.add(cheap)  # dedup
+        assert pool.select() == [rich, cheap]
+
+    def test_block_delta_and_resurrection(self):
+        from p1_tpu.core import Block, BlockHeader, merkle_root
+        from p1_tpu.mempool import Mempool
+
+        def block_with(txs):
+            header = BlockHeader(
+                1, bytes(32), merkle_root([t.txid() for t in txs]), 1, DIFF, 0
+            )
+            return Block(header, tuple(txs))
+
+        pool = Mempool()
+        t1 = Transaction("a", "b", 1, 1, 0)
+        t2 = Transaction("c", "d", 2, 2, 0)
+        pool.add(t1)
+        pool.add(t2)
+        pool.apply_block_delta((), (block_with([t1]),))
+        assert t1.txid() not in pool and t2.txid() in pool
+        # reorg abandons the t1 block: t1 comes back
+        pool.apply_block_delta((block_with([t1]),), (block_with([t2]),))
+        assert t1.txid() in pool and t2.txid() not in pool
