@@ -24,8 +24,10 @@ class NodeConfig:
     chunk: int | None = None  # miner abort granularity (nonces per call)
 
     def peer_addrs(self) -> list[tuple[str, int]]:
+        # A bare "host:port" string would otherwise iterate character-wise.
+        peers = (self.peers,) if isinstance(self.peers, str) else self.peers
         out = []
-        for peer in self.peers:
+        for peer in peers:
             host, _, port = peer.rpartition(":")
             out.append((host or "127.0.0.1", int(port)))
         return out

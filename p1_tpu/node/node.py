@@ -96,6 +96,7 @@ class Node:
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
         self._abort = None  # threading.Event of the in-flight search
         self._mine_task: asyncio.Task | None = None
+        self._post_seal: asyncio.Task | None = None  # shielded seal handling
         self._running = False
         self.port: int | None = None  # bound listen port (after start)
 
@@ -103,11 +104,10 @@ class Node:
 
     async def start(self) -> None:
         if self.store is not None:
-            restored = self.store.load_chain(self.config.difficulty)
-            # Re-adding through a fresh chain keeps validation authoritative.
-            for block in restored.main_chain():
-                if block.block_hash() != self.chain.genesis.block_hash():
-                    self.chain.add_block(block)
+            # load_chain already routes every record through full add_block
+            # validation, and keeps persisted side branches alive (store.py)
+            # — adopt it wholesale instead of re-validating main_chain only.
+            self.chain = self.store.load_chain(self.config.difficulty)
             if self.chain.height:
                 log.info(
                     "resumed chain height=%d tip=%s",
@@ -127,7 +127,10 @@ class Node:
 
     async def stop(self) -> None:
         self._running = False
-        self._abort_inflight_search()
+        # Stop the miner FIRST: stop_mining awaits the shielded final-block
+        # handling while peer sessions are still alive, so a block sealed in
+        # the last instant reaches peers before any connection is torn down.
+        await self.stop_mining()
         # Cancel inbound session handlers along with our own tasks BEFORE
         # waiting on the server: Python 3.12's Server.wait_closed() blocks
         # until every connection handler returns, and handlers sit in
@@ -165,6 +168,25 @@ class Node:
             if self._mine_task in self._tasks:
                 self._tasks.remove(self._mine_task)
             self._mine_task = None
+        # A block sealed in the final instant enters the chain and reaches
+        # peers only once the shielded _post_seal task runs to completion —
+        # cancelling the mine loop does not cancel it, and awaiting it here
+        # is what guarantees callers observe a fully-propagated stop.
+        await self._await_post_seal()
+
+    async def _await_post_seal(self) -> None:
+        if self._post_seal is not None:
+            results = await asyncio.gather(
+                self._post_seal, return_exceptions=True
+            )
+            self._post_seal = None
+            for r in results:
+                if isinstance(r, BaseException) and not isinstance(
+                    r, asyncio.CancelledError
+                ):
+                    # Nothing else can surface a failure on this path (the
+                    # mine loop is already gone) — don't lose it.
+                    log.error("post-seal block handling failed: %r", r)
 
     # -- p2p ------------------------------------------------------------
 
@@ -389,7 +411,18 @@ class Node:
                 self.metrics.last_block_time_s,
                 stats.hashes_per_sec,
             )
-            await self._handle_block(block, origin=None)
+            # Shield the post-seal handling.  add_block + gossip happen
+            # inside the _post_seal task, which cancellation of THIS loop
+            # cannot kill; the guarantee that the sealed block lands in the
+            # chain and reaches peers comes from stop_mining()/stop()
+            # awaiting _post_seal, NOT from any ordering within this loop.
+            # Without the shield, cancellation between add_block and the
+            # gossip send strands the miner one block ahead forever.
+            self._post_seal = asyncio.create_task(
+                self._handle_block(block, origin=None)
+            )
+            await asyncio.shield(self._post_seal)
+            self._post_seal = None
             await asyncio.sleep(0)  # let gossip/tx handlers breathe
 
     # -- introspection ---------------------------------------------------
