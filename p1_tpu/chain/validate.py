@@ -35,5 +35,11 @@ def check_block(block: Block, expected_difficulty: int, *, is_genesis: bool = Fa
     txids = [tx.txid() for tx in block.txs]
     if len(set(txids)) != len(txids):
         raise ValidationError("duplicate txid in block")
+    # A coinbase (block-reward tx) is optional, but if present it must be
+    # the first transaction and unique — any coinbase at index > 0 covers
+    # both the misplaced and the duplicate case.
+    for i, tx in enumerate(block.txs):
+        if i > 0 and tx.is_coinbase:
+            raise ValidationError("coinbase transaction must be first and unique")
     if block.compute_merkle_root() != header.merkle_root:
         raise ValidationError("merkle root mismatch")

@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=9444)
     p.add_argument("--peers", nargs="*", default=[], help="host:port ...")
     p.add_argument("--no-mine", action="store_true")
+    p.add_argument(
+        "--miner-id",
+        default=None,
+        help="coinbase recipient id (default: random per process)",
+    )
     p.add_argument("--store", default=None, help="chain persistence path")
     p.add_argument("--duration", type=float, default=None, help="exit after N s")
     p.add_argument(
@@ -206,6 +211,7 @@ async def _run_node(args) -> int:
         store_path=args.store,
         batch=args.batch,
         chunk=args.chunk,
+        miner_id=args.miner_id,
     )
     node = Node(config)
     await node.start()
@@ -283,6 +289,8 @@ def cmd_net(args) -> int:
             args.backend,
             "--deadline",
             "stdin",
+            "--miner-id",
+            f"node{i}",
         ]
         if args.chunk:
             cmd += ["--chunk", str(args.chunk)]

@@ -22,6 +22,9 @@ class NodeConfig:
     max_block_txs: int = 1000
     batch: int | None = None  # device batch override for jax/sharded
     chunk: int | None = None  # miner abort granularity (nonces per call)
+    #: Coinbase recipient id.  None = a random per-process id, which is what
+    #: makes two independent miners produce *different* candidate blocks.
+    miner_id: str | None = None
 
     def peer_addrs(self) -> list[tuple[str, int]]:
         # A bare "host:port" string would otherwise iterate character-wise.

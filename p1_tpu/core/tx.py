@@ -15,6 +15,15 @@ import struct
 
 _MAX_ID_LEN = 255
 
+#: Reserved sender id marking a block-reward (coinbase) transaction.  A
+#: coinbase is what gives each miner's candidate block a distinct identity:
+#: recipient = the miner's id, seq = the block height, so two miners working
+#: on the same tip produce different merkle roots and therefore different
+#: headers — concurrent mining yields genuinely competing blocks instead of
+#: every node re-deriving the identical one.
+COINBASE_SENDER = "coinbase"
+BLOCK_REWARD = 50
+
 
 @dataclasses.dataclass(frozen=True)
 class Transaction:
@@ -78,3 +87,18 @@ class Transaction:
         from p1_tpu.core.hashutil import sha256d
 
         return sha256d(self.serialize())
+
+    @property
+    def is_coinbase(self) -> bool:
+        return self.sender == COINBASE_SENDER
+
+    @classmethod
+    def coinbase(
+        cls, miner_id: str, height: int, reward: int = BLOCK_REWARD
+    ) -> "Transaction":
+        """The block-reward transaction for ``miner_id`` at ``height``.
+
+        seq = height makes the coinbase (and with it the merkle root) unique
+        per height even for the same miner; miner_id distinguishes miners.
+        """
+        return cls(COINBASE_SENDER, miner_id, reward, 0, height)

@@ -27,7 +27,15 @@ class Mempool:
         return txid in self._txs
 
     def add(self, tx: Transaction) -> bool:
-        """Admit ``tx``; False if already known or the pool is full."""
+        """Admit ``tx``; False if coinbase, already known, or the pool is full.
+
+        Coinbases never belong in a mempool: they are minted per block by
+        the assembling miner, so a gossiped one is invalid and a reorg's
+        resurrection path (``apply_block_delta``) must drop the abandoned
+        branch's rewards rather than re-mine them into the new branch.
+        """
+        if tx.is_coinbase:
+            return False
         txid = tx.txid()
         if txid in self._txs or len(self._txs) >= self.max_txs:
             return False

@@ -76,7 +76,13 @@ class Node:
     """One blockchain node: chain + mempool + p2p + (optionally) a miner."""
 
     def __init__(self, config: NodeConfig, miner: Miner | None = None):
+        import secrets
+
         self.config = config
+        #: Coinbase identity: distinct per node unless pinned by config, so
+        #: concurrent miners assemble *different* candidate blocks and the
+        #: fork-choice machinery is actually exercised at network level.
+        self.miner_id = config.miner_id or f"m-{secrets.token_hex(4)}"
         self.chain = Chain(config.difficulty)
         self.mempool = Mempool()
         self.metrics = NodeMetrics()
@@ -165,6 +171,11 @@ class Node:
                 await self._mine_task
             except asyncio.CancelledError:
                 pass
+            except Exception:
+                # A mine loop that already died of its own exception re-raises
+                # it here; stop()/stop_mining() must still run the rest of
+                # teardown (sessions, server socket, store).
+                log.exception("mine task ended with error")
             if self._mine_task in self._tasks:
                 self._tasks.remove(self._mine_task)
             self._mine_task = None
@@ -361,7 +372,11 @@ class Node:
 
     def _assemble(self) -> Block:
         tip = self.chain.tip
-        txs = tuple(self.mempool.select(self.config.max_block_txs))
+        coinbase = Transaction.coinbase(self.miner_id, self.chain.height + 1)
+        txs = (
+            coinbase,
+            *self.mempool.select(max(0, self.config.max_block_txs - 1)),
+        )
         header = BlockHeader(
             version=1,
             prev_hash=tip.block_hash(),
@@ -379,8 +394,8 @@ class Node:
             raise
         except Exception:
             # A silently dead miner looks like a healthy idle node; make
-            # the failure loud (stop() retrieves the task with
-            # return_exceptions=True, so nothing else would surface it).
+            # the failure loud here — stop_mining() swallows (logs) the
+            # re-raise so teardown still completes.
             log.exception("mining loop died")
             raise
 

@@ -99,6 +99,28 @@ class TestValidate:
     def test_genesis_pow_waived(self):
         check_block(make_genesis(DIFF), DIFF, is_genesis=True)
 
+    def test_coinbase_first_ok(self):
+        genesis = make_genesis(DIFF)
+        cb = Transaction.coinbase("miner-a", 1)
+        tx = Transaction("a", "b", 1, 0, 0)
+        check_block(_mine_child(genesis, txs=(cb, tx)), DIFF)
+
+    def test_coinbase_not_first_rejected(self):
+        genesis = make_genesis(DIFF)
+        cb = Transaction.coinbase("miner-a", 1)
+        tx = Transaction("a", "b", 1, 0, 0)
+        block = _mine_child(genesis, txs=(tx, cb))
+        with pytest.raises(ValidationError, match="coinbase"):
+            check_block(block, DIFF)
+
+    def test_two_coinbases_rejected(self):
+        genesis = make_genesis(DIFF)
+        cb1 = Transaction.coinbase("miner-a", 1)
+        cb2 = Transaction.coinbase("miner-b", 1)
+        block = _mine_child(genesis, txs=(cb1, cb2))
+        with pytest.raises(ValidationError, match="coinbase"):
+            check_block(block, DIFF)
+
 
 class TestForkChoice:
     def test_linear_growth(self, chain_blocks):

@@ -131,6 +131,71 @@ class TestGossip:
         run(scenario())
 
 
+class TestMinerIdentity:
+    def test_unpeered_miners_diverge(self):
+        """Round-2 judge experiment, inverted: two *unconnected* nodes must
+        mine different chains (each block carries the miner's coinbase, so
+        candidates differ from height 1 on).  Before coinbases every node
+        assembled bit-identical blocks and 'convergence' was degenerate."""
+
+        async def scenario():
+            a = Node(_config(mine=True))
+            b = Node(_config(mine=True))
+            await a.start()
+            await b.start()
+            try:
+                assert await wait_until(
+                    lambda: a.chain.height >= 3 and b.chain.height >= 3
+                )
+                await a.stop_mining()
+                await b.stop_mining()
+                a_hashes = [blk.block_hash() for blk in a.chain.main_chain()]
+                b_hashes = [blk.block_hash() for blk in b.chain.main_chain()]
+                # Same genesis, nothing else in common.
+                assert a_hashes[0] == b_hashes[0]
+                overlap = set(a_hashes[1:4]) & set(b_hashes[1:4])
+                assert not overlap, f"identical blocks mined: {overlap}"
+            finally:
+                await stop_all([a, b])
+
+        run(scenario())
+
+    def test_fork_resolves_with_reorg(self):
+        """Deterministic network-level reorg: A mines a short private chain,
+        B a longer one; when A first hears of B's chain it must abandon its
+        own branch (metrics.reorgs goes up) and adopt B's tip."""
+
+        async def scenario():
+            a = Node(_config(mine=True, miner_id="alice"))
+            b = Node(_config(mine=True, miner_id="bob"))
+            await a.start()
+            await b.start()
+            try:
+                assert await wait_until(lambda: a.chain.height >= 2)
+                await a.stop_mining()
+                height_a = a.chain.height
+                assert await wait_until(lambda: b.chain.height >= height_a + 2)
+                await b.stop_mining()
+                assert a.chain.tip_hash != b.chain.tip_hash
+                # Now connect them: a dials b and syncs.
+                c = Node(
+                    _config(peers=[f"127.0.0.1:{b.port}"]), miner=a.miner
+                )
+                c.chain = a.chain  # adopt A's private chain wholesale
+                await c.start()
+                try:
+                    assert await wait_until(
+                        lambda: c.chain.tip_hash == b.chain.tip_hash
+                    )
+                    assert c.metrics.reorgs >= 1, "fork resolved without a reorg"
+                finally:
+                    await c.stop()
+            finally:
+                await stop_all([a, b])
+
+        run(scenario())
+
+
 class TestConvergence:
     def test_four_miners_converge(self):
         async def scenario():
@@ -232,6 +297,19 @@ class TestMempoolUnit:
         assert pool.add(cheap) and pool.add(rich)
         assert not pool.add(cheap)  # dedup
         assert pool.select() == [rich, cheap]
+
+    def test_coinbase_never_enters_pool(self):
+        from p1_tpu.core.block import Block, merkle_root
+        from p1_tpu.core.header import BlockHeader
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        cb = Transaction.coinbase("miner-a", 7)
+        assert not pool.add(cb)  # gossiped coinbase refused
+        # reorg resurrection drops the abandoned branch's reward too
+        header = BlockHeader(1, bytes(32), merkle_root([cb.txid()]), 1, DIFF, 0)
+        pool.apply_block_delta((Block(header, (cb,)),), ())
+        assert cb.txid() not in pool
 
     def test_block_delta_and_resurrection(self):
         from p1_tpu.core import Block, BlockHeader, merkle_root
