@@ -15,7 +15,11 @@ paths" and "longest-chain" resolution on the gossip network
   equal-work first-seen tips.
 - Blocks whose parent is unknown wait in an **orphan pool** keyed by
   prev-hash (gossip delivers out of order); connecting a parent drains its
-  orphans recursively.
+  orphans recursively.  The pool is hostile-input-safe: a block must pass
+  full stateless validation (including its own PoW) *before* parking, the
+  pool is capped at ``MAX_ORPHANS`` with FIFO eviction, and re-received
+  orphans are not double-parked — so a buggy or malicious peer cannot grow
+  node memory without bound by flooding unconnectable blocks.
 - ``add_block`` reports what happened — including the reorg's removed/added
   block lists so the mempool can resurrect transactions from abandoned
   blocks and the miner knows to abort a stale search.
@@ -23,6 +27,7 @@ paths" and "longest-chain" resolution on the gossip network
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
 from typing import Iterator
@@ -30,6 +35,12 @@ from typing import Iterator
 from p1_tpu.core.block import Block
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.chain.validate import ValidationError, check_block
+
+
+#: Orphan-pool capacity.  Orphans exist only to absorb out-of-order gossip,
+#: which a locator sync backfills within one round trip — a few hundred is
+#: plenty, and the cap is what bounds memory against a flooding peer.
+MAX_ORPHANS = 256
 
 
 class AddStatus(enum.Enum):
@@ -77,7 +88,16 @@ class Chain:
             ghash: _Entry(self.genesis, 0, 1 << difficulty)
         }
         self._tip_hash = ghash
+        #: Main-chain hashes by height (``_main_hashes[h]`` is the height-h
+        #: block).  Kept in sync on every tip move so sync serving
+        #: (``blocks_after``) and ``_on_main_chain`` are O(1) per block
+        #: instead of re-walking the whole chain per request.
+        self._main_hashes: list[bytes] = [ghash]
         self._orphans: dict[bytes, list[Block]] = {}  # prev_hash -> waiting blocks
+        self._orphan_hashes: set[bytes] = set()  # parked block hashes (dedup)
+        self._orphan_fifo: collections.deque[tuple[bytes, bytes]] = (
+            collections.deque()
+        )  # (prev_hash, block_hash) in arrival order, for FIFO eviction
 
     # -- queries ---------------------------------------------------------
 
@@ -108,36 +128,40 @@ class Chain:
 
     def main_chain(self) -> Iterator[Block]:
         """Genesis-first iteration of the current best chain."""
-        path = list(self._walk_back(self._tip_hash))
-        yield from reversed(path)
+        for h in self._main_hashes:
+            yield self._index[h].block
 
     def locator(self, dense: int = 10) -> list[bytes]:
         """Hashes from tip back to genesis: the last ``dense`` blocks one by
         one, then exponentially spaced — the classic sync locator shape."""
         out = []
-        h = self._tip_hash
+        height = len(self._main_hashes) - 1
         step = 1
         while True:
-            out.append(h)
-            if self._index[h].height == 0:
+            out.append(self._main_hashes[height])
+            if height == 0:
                 return out
             if len(out) >= dense:
                 step *= 2
-            for _ in range(step):
-                if self._index[h].height == 0:
-                    break
-                h = self._index[h].block.header.prev_hash
+            height = max(0, height - step)
 
     def blocks_after(self, locator: list[bytes], limit: int = 500) -> list[Block]:
-        """Main-chain blocks after the first locator hash we recognize."""
+        """Main-chain blocks after the first locator hash we recognize.
+
+        O(limit) per call: served straight from the height index instead of
+        materializing the whole main chain (which made a full peer sync
+        O(height²/batch))."""
         start_height = 0
         for h in locator:
             entry = self._index.get(h)
             if entry and self._on_main_chain(h):
                 start_height = entry.height + 1
                 break
-        main = list(self.main_chain())
-        return main[start_height : start_height + limit]
+        end = min(start_height + limit, len(self._main_hashes))
+        return [
+            self._index[self._main_hashes[i]].block
+            for i in range(start_height, end)
+        ]
 
     # -- mutation --------------------------------------------------------
 
@@ -159,15 +183,28 @@ class Chain:
         pending = [block.block_hash()]
         while pending:
             for orphan in self._orphans.pop(pending.pop(), []):
-                st, _ = self._insert(orphan)
+                self._orphan_hashes.discard(orphan.block_hash())
+                # Orphans were fully validated when parked; only linkage
+                # (now satisfied) was missing — don't re-hash the block.
+                st, _ = self._insert(orphan, prevalidated=True)
                 if st is AddStatus.ACCEPTED:
                     connected.append(orphan)
                     pending.append(orphan.block_hash())
+        # Connected orphans leave _orphans/_orphan_hashes but their FIFO
+        # entries linger; compact once the stale fraction dominates so the
+        # deque stays O(MAX_ORPHANS) over the node's lifetime.
+        if len(self._orphan_fifo) > 2 * MAX_ORPHANS:
+            self._orphan_fifo = collections.deque(
+                e for e in self._orphan_fifo if e[1] in self._orphan_hashes
+            )
 
         removed: tuple[Block, ...] = ()
         added: tuple[Block, ...] = ()
         if self._tip_hash != old_tip:
             removed, added = self._reorg_paths(old_tip, self._tip_hash)
+            if removed:
+                del self._main_hashes[len(self._main_hashes) - len(removed) :]
+            self._main_hashes.extend(b.block_hash() for b in added)
         return AddResult(
             AddStatus.ACCEPTED,
             removed=removed,
@@ -175,19 +212,21 @@ class Chain:
             connected=tuple(connected),
         )
 
-    def _insert(self, block: Block) -> tuple[AddStatus, str]:
+    def _insert(
+        self, block: Block, prevalidated: bool = False
+    ) -> tuple[AddStatus, str]:
         """Validate + index one block and advance the tip by work."""
         bhash = block.block_hash()
         if bhash in self._index:
             return AddStatus.DUPLICATE, ""
         prev = self._index.get(block.header.prev_hash)
         if prev is None:
-            self._orphans.setdefault(block.header.prev_hash, []).append(block)
-            return AddStatus.ORPHAN, ""
-        try:
-            check_block(block, self.difficulty)
-        except ValidationError as e:
-            return AddStatus.REJECTED, str(e)
+            return self._park_orphan(block, bhash)
+        if not prevalidated:
+            try:
+                check_block(block, self.difficulty)
+            except ValidationError as e:
+                return AddStatus.REJECTED, str(e)
         entry = _Entry(
             block, prev.height + 1, prev.work + (1 << block.header.difficulty)
         )
@@ -201,28 +240,47 @@ class Chain:
 
     # -- internals -------------------------------------------------------
 
-    def _walk_back(self, block_hash: bytes) -> Iterator[Block]:
-        """Tip-first walk to genesis."""
-        h = block_hash
-        while True:
-            entry = self._index[h]
-            yield entry.block
-            if entry.height == 0:
-                return
-            h = entry.block.header.prev_hash
+    def _park_orphan(self, block: Block, bhash: bytes) -> tuple[AddStatus, str]:
+        """Hold a parentless block until its parent arrives — safely.
+
+        The block must carry its own valid PoW (full stateless validation)
+        before it costs us memory, and the pool is FIFO-capped: unconnectable
+        junk from a hostile peer evicts, it does not accumulate.
+        """
+        if bhash in self._orphan_hashes:
+            return AddStatus.ORPHAN, "already parked"
+        try:
+            check_block(block, self.difficulty)
+        except ValidationError as e:
+            return AddStatus.REJECTED, str(e)
+        self._orphans.setdefault(block.header.prev_hash, []).append(block)
+        self._orphan_hashes.add(bhash)
+        self._orphan_fifo.append((block.header.prev_hash, bhash))
+        while len(self._orphan_hashes) > MAX_ORPHANS:
+            self._evict_oldest_orphan()
+        return AddStatus.ORPHAN, ""
+
+    def _evict_oldest_orphan(self) -> None:
+        while self._orphan_fifo:
+            prev_hash, bhash = self._orphan_fifo.popleft()
+            if bhash not in self._orphan_hashes:
+                continue  # stale entry: orphan was connected meanwhile
+            waiting = self._orphans.get(prev_hash, [])
+            for i, blk in enumerate(waiting):
+                if blk.block_hash() == bhash:
+                    waiting.pop(i)
+                    break
+            if not waiting:
+                self._orphans.pop(prev_hash, None)
+            self._orphan_hashes.discard(bhash)
+            return
 
     def _on_main_chain(self, block_hash: bytes) -> bool:
         entry = self._index[block_hash]
-        h = self._tip_hash
-        while True:
-            cur = self._index[h]
-            if cur.height < entry.height:
-                return False
-            if h == block_hash:
-                return True
-            if cur.height == 0:
-                return False
-            h = cur.block.header.prev_hash
+        return (
+            entry.height < len(self._main_hashes)
+            and self._main_hashes[entry.height] == block_hash
+        )
 
     def _reorg_paths(
         self, old_tip: bytes, new_tip: bytes

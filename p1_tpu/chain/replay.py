@@ -101,9 +101,10 @@ def replay_device(
     Each segment checks PoW for all its headers and linkage both within the
     segment and across the segment boundary (via the previous segment's
     last digest, recomputed on host — one hash per 4096).  The final short
-    segment is padded with copies of its last header; padding lanes are
-    linked+valid by construction except pad lane 0's PoW, so invalid
-    indices past the real length are clamped off on host.
+    segment is padded with copies of its last header; every pad lane FAILS
+    linkage (a copied header's prev_hash never equals the preceding copy's
+    digest), intentionally: the ``idx < valid_len`` clamp on host is what
+    discards pad-lane failures, so do not "fix" the clamp away.
     """
     import jax.numpy as jnp
 

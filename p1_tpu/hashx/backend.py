@@ -83,6 +83,10 @@ def register(name: str) -> Callable[[type[HashBackend]], type[HashBackend]]:
             raise ValueError(f"hash backend {name!r} already registered")
         cls.name = name
         _REGISTRY[name] = cls
+        # A direct `import p1_tpu.hashx.<module>` fulfills the lazy entry
+        # without going through _resolve; drop it so the name isn't listed
+        # twice and _resolve never re-imports a loaded module.
+        _LAZY_BACKENDS.pop(name, None)
         return cls
 
     return deco
@@ -99,9 +103,10 @@ def _resolve(name: str) -> type[HashBackend]:
     if name in _REGISTRY:
         return _REGISTRY[name]
     if name in _LAZY_BACKENDS:
-        cls = _LAZY_BACKENDS[name]()  # pop only on success so a failed
-        del _LAZY_BACKENDS[name]  # import surfaces again on retry
-        # The loader's module is expected to @register(name) on import.
+        # The loader's module is expected to @register(name) on import,
+        # which also removes the lazy entry.  A failed import leaves the
+        # entry in place so the error surfaces again on retry.
+        _LAZY_BACKENDS[name]()
         if name not in _REGISTRY:
             raise RuntimeError(f"lazy loader for {name!r} did not register it")
         return _REGISTRY[name]

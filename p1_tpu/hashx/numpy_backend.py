@@ -3,8 +3,9 @@
 Role in the framework: the host-side *vectorized oracle*.  It shares the
 midstate formulation with the JAX/Pallas device kernels (one uint32 lane per
 candidate nonce, chunk-2 + second-pass compression only), so kernel tests can
-diff the two lane-by-lane, and it doubles as a much faster CPU miner than the
-hashlib loop for larger difficulties.
+diff the two lane-by-lane.  It is an *oracle*, not a fast miner: NumPy's
+per-op dispatch makes it measurably slower than the hashlib loop (~0.5 vs
+~0.8 MH/s) — use ``cpu`` when you want host hashrate.
 
 The layout mirrors what runs on the TPU VPU: every SHA-256 word is a vector
 of ``count`` uint32 lanes; rotations are shift/or pairs; the 64 rounds are an

@@ -122,6 +122,58 @@ class TestValidate:
             check_block(block, DIFF)
 
 
+class TestOrphanPool:
+    """A hostile peer flooding unconnectable blocks must not grow memory:
+    orphans need their own valid PoW to park, the pool is FIFO-capped, and
+    re-received orphans are not double-parked."""
+
+    def test_flood_is_bounded_and_chain_still_extends(self):
+        import os
+
+        from p1_tpu.chain.chain import MAX_ORPHANS
+
+        diff = 2  # ~4 hashes per orphan: 10k mined orphans stay cheap
+        chain = Chain(diff)
+        miner = Miner(backend=get_backend("cpu"))
+        for i in range(10_000):
+            header = BlockHeader(1, os.urandom(32), bytes(32), i + 1, diff, 0)
+            sealed = miner.search_nonce(header)
+            assert sealed is not None
+            res = chain.add_block(Block(sealed, ()))
+            assert res.status is AddStatus.ORPHAN
+        assert len(chain._orphan_hashes) <= MAX_ORPHANS
+        assert len(chain._orphan_fifo) <= MAX_ORPHANS
+        assert sum(len(v) for v in chain._orphans.values()) <= MAX_ORPHANS
+        # the chain is unharmed: a legitimate child still connects
+        child = _mine_child(chain.genesis)
+        assert chain.add_block(child).status is AddStatus.ACCEPTED
+        assert chain.height == 1
+
+    def test_orphan_without_pow_rejected_not_parked(self):
+        import os
+
+        chain = Chain(20)
+        header = BlockHeader(1, os.urandom(32), bytes(32), 1, 20, 0)
+        res = chain.add_block(Block(header, ()))  # nonce 0: no PoW at d20
+        assert res.status is AddStatus.REJECTED
+        assert not chain._orphan_hashes
+
+    def test_reparked_orphan_not_duplicated(self):
+        import os
+
+        diff = 2
+        chain = Chain(diff)
+        miner = Miner(backend=get_backend("cpu"))
+        header = BlockHeader(1, os.urandom(32), bytes(32), 1, diff, 0)
+        sealed = miner.search_nonce(header)
+        orphan = Block(sealed, ())
+        assert chain.add_block(orphan).status is AddStatus.ORPHAN
+        res = chain.add_block(orphan)
+        assert res.status is AddStatus.ORPHAN and res.reason == "already parked"
+        assert len(chain._orphan_hashes) == 1
+        assert sum(len(v) for v in chain._orphans.values()) == 1
+
+
 class TestForkChoice:
     def test_linear_growth(self, chain_blocks):
         main, _ = chain_blocks

@@ -67,6 +67,42 @@ class TestRegistry:
         with pytest.raises(KeyError):
             get_backend("definitely-not-a-backend")
 
+    def test_lazy_resolve_in_fresh_process(self):
+        # get_backend must work when the lazy loader (not a direct import)
+        # is what registers the backend — regression: @register popping the
+        # lazy entry made _resolve's own cleanup KeyError.
+        import os
+        import subprocess
+        import sys
+
+        # The axon sitecustomize overrides JAX_PLATFORMS at interpreter
+        # start (see conftest.py) — pin CPU with a config update instead.
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                "from p1_tpu.hashx import get_backend;"
+                "get_backend('jax'); print('resolved')",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "resolved" in out.stdout
+
+    def test_direct_import_does_not_double_list(self):
+        # Importing a lazily-registered backend module directly fulfills the
+        # lazy entry; the name must appear exactly once afterwards.
+        import p1_tpu.hashx.jax_backend  # noqa: F401
+
+        names = list(available_backends())
+        assert names.count("jax") == 1
+        assert len(names) == len(set(names))
+
 
 def _random_prefix(seed: int) -> bytes:
     rng = random.Random(seed)
