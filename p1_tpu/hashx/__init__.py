@@ -26,11 +26,15 @@ def _load_sharded():
     return sharded.ShardedBackend
 
 
+def _load_pallas():
+    from p1_tpu.hashx import pallas_backend
+
+    return pallas_backend.PallasTPUBackend
+
+
 _register_lazy("jax", _load_jax)
 _register_lazy("sharded", _load_sharded)
-# "tpu" (Pallas kernel) registers here when its module lands; advertising
-# names whose modules don't exist yet would turn get_backend into a
-# ModuleNotFoundError trap.
+_register_lazy("tpu", _load_pallas)
 
 __all__ = [
     "HashBackend",

@@ -43,18 +43,41 @@ def default_batch(platform: str | None = None) -> int:
     return _PLATFORM_BATCH.get(p, 1 << 20)
 
 
+#: Opening-ramp parameters (see ``PipelinedSearchMixin.search``).  The floor
+#: is sized so a difficulty-20 hit (expected at ~2²⁰ nonces) lands in the
+#: first step with ~98% probability; through the axon relay one dispatch
+#: costs ~125 ms regardless of span, so nothing is gained by starting lower.
+_RAMP_FLOOR = 1 << 22
+_RAMP_FACTOR = 8
+#: Ramp only when a hit inside the floor span is plausible: at difficulty d
+#: the expected hit is at 2^d nonces, so for d > 26 the opening steps almost
+#: never hit and would only add dispatch latency to a long scan.
+_RAMP_MAX_DIFFICULTY = 26
+
+
 class PipelinedSearchMixin:
     """The host loop shared by every device-stepped backend.
 
-    Subclasses provide ``step_span`` (nonces evaluated per device step) and
-    ``_make_step()`` (the jitted step function).  ``search`` then scans an
-    arbitrary range with a one-step pipeline and host-side masking of the
-    partial final step.
+    Subclasses provide ``step_span`` (nonces evaluated per full device step)
+    and ``_make_step(span)`` (a jitted step function for a given span).
+    ``search`` then scans an arbitrary range with a one-step pipeline and
+    host-side masking of the partial final step.
+
+    **Adaptive opening ramp**: a fresh scan (nonce_start == 0) at a
+    difficulty where an early hit is plausible starts with a small step
+    (``ramp_floor``) and grows geometrically to ``step_span``, so
+    time-to-block is one dispatch latency instead of a full-batch step —
+    at difficulty 20 a 2²⁷-batch backend would otherwise spend ~10× the
+    expected search time on granularity alone.  Throughput scans
+    (high difficulty, or resumed ranges) skip the ramp entirely.
     """
 
     step_span: int
+    #: Smallest opening step; None disables the ramp (sharded backend: the
+    #: per-device batch is baked into the mesh program).
+    ramp_floor: int | None = _RAMP_FLOOR
 
-    def _make_step(self) -> StepFn:
+    def _make_step(self, span: int) -> StepFn:
         raise NotImplementedError
 
     def sha256d(self, data: bytes) -> bytes:
@@ -73,20 +96,28 @@ class PipelinedSearchMixin:
     ) -> SearchResult:
         self._check_search_args(header_prefix, nonce_start, count, difficulty)
         midstate, tail, target = self._search_arrays(header_prefix, difficulty)
-        step = self._make_step()
+
+        ramping = (
+            self.ramp_floor is not None
+            and nonce_start == 0
+            and difficulty <= _RAMP_MAX_DIFFICULTY
+            and self.step_span > self.ramp_floor
+        )
+        span = self.ramp_floor if ramping else self.step_span
 
         # Batched scan with a one-step pipeline.  Each step covers
-        # [base, base+step_span); a partial final step is masked on the host
+        # [base, base+span); a partial final step is masked on the host
         # by re-checking the hit offset against the remaining count.
         pending: list[tuple[int, int, object]] = []  # (base, valid, device idx)
         done = 0
         result: SearchResult | None = None
         while done < count and result is None:
             base = nonce_start + done
-            valid = min(self.step_span, count - done)
-            idx = step(midstate, tail, target, _U32(base))
+            valid = min(span, count - done)
+            idx = self._make_step(span)(midstate, tail, target, _U32(base))
             pending.append((base, valid, idx))
             done += valid
+            span = min(span * _RAMP_FACTOR, self.step_span)
             if len(pending) > 1:
                 result = self._drain_one(pending, nonce_start)
         while result is None and pending:
@@ -117,5 +148,5 @@ class JaxBackend(PipelinedSearchMixin, HashBackend):
         self.step_span = batch
         self.platform = platform
 
-    def _make_step(self) -> StepFn:
-        return jit_search_step(self.batch, self.platform)
+    def _make_step(self, span: int) -> StepFn:
+        return jit_search_step(span, self.platform)

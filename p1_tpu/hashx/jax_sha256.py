@@ -65,7 +65,10 @@ _K_NP = np.asarray(K, dtype=np.uint32)
 
 
 def _compress(
-    state: tuple[jax.Array, ...], w16: tuple[jax.Array, ...], unroll: int
+    state: tuple[jax.Array, ...],
+    w16: tuple[jax.Array, ...],
+    unroll: int,
+    ks=None,
 ) -> tuple[jax.Array, ...]:
     """One SHA-256 compression over a 16-word chunk, rounds+extension fused.
 
@@ -77,7 +80,11 @@ def _compress(
     steps computed for rounds 48..63 feed nothing; that waste is ~12% of the
     σ work and buys a single uniform round body.
     """
-    ks = jnp.asarray(_K_NP)
+    if ks is None:
+        ks = jnp.asarray(_K_NP)
+    # ``ks`` may also be a Pallas SMEM ref of the K table: inside a kernel
+    # a captured jnp constant is disallowed, so the kernel passes the table
+    # in as a scalar-memory input and round ``i`` reads ``ks[i]``.
 
     def body(i, carry):
         w, s = carry

@@ -1,0 +1,219 @@
+"""Pallas-TPU hash backend: the SHA-256d nonce search as a Mosaic kernel.
+
+This is the literal north-star artifact (BASELINE.json:5 — the miner's inner
+loop "becomes a vmapped Pallas SHA-256 kernel that evaluates millions of
+candidate nonces per device step"), and it exists for a measured reason, not
+ceremony: the pure-XLA formulation (jax_backend.py) is **HBM-bound**.  XLA
+compiles the 64-round ``fori_loop`` with its ~25-array uint32 carry spilled
+to HBM between (unrolled) round bodies, so at batch 2²⁴ every round group
+streams gigabytes through HBM and the VPU idles.  The Pallas kernel instead
+works one ``(sub, 128)`` uint32 tile of nonces per grid step with the entire
+rolling window held in VMEM/vector registers — HBM traffic is ~zero (a few
+SMEM scalars in, 4 bytes out) and the search becomes compute-bound on the
+VPU, which is the best a hash search can do on this hardware.
+
+Layout (SURVEY.md §7 step 3):
+
+- Nonces across VPU lanes: grid step ``i`` evaluates flat lane indices
+  ``[i·sub·128, (i+1)·sub·128)`` as a ``(sub, 128)`` uint32 tile — the
+  native vreg shape for 32-bit data.
+- Same round math as jax_sha256 (``_compress`` is reused verbatim inside
+  the kernel body: midstate chunk-2 + second pass, schedule extension fused
+  into the round loop), so the Pallas/XLA/NumPy formulations stay
+  lane-exact by construction.
+- Scalar plumbing in SMEM: midstate (8), chunk-2 tail words (3), target
+  (8), nonce base (1).  Output is a single SMEM uint32 — ``min`` over the
+  grid of the earliest hit's flat index (or ``batch``) — accumulated across
+  sequential grid steps, exactly the contract of jax_sha256.search_step, so
+  the pipelined host loop and the sharded pmin reduction compose unchanged.
+- ``interpret=True`` runs the identical kernel on CPU (tests; Mosaic needs
+  real TPU hardware otherwise).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from p1_tpu.hashx.backend import HashBackend, register
+from p1_tpu.hashx.jax_backend import _RAMP_FLOOR, PipelinedSearchMixin, StepFn
+from p1_tpu.hashx.jax_sha256 import _compress, below_target
+from p1_tpu.hashx.sha256_ref import IV, K
+
+_U32 = jnp.uint32
+# Round constants / IV enter the kernel as SMEM inputs: a Pallas kernel may
+# not capture array-valued constants from its closure.
+_K_WORDS = np.asarray(K, dtype=np.uint32)
+_IV_WORDS = np.asarray(IV, dtype=np.uint32)
+
+#: Rows of 128 lanes per grid step.  The v5e sweep (docs/PERF.md) put
+#: sub=16 on top: 2048 nonces/step keeps the full compression window
+#: (~30 live tile-arrays ≈ 0.25 MB) in VMEM with the best Mosaic schedule;
+#: larger tiles spill, smaller ones starve the VPU of independent work.
+_DEFAULT_SUB = 16
+
+#: Device-step batch for compiled runs.  Unlike the XLA backend, the kernel
+#: materializes nothing per nonce in HBM, so a huge batch costs only abort
+#: granularity — and through the axon relay each dispatch carries ~40-125 ms
+#: of RPC overhead, so big steps are what amortize it (the sweep saturated
+#: at 2²⁷: ~750 MH/s vs 195 MH/s at 2²⁴).
+_DEFAULT_BATCH = 1 << 27
+
+
+def _search_kernel(
+    mid_ref,
+    tail_ref,
+    target_ref,
+    base_ref,
+    k_ref,
+    iv_ref,
+    out_ref,
+    *,
+    sub: int,
+    batch: int,
+    unroll: int,
+):
+    """One grid step: hash a (sub, 128) tile of nonces, fold in its first hit.
+
+    TPU grid steps run sequentially on the core, so the min-accumulation
+    into the single SMEM output cell is race-free by construction.
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[0] = jnp.int32(batch)
+
+    rows = jax.lax.broadcasted_iota(_U32, (sub, 128), 0)
+    cols = jax.lax.broadcasted_iota(_U32, (sub, 128), 1)
+    flat = i.astype(_U32) * _U32(sub * 128) + rows * _U32(128) + cols
+    nonces = base_ref[0] + flat
+
+    def bc(scalar):
+        return jnp.full((sub, 128), scalar, dtype=_U32)
+
+    zero = jnp.zeros((sub, 128), dtype=_U32)
+    # Pass 1, chunk 2: tail words + nonce + pad(0x80) + bitlen 640.
+    w = (bc(tail_ref[0]), bc(tail_ref[1]), bc(tail_ref[2]), nonces)
+    w += (zero + _U32(0x80000000),) + (zero,) * 10 + (zero + _U32(640),)
+    state1 = _compress(
+        tuple(bc(mid_ref[k]) for k in range(8)), w, unroll=unroll, ks=k_ref
+    )
+    # Pass 2 over the 32-byte digest (bitlen 256).
+    w2 = state1 + (zero + _U32(0x80000000),) + (zero,) * 6 + (zero + _U32(256),)
+    iv = tuple(bc(iv_ref[k]) for k in range(8))
+    digest = list(_compress(iv, w2, unroll=unroll, ks=k_ref))
+
+    hits = below_target(digest, tuple(target_ref[k] for k in range(8)))
+    # Mosaic has no unsigned-int reductions; flat indices are < 2³¹, so the
+    # first-hit min runs in int32 and the wrapper casts back to uint32.
+    local = jnp.min(
+        jnp.where(hits, flat.astype(jnp.int32), jnp.int32(batch))
+    )
+    out_ref[0] = jnp.minimum(out_ref[0], local)
+
+
+@functools.cache
+def jit_pallas_search_step(
+    batch: int,
+    sub: int = _DEFAULT_SUB,
+    platform: str | None = None,
+    interpret: bool = False,
+    unroll: int | None = None,
+) -> StepFn:
+    """Jitted Pallas search step with jit_search_step's exact signature:
+    (midstate(8,), tail(3,), target(8,), nonce_base) -> uint32 first-hit
+    offset in [0, batch], where ``batch`` means "no hit"."""
+    block = sub * 128
+    if batch % block:
+        raise ValueError(f"batch {batch} not a multiple of the {block} tile")
+    if unroll is None:
+        # Interpret mode lowers through XLA:CPU, where a fully-unrolled
+        # 128-round trace compiles for minutes (the trap jax_sha256's
+        # rolled loop exists to avoid); Mosaic on real TPU wants the
+        # straight-line body.
+        unroll = 1 if interpret else 64
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        smem = pltpu.SMEM
+    except ImportError:  # pragma: no cover - pallas tpu backend always ships
+        smem = None
+
+    kernel = functools.partial(
+        _search_kernel, sub=sub, batch=batch, unroll=unroll
+    )
+    scalar_spec = pl.BlockSpec(memory_space=smem)
+    call = pl.pallas_call(
+        kernel,
+        grid=(batch // block,),
+        in_specs=[scalar_spec] * 6,
+        out_specs=pl.BlockSpec(
+            (1,), lambda i: (0,), memory_space=smem
+        ),
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        interpret=interpret,
+    )
+
+    def step(midstate, tail, target, nonce_base):
+        return call(
+            midstate,
+            tail,
+            target,
+            jnp.reshape(nonce_base, (1,)),
+            jnp.asarray(_K_WORDS),
+            jnp.asarray(_IV_WORDS),
+        )[0].astype(_U32)
+
+    device = jax.devices(platform)[0] if platform else None
+    return jax.jit(step, device=device)
+
+
+@register("tpu")
+class PallasTPUBackend(PipelinedSearchMixin, HashBackend):
+    """SHA-256d nonce search as a Pallas TPU kernel (north star's ``tpu``).
+
+    ``interpret=None`` auto-detects: compiled Mosaic on a real TPU,
+    interpreter mode elsewhere (CPU tests run the identical kernel).
+    """
+
+    def __init__(
+        self,
+        batch: int | None = None,
+        sub: int = _DEFAULT_SUB,
+        platform: str | None = None,
+        interpret: bool | None = None,
+    ):
+        resolved = platform or jax.default_backend()
+        if interpret is None:
+            interpret = resolved not in ("tpu", "axon")
+        if batch is None:
+            # Interpreted runs are for parity tests: keep steps small.
+            batch = 1 << 12 if interpret else _DEFAULT_BATCH
+        block = sub * 128
+        if batch % block:
+            raise ValueError(f"batch {batch} must be a multiple of {block}")
+        if batch >= 1 << 31:
+            # The kernel's first-hit min runs in int32 (Mosaic has no
+            # unsigned reductions), so flat indices and the miss sentinel
+            # must stay below 2³¹ — fail here, not at first trace.
+            raise ValueError(f"batch {batch} must be < 2**31")
+        if _RAMP_FLOOR % block:
+            # Ramp spans are powers of two; a tile that doesn't divide them
+            # can't take part in the opening ramp.
+            self.ramp_floor = None
+        self.batch = batch
+        self.sub = sub
+        self.step_span = batch
+        self.platform = platform
+        self.interpret = interpret
+
+    def _make_step(self, span: int) -> StepFn:
+        return jit_pallas_search_step(
+            span, self.sub, self.platform, self.interpret
+        )

@@ -121,6 +121,10 @@ class ShardedBackend(PipelinedSearchMixin, HashBackend):
         self.batch = batch
         self.step_span = self.n_devices * batch
         self.unroll = unroll
+        # No opening ramp: the per-device batch is baked into the mesh
+        # program, and a v5e-8 step is already granular enough per chip.
+        self.ramp_floor = None
 
-    def _make_step(self) -> StepFn:
+    def _make_step(self, span: int) -> StepFn:
+        assert span == self.step_span, "sharded step span is fixed"
         return jit_sharded_step(self.mesh, self.batch, self.unroll)
