@@ -2,10 +2,11 @@
 
 Capability parity: "chain replay: verify 10k-block header chain (hash-only,
 no mining)" (BASELINE.json:9).  TPU-first: verification packs the whole
-chain into one (N, 20) uint32 array and runs PoW + prev-hash linkage as a
-single batched device computation (``verify_header_chain``) — segmented at
-a fixed size so one compiled program serves any chain length.  A host
-(hashlib) path provides the oracle and the CPU baseline.
+chain into one (S, segment, 20) uint32 array and runs PoW + prev-hash
+linkage as a single batched device program — a ``lax.scan`` over segments
+with the cross-segment digest carried on device
+(``jax_sha256.verify_header_chain_segments``).  A host (hashlib) path
+provides the oracle and the CPU baseline.
 """
 
 from __future__ import annotations
@@ -94,22 +95,30 @@ def replay_host(headers: list[BlockHeader]) -> ReplayReport:
 
 
 def replay_device(
-    headers: list[BlockHeader], segment: int = 4096, platform: str | None = None
+    headers: list[BlockHeader], segment: int = 8192, platform: str | None = None
 ) -> ReplayReport:
-    """Batched device verification in fixed-size segments.
+    """Whole-chain device verification in ONE dispatch.
 
-    Each segment checks PoW for all its headers and linkage both within the
-    segment and across the segment boundary (via the previous segment's
-    last digest, recomputed on host — one hash per 4096).  The final short
-    segment is padded with copies of its last header; every pad lane FAILS
-    linkage (a copied header's prev_hash never equals the preceding copy's
-    digest), intentionally: the ``idx < valid_len`` clamp on host is what
-    discards pad-lane failures, so do not "fix" the clamp away.
+    The chain is padded to a multiple of ``segment`` with byte-copies of
+    its last header, reshaped to (S, segment, 20), and handed to a single
+    jitted ``lax.scan`` that carries the cross-segment digest on device
+    (``jax_sha256.verify_header_chain_segments``) — no per-segment host
+    round-trips, no host re-hashing.  Per-dispatch relay overhead (~125 ms,
+    docs/PERF.md) is therefore paid exactly once per replay regardless of
+    chain length.
+
+    Padding semantics: every pad lane FAILS linkage (a copied header's
+    prev_hash never equals the preceding copy's digest), intentionally —
+    padding sits strictly after every real header, so a reported first
+    failure ``>= n`` means the real chain is clean; the host-side ``< n``
+    clamp is what discards pad-lane failures.  Do not "fix" the clamp away.
+    The pad copies also make the device-carried digest chain correct at the
+    boundary: the last pad lane's digest equals the last real header's.
     """
     import jax.numpy as jnp
 
     from p1_tpu.core.header import target_from_difficulty, target_to_words
-    from p1_tpu.hashx.jax_sha256 import jit_verify_chain
+    from p1_tpu.hashx.jax_sha256 import jit_verify_chain_scan
 
     if not headers:
         raise ValueError("empty chain")
@@ -119,34 +128,23 @@ def replay_device(
     )
     words = headers_to_words(headers)
     n = len(headers)
-    step = jit_verify_chain(segment, platform)
+    n_segments = -(-n // segment)
+    pad = n_segments * segment - n
+    if pad:
+        words = np.concatenate([words, np.repeat(words[-1:], pad, axis=0)])
+    words3 = words.reshape(n_segments, segment, 20)
+    step = jit_verify_chain_scan(n_segments, segment, platform)
 
     t0 = time.perf_counter()
-    first_invalid = None
-    prev_digest_words = jnp.zeros((8,), jnp.uint32)  # genesis links to zero
-    for base in range(0, n, segment):
-        chunk = words[base : base + segment]
-        valid_len = chunk.shape[0]
-        if valid_len < segment:
-            pad = np.repeat(chunk[-1:], segment - valid_len, axis=0)
-            chunk = np.concatenate([chunk, pad], axis=0)
-        idx = int(
-            step(
-                jnp.asarray(chunk),
-                target,
-                prev_digest_words,
-                jnp.asarray(base == 0),
-                jnp.uint32(difficulty),
-            )
-        )
-        if idx < valid_len:
-            first_invalid = base + idx
-            break
-        # Host-hash the segment's last real header to seed the next link.
-        last = sha256d(headers[base + valid_len - 1].serialize())
-        prev_digest_words = jnp.asarray(
-            np.frombuffer(last, dtype=">u4").astype(np.uint32)
-        )
+    idxs = np.asarray(
+        step(jnp.asarray(words3), target, jnp.uint32(difficulty))
+    )
+    offsets = np.arange(n_segments, dtype=np.int64) * segment
+    bad = offsets + idxs
+    bad = bad[idxs < segment]
+    first_invalid = int(bad.min()) if bad.size else None
+    if first_invalid is not None and first_invalid >= n:
+        first_invalid = None  # pad-lane failure: real chain is clean
     return ReplayReport(
         n, first_invalid is None, first_invalid, time.perf_counter() - t0, "device"
     )

@@ -46,6 +46,24 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("mine", help="mine N blocks from genesis (configs 1/2)")
     _add_common(p)
     p.add_argument("--blocks", type=int, default=10)
+    p.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler device trace of the mining loop into "
+        "DIR (view with tensorboard or xprof)",
+    )
+
+    p = sub.add_parser(
+        "sweep", help="difficulty sweep: time-to-block scaling (config 2)"
+    )
+    _add_common(p)
+    p.add_argument(
+        "--difficulties",
+        default="16:25",
+        help="half-open range LO:HI (e.g. 16:25) or comma list (16,20,24)",
+    )
+    p.add_argument("--blocks", type=int, default=5, help="blocks per difficulty")
 
     p = sub.add_parser("replay", help="generate+verify a header chain (config 3)")
     _add_common(p)
@@ -91,19 +109,19 @@ def build_parser() -> argparse.ArgumentParser:
 # -- mine ----------------------------------------------------------------
 
 
-def cmd_mine(args) -> int:
+def _mine_chain(miner, difficulty: int, blocks: int):
+    """Mine ``blocks`` headers from genesis; return (times, total_hashes)."""
     from p1_tpu.core.genesis import make_genesis
     from p1_tpu.core.header import BlockHeader
-    from p1_tpu.hashx import get_backend
-    from p1_tpu.miner import Miner
 
-    kwargs = {"batch": args.batch} if args.batch else {}
-    miner = Miner(backend=get_backend(args.backend, **kwargs), chunk=args.chunk)
-    tip = make_genesis(args.difficulty).header
+    if blocks < 1:
+        raise SystemExit("--blocks must be >= 1")
+
+    tip = make_genesis(difficulty).header
     times, hashes = [], 0
-    for height in range(1, args.blocks + 1):
+    for height in range(1, blocks + 1):
         draft = BlockHeader(
-            1, tip.block_hash(), bytes(32), tip.timestamp + 1, args.difficulty, 0
+            1, tip.block_hash(), bytes(32), tip.timestamp + 1, difficulty, 0
         )
         t0 = time.perf_counter()
         sealed = miner.search_nonce(draft)
@@ -112,13 +130,38 @@ def cmd_mine(args) -> int:
         times.append(dt)
         hashes += miner.last_stats.hashes_done
         logging.info(
-            "block height=%d nonce=%d t=%.3fs hps=%.0f",
+            "block d=%d height=%d nonce=%d t=%.3fs hps=%.0f",
+            difficulty,
             height,
             sealed.nonce,
             dt,
             miner.last_stats.hashes_per_sec,
         )
         tip = sealed
+    return times, hashes
+
+
+def cmd_mine(args) -> int:
+    import contextlib
+
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    kwargs = {"batch": args.batch} if args.batch else {}
+    miner = Miner(backend=get_backend(args.backend, **kwargs), chunk=args.chunk)
+    if args.profile:
+        # SURVEY.md §5 tracing: a device trace of the real mining loop.
+        # One warmup block first so the trace shows steady-state steps,
+        # not Mosaic/XLA compilation.
+        import jax
+
+        _mine_chain(miner, args.difficulty, 1)
+        profile_ctx = jax.profiler.trace(args.profile)
+        logging.info("profiling mining loop into %s", args.profile)
+    else:
+        profile_ctx = contextlib.nullcontext()
+    with profile_ctx:
+        times, hashes = _mine_chain(miner, args.difficulty, args.blocks)
     total = sum(times)
     print(
         json.dumps(
@@ -130,9 +173,58 @@ def cmd_mine(args) -> int:
                 "hashes_per_sec": round(hashes / total) if total else 0,
                 "time_to_block_s": round(statistics.median(times), 4),
                 "total_s": round(total, 3),
+                **({"profile_dir": args.profile} if args.profile else {}),
             }
         )
     )
+    return 0
+
+
+def _parse_difficulties(spec: str) -> list[int]:
+    try:
+        if ":" in spec:
+            lo, _, hi = spec.partition(":")
+            out = list(range(int(lo), int(hi)))
+        else:
+            out = [int(d) for d in spec.split(",") if d]
+    except ValueError:
+        out = []
+    if not out or not all(0 <= d <= 255 for d in out):
+        raise SystemExit(
+            f"bad difficulty spec {spec!r} (want LO:HI or a comma list)"
+        )
+    return out
+
+
+def cmd_sweep(args) -> int:
+    """Benchmark config 2: nonce-space scaling across difficulties.
+
+    One JSON line per difficulty with median time-to-block and the
+    aggregate hash rate, so the scaling curve (time ~ 2^d / rate, floored
+    by dispatch latency) is reproducible from a single command.
+    """
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    kwargs = {"batch": args.batch} if args.batch else {}
+    miner = Miner(backend=get_backend(args.backend, **kwargs), chunk=args.chunk)
+    for difficulty in _parse_difficulties(args.difficulties):
+        times, hashes = _mine_chain(miner, difficulty, args.blocks)
+        total = sum(times)
+        print(
+            json.dumps(
+                {
+                    "config": "sweep",
+                    "backend": args.backend,
+                    "difficulty": difficulty,
+                    "blocks": args.blocks,
+                    "time_to_block_s": round(statistics.median(times), 4),
+                    "hashes_per_sec": round(hashes / total) if total else 0,
+                    "total_s": round(total, 3),
+                }
+            ),
+            flush=True,
+        )
     return 0
 
 
@@ -366,6 +458,7 @@ def main(argv=None) -> int:
     )
     handler = {
         "mine": cmd_mine,
+        "sweep": cmd_sweep,
         "replay": cmd_replay,
         "node": cmd_node,
         "net": cmd_net,

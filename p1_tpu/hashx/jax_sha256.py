@@ -168,25 +168,15 @@ def sha256d_headers(
     return list(_compress(iv, w3, unroll))
 
 
-def verify_header_chain(
+def _verify_segment(
     header_words: jax.Array,
     target_words: jax.Array,
     prev_digest: jax.Array,
     genesis_first: jax.Array,
     difficulty: jax.Array,
-    unroll: int | None = None,
-) -> jax.Array:
-    """Index of the first invalid header in a linked batch, or N if all pass.
-
-    ``header_words``: (N, 20) uint32 — consecutive headers of one chain
-    segment.  A header is valid iff its declared difficulty field (word 18)
-    equals ``difficulty``, its SHA-256d meets ``target_words`` AND its
-    prev-hash field (words 1..8) equals the previous header's digest.
-    ``prev_digest``: (8,) digest of the header before the segment (for i=0).
-    ``genesis_first``: scalar bool — when true, header 0 is a genesis block:
-    linkage (zero prev-hash) is still enforced via ``prev_digest`` but the
-    PoW check is waived (genesis anchors by identity, not work).
-    """
+    unroll: int | None,
+) -> tuple[jax.Array, jax.Array]:
+    """(first-invalid index or N, last header's digest words (8,))."""
     digests = sha256d_headers(header_words, unroll)
     n = header_words.shape[0]
     pow_ok = below_target(digests, target_words)
@@ -207,15 +197,80 @@ def verify_header_chain(
 
     ok = pow_ok & link_ok
     idx = jnp.arange(n, dtype=_U32)
-    return jnp.min(jnp.where(ok, _U32(n), idx))
+    first_bad = jnp.min(jnp.where(ok, _U32(n), idx))
+    last_digest = jnp.stack([d[-1] for d in digests])
+    return first_bad, last_digest
+
+
+def verify_header_chain(
+    header_words: jax.Array,
+    target_words: jax.Array,
+    prev_digest: jax.Array,
+    genesis_first: jax.Array,
+    difficulty: jax.Array,
+    unroll: int | None = None,
+) -> jax.Array:
+    """Index of the first invalid header in a linked batch, or N if all pass.
+
+    ``header_words``: (N, 20) uint32 — consecutive headers of one chain
+    segment.  A header is valid iff its declared difficulty field (word 18)
+    equals ``difficulty``, its SHA-256d meets ``target_words`` AND its
+    prev-hash field (words 1..8) equals the previous header's digest.
+    ``prev_digest``: (8,) digest of the header before the segment (for i=0).
+    ``genesis_first``: scalar bool — when true, header 0 is a genesis block:
+    linkage (zero prev-hash) is still enforced via ``prev_digest`` but the
+    PoW check is waived (genesis anchors by identity, not work).
+    """
+    idx, _ = _verify_segment(
+        header_words, target_words, prev_digest, genesis_first, difficulty, unroll
+    )
+    return idx
+
+
+def verify_header_chain_segments(
+    words3: jax.Array,
+    target_words: jax.Array,
+    difficulty: jax.Array,
+    unroll: int | None = None,
+) -> jax.Array:
+    """Whole-chain verification as ONE device program: ``lax.scan`` over
+    (S, segment, 20) header words, carrying the cross-segment digest on
+    device.  Returns (S,) local first-invalid indices (= segment when the
+    segment is clean).
+
+    This exists because per-segment host round-trips dominate replay through
+    the axon relay (~125 ms per dispatch, docs/PERF.md): the scan costs one
+    dispatch and one bulk transfer for the entire chain, with no host
+    re-hashing between segments.  Header 0 of segment 0 is treated as
+    genesis (PoW waived, zero prev-hash enforced).
+    """
+    s = words3.shape[0]
+    first_flags = jnp.arange(s) == 0
+
+    def body(prev_digest, inp):
+        seg_words, is_first = inp
+        idx, last_digest = _verify_segment(
+            seg_words, target_words, prev_digest, is_first, difficulty, unroll
+        )
+        return last_digest, idx
+
+    _, idxs = lax.scan(
+        body, jnp.zeros((8,), _U32), (words3, first_flags)
+    )
+    return idxs
 
 
 @functools.cache
-def jit_verify_chain(n: int, platform: str | None = None, unroll: int | None = None):
-    """Jitted ``verify_header_chain`` for segments of exactly ``n`` headers."""
+def jit_verify_chain_scan(
+    n_segments: int,
+    segment: int,
+    platform: str | None = None,
+    unroll: int | None = None,
+):
+    """Jitted ``verify_header_chain_segments`` for an (S, segment) layout."""
     if unroll is None:
         unroll = default_unroll(platform)
-    fn = functools.partial(verify_header_chain, unroll=unroll)
+    fn = functools.partial(verify_header_chain_segments, unroll=unroll)
     device = jax.devices(platform)[0] if platform else None
     return jax.jit(fn, device=device)
 

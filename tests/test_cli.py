@@ -26,6 +26,31 @@ class TestCli:
         assert out["hashes_per_sec"] > 0
         assert out["time_to_block_s"] >= 0
 
+    def test_sweep_config2(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "sweep",
+                "--difficulties", "8:10", "--blocks", "2", "--backend", "cpu",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+        assert [l["difficulty"] for l in lines] == [8, 9]
+        assert all(l["config"] == "sweep" and l["blocks"] == 2 for l in lines)
+
+    def test_mine_profile_writes_trace(self, tmp_path):
+        out = _run(
+            "mine", "--difficulty", "8", "--blocks", "1", "--backend", "cpu",
+            "--profile", str(tmp_path / "trace"),
+        )
+        assert out["profile_dir"] == str(tmp_path / "trace")
+        files = list((tmp_path / "trace").rglob("*"))
+        assert any(f.is_file() for f in files), "no trace files written"
+
     def test_replay_config3(self):
         out = _run(
             "replay", "--n", "64", "--difficulty", "8", "--method", "host"
