@@ -82,6 +82,18 @@ def main() -> None:
         # The pure-XLA formulation, for the Pallas-vs-XLA record
         # (docs/PERF.md): same chip, same session.
         extra["xla_hps"] = round(_throughput(get_backend("jax"), prefix, 1 << 28))
+    from p1_tpu.hashx.native_build import NativeBuildError
+
+    try:
+        # The C++ host tier (SHA-NI when available); skipped cleanly when
+        # no toolchain exists on the bench host — anything else is a real
+        # regression and should crash the bench loudly.
+        native = get_backend("native")
+    except (NativeBuildError, OSError):
+        native = None
+    if native is not None:
+        extra["native_hps"] = round(_throughput(native, prefix, 1 << 22, repeats=1))
+        extra["native_shani"] = native.has_shani
 
     ttb = _time_to_block(Miner(backend=device), difficulty=20)
 

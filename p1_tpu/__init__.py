@@ -7,7 +7,8 @@ against the driver-recorded capability model in /root/repo/BASELINE.json):
 - ``p1_tpu.core``    — block/header/transaction types, deterministic
   serialization, difficulty/target math, genesis.
 - ``p1_tpu.hashx``   — the ``HashBackend`` plugin registry (BASELINE.json:5)
-  with CPU (hashlib), NumPy-oracle, JAX/XLA, Pallas-TPU (``tpu``) and
+  with CPU (hashlib), C++ ``native`` (SHA-NI when available, built lazily
+  from p1_tpu/native/), NumPy-oracle, JAX/XLA, Pallas-TPU (``tpu``) and
   multi-chip ``sharded`` backends.
 - ``p1_tpu.miner``   — ``Miner.search_nonce()`` (BASELINE.json:5): the nonce
   search as batched device steps; multi-chip sharding with a pmin first-hit

@@ -32,9 +32,16 @@ def _load_pallas():
     return pallas_backend.PallasTPUBackend
 
 
+def _load_native():
+    from p1_tpu.hashx import native_backend
+
+    return native_backend.NativeBackend
+
+
 _register_lazy("jax", _load_jax)
 _register_lazy("sharded", _load_sharded)
 _register_lazy("tpu", _load_pallas)
+_register_lazy("native", _load_native)
 
 __all__ = [
     "HashBackend",

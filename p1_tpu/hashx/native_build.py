@@ -1,0 +1,66 @@
+"""Build the native SHA-256d core (p1_tpu/native/sha256d.cpp) on demand.
+
+The .so is machine-local (it carries a runtime SHA-NI dispatch but is built
+with the local toolchain), so it is compiled lazily into a content-addressed
+cache — first `get_backend("native")` pays one g++ invocation, everything
+after that is an mmap.  No setuptools, no pybind11: the C ABI + ctypes is
+the whole binding layer (this environment ships no pybind11; the CPython
+API would be overkill for four functions).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import subprocess
+
+SOURCE = pathlib.Path(__file__).resolve().parent.parent / "native" / "sha256d.cpp"
+
+
+class NativeBuildError(RuntimeError):
+    """The native core could not be compiled (missing toolchain, bad env)."""
+
+
+def cache_dir() -> pathlib.Path:
+    root = os.environ.get("P1_NATIVE_CACHE")
+    if root:
+        return pathlib.Path(root)
+    return pathlib.Path.home() / ".cache" / "p1_tpu"
+
+
+def build_lib(force: bool = False) -> pathlib.Path:
+    """Compile (if needed) and return the shared library path.
+
+    Content-addressed by source hash: editing the .cpp invalidates the
+    cache automatically; concurrent builders race benignly via an atomic
+    rename of a per-pid temp file.
+    """
+    tag = hashlib.sha256(SOURCE.read_bytes()).hexdigest()[:16]
+    out = cache_dir() / f"sha256d_{tag}.so"
+    if out.exists() and not force:
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    cxx = os.environ.get("CXX", "g++")
+    tmp = out.with_suffix(f".tmp.{os.getpid()}")
+    cmd = [
+        cxx,
+        "-O3",
+        "-std=c++17",
+        "-fPIC",
+        "-shared",
+        "-fno-exceptions",
+        str(SOURCE),
+        "-o",
+        str(tmp),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"cannot run {cxx}: {e}") from e
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr[-2000:]}"
+        )
+    os.replace(tmp, out)
+    return out

@@ -1,0 +1,299 @@
+// Native SHA-256d core for the "native" hash backend.
+//
+// Capability parity: the reference's CPU mining path as a native-code
+// component (SURVEY.md §2 requires C++ equivalents wherever the reference
+// is native; BASELINE.json:5 names the CPU backend the TPU must beat).
+// This is the host-side performance tier between the hashlib loop
+// (~0.8 MH/s, Python-call-bound) and the TPU kernel: a single C call scans
+// a whole nonce range with the midstate trick, using the x86 SHA-NI
+// extension when the CPU has it (~10-20x hashlib) and a portable scalar
+// compression otherwise.
+//
+// Exposed C ABI (ctypes-friendly; see p1_tpu/hashx/native_backend.py):
+//   p1_sha256d(data, len, out32)           - one double-SHA-256
+//   p1_search(prefix76, start, count, d)   - earliest nonce with >= d
+//                                            leading zero bits, or -1
+//   p1_has_shani()                         - which compression runs
+//
+// The header layout contract matches p1_tpu/core/header.py: 80-byte
+// big-endian header, nonce in bytes 76..80; the search holds bytes 0..76
+// fixed (one compression of bytes 0..64 is hoisted out of the loop).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+#define P1_X86 1
+#else
+#define P1_X86 0
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t IV[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+// ---------------------------------------------------------------- scalar --
+
+void compress_scalar(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; ++i) w[i] = be32(block + 4 * i);
+  for (int i = 16; i < 64; ++i) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + s1 + ch + K[i] + w[i];
+    uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// ---------------------------------------------------------------- SHA-NI --
+
+#if P1_X86
+// Standard two-lane SHA-NI schedule (state held as ABEF/CDGH vectors);
+// compiled with a target attribute so the .so builds and loads on any
+// x86-64 and the choice happens at runtime via __builtin_cpu_supports.
+__attribute__((target("sha,sse4.1")))
+void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+
+  __m128i TMP = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&state[0]));
+  __m128i STATE1 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&state[4]));
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);        // CDAB
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);  // EFGH
+  __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);  // ABEF
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);       // CDGH
+
+  const __m128i ABEF_SAVE = STATE0;
+  const __m128i CDGH_SAVE = STATE1;
+  __m128i MSG, MSG0, MSG1, MSG2, MSG3;
+
+#define P1_QROUND(Ki_lo, Ki_hi, M)                                   \
+  do {                                                               \
+    MSG = _mm_add_epi32(                                             \
+        M, _mm_set_epi64x((long long)(Ki_hi), (long long)(Ki_lo)));  \
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);             \
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);                              \
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);             \
+  } while (0)
+
+  // Rounds 0-15: raw message words; start msg1 pre-passes as groups land.
+  MSG0 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 0)), MASK);
+  P1_QROUND(0x71374491428a2f98ULL, 0xe9b5dba5b5c0fbcfULL, MSG0);
+  MSG1 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 16)), MASK);
+  P1_QROUND(0x59f111f13956c25bULL, 0xab1c5ed5923f82a4ULL, MSG1);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+  MSG2 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 32)), MASK);
+  P1_QROUND(0x12835b01d807aa98ULL, 0x550c7dc3243185beULL, MSG2);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+  MSG3 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 48)), MASK);
+  P1_QROUND(0x80deb1fe72be5d74ULL, 0xc19bf1749bdc06a7ULL, MSG3);
+
+  // Schedule step: Mnext (already msg1-combined with its successor two
+  // steps ago) gains w[i-7..i-4] = alignr(newest, second_newest) and the
+  // msg2 sigma-1 chain; second_newest then takes ITS msg1 pre-pass.  The
+  // alignr must read second_newest RAW, so msg1 comes last.
+#define P1_SCHED(Mnext, Mprev2, Mprev1)                              \
+  do {                                                               \
+    TMP = _mm_alignr_epi8(Mprev1, Mprev2, 4);                        \
+    Mnext = _mm_add_epi32(Mnext, TMP);                               \
+    Mnext = _mm_sha256msg2_epu32(Mnext, Mprev1);                     \
+    Mprev2 = _mm_sha256msg1_epu32(Mprev2, Mprev1);                   \
+  } while (0)
+
+  // Rounds 16-63: 12 schedule+round pairs with cyclically rotating roles.
+  P1_SCHED(MSG0, MSG2, MSG3);
+  P1_QROUND(0xefbe4786e49b69c1ULL, 0x240ca1cc0fc19dc6ULL, MSG0);
+  P1_SCHED(MSG1, MSG3, MSG0);
+  P1_QROUND(0x4a7484aa2de92c6fULL, 0x76f988da5cb0a9dcULL, MSG1);
+  P1_SCHED(MSG2, MSG0, MSG1);
+  P1_QROUND(0xa831c66d983e5152ULL, 0xbf597fc7b00327c8ULL, MSG2);
+  P1_SCHED(MSG3, MSG1, MSG2);
+  P1_QROUND(0xd5a79147c6e00bf3ULL, 0x1429296706ca6351ULL, MSG3);
+  P1_SCHED(MSG0, MSG2, MSG3);
+  P1_QROUND(0x2e1b213827b70a85ULL, 0x53380d134d2c6dfcULL, MSG0);
+  P1_SCHED(MSG1, MSG3, MSG0);
+  P1_QROUND(0x766a0abb650a7354ULL, 0x92722c8581c2c92eULL, MSG1);
+  P1_SCHED(MSG2, MSG0, MSG1);
+  P1_QROUND(0xa81a664ba2bfe8a1ULL, 0xc76c51a3c24b8b70ULL, MSG2);
+  P1_SCHED(MSG3, MSG1, MSG2);
+  P1_QROUND(0xd6990624d192e819ULL, 0x106aa070f40e3585ULL, MSG3);
+  P1_SCHED(MSG0, MSG2, MSG3);
+  P1_QROUND(0x1e376c0819a4c116ULL, 0x34b0bcb52748774cULL, MSG0);
+  P1_SCHED(MSG1, MSG3, MSG0);
+  P1_QROUND(0x4ed8aa4a391c0cb3ULL, 0x682e6ff35b9cca4fULL, MSG1);
+  P1_SCHED(MSG2, MSG0, MSG1);
+  P1_QROUND(0x78a5636f748f82eeULL, 0x8cc7020884c87814ULL, MSG2);
+  P1_SCHED(MSG3, MSG1, MSG2);
+  P1_QROUND(0xa4506ceb90befffaULL, 0xc67178f2bef9a3f7ULL, MSG3);
+
+#undef P1_SCHED
+#undef P1_QROUND
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);        // FEBA
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);     // DCHG
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);  // DCBA
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);     // HGFE... -> EFGH order below
+
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[0]), STATE0);
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[4]), STATE1);
+}
+#endif  // P1_X86
+
+using CompressFn = void (*)(uint32_t[8], const uint8_t[64]);
+
+CompressFn pick_compress() {
+#if P1_X86
+  if (__builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1"))
+    return compress_shani;
+#endif
+  return compress_scalar;
+}
+
+CompressFn g_compress = pick_compress();
+
+// --------------------------------------------------------------- helpers --
+
+// One-shot SHA-256 of an arbitrary message.
+void sha256(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  uint32_t st[8];
+  std::memcpy(st, IV, sizeof(st));
+  uint64_t full = len / 64;
+  for (uint64_t i = 0; i < full; ++i) g_compress(st, data + 64 * i);
+  uint8_t block[64];
+  uint64_t rem = len - 64 * full;
+  std::memcpy(block, data + 64 * full, rem);
+  block[rem] = 0x80;
+  std::memset(block + rem + 1, 0, 64 - rem - 1);
+  if (rem + 1 > 56) {  // length field doesn't fit: one more block
+    g_compress(st, block);
+    std::memset(block, 0, 64);
+  }
+  uint64_t bits = len * 8;
+  for (int i = 0; i < 8; ++i) block[56 + i] = uint8_t(bits >> (8 * (7 - i)));
+  g_compress(st, block);
+  for (int i = 0; i < 8; ++i) put_be32(out + 4 * i, st[i]);
+}
+
+// >= difficulty leading zero bits?  (digest < 2^(256-d), header.py:97-120)
+inline bool leading_zero_bits_ge(const uint32_t digest_words[8], uint32_t d) {
+  uint32_t full = d / 32, rem = d % 32;
+  for (uint32_t i = 0; i < full; ++i)
+    if (digest_words[i] != 0) return false;
+  if (rem == 0) return true;
+  return full < 8 && (digest_words[full] >> (32 - rem)) == 0;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------- ABI --
+
+extern "C" {
+
+int p1_has_shani() {
+#if P1_X86
+  return g_compress != compress_scalar;
+#else
+  return 0;
+#endif
+}
+
+// Test hook: force the portable scalar compression (enable=1) or restore
+// the runtime-dispatched best path (enable=0), so the fallback is testable
+// on SHA-NI hardware.
+void p1_force_scalar(int enable) {
+  g_compress = enable ? compress_scalar : pick_compress();
+}
+
+void p1_sha256d(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  uint8_t first[32];
+  sha256(data, len, first);
+  sha256(first, 32, out);
+}
+
+// Earliest nonce in [nonce_start, nonce_start+count) whose header SHA-256d
+// has >= difficulty leading zero bits, or -1.  prefix is the fixed 76-byte
+// header head; the first 64 bytes compress once (midstate).
+long long p1_search(const uint8_t prefix[76], uint32_t nonce_start,
+                    uint64_t count, uint32_t difficulty) {
+  uint32_t midstate[8];
+  std::memcpy(midstate, IV, sizeof(midstate));
+  g_compress(midstate, prefix);
+
+  // Chunk 2 template: prefix bytes 64..76, nonce at 12..16, pad, bitlen 640.
+  uint8_t block2[64];
+  std::memset(block2, 0, sizeof(block2));
+  std::memcpy(block2, prefix + 64, 12);
+  block2[16] = 0x80;
+  block2[62] = 0x02;  // 640 = 0x0280 big-endian in bytes 56..64
+  block2[63] = 0x80;
+
+  // Second-pass template: 32-byte digest, pad, bitlen 256.
+  uint8_t block3[64];
+  std::memset(block3, 0, sizeof(block3));
+  block3[32] = 0x80;
+  block3[62] = 0x01;  // 256 = 0x0100
+  block3[63] = 0x00;
+
+  const uint64_t end = uint64_t(nonce_start) + count;
+  for (uint64_t nonce = nonce_start; nonce < end; ++nonce) {
+    put_be32(block2 + 12, uint32_t(nonce));
+    uint32_t st[8];
+    std::memcpy(st, midstate, sizeof(st));
+    g_compress(st, block2);
+    for (int i = 0; i < 8; ++i) put_be32(block3 + 4 * i, st[i]);
+    uint32_t st2[8];
+    std::memcpy(st2, IV, sizeof(st2));
+    g_compress(st2, block3);
+    if (leading_zero_bits_ge(st2, difficulty)) return (long long)nonce;
+  }
+  return -1;
+}
+
+}  // extern "C"

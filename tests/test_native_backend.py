@@ -1,0 +1,72 @@
+"""Native (C++/ctypes) backend: digest + search parity, both compressions.
+
+Skips cleanly when no C++ toolchain is available; on x86 with SHA-NI both
+the hardware path and the portable scalar fallback are exercised via the
+force_scalar test hook.
+"""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+from p1_tpu.core import BlockHeader
+
+
+@pytest.fixture(scope="module")
+def native():
+    from p1_tpu.hashx.native_build import NativeBuildError
+
+    try:
+        from p1_tpu.hashx import get_backend
+
+        be = get_backend("native")
+    except NativeBuildError as e:
+        pytest.skip(f"native toolchain unavailable: {e}")
+    yield be
+    be.force_scalar(False)
+
+
+def _prefix(seed: int) -> bytes:
+    rng = random.Random(seed)
+    return BlockHeader(
+        1, rng.randbytes(32), rng.randbytes(32), 1735689700, 12, 0
+    ).mining_prefix()
+
+
+@pytest.mark.parametrize("scalar", [False, True])
+class TestNative:
+    def test_sha256d_matches_hashlib(self, native, scalar):
+        native.force_scalar(scalar)
+        rng = random.Random(42)
+        # Lengths straddling every padding boundary.
+        for n in (0, 1, 31, 32, 55, 56, 57, 63, 64, 65, 80, 119, 120, 1000):
+            data = rng.randbytes(n)
+            want = hashlib.sha256(hashlib.sha256(data).digest()).digest()
+            assert native.sha256d(data) == want, f"len={n} scalar={scalar}"
+
+    def test_search_parity_with_cpu(self, native, scalar):
+        from p1_tpu.hashx import get_backend
+
+        native.force_scalar(scalar)
+        for seed in (0, 3):
+            prefix = _prefix(seed)
+            got = native.search(prefix, 0, 1 << 14, 10)
+            want = get_backend("cpu").search(prefix, 0, 1 << 14, 10)
+            assert got == want, f"seed={seed} scalar={scalar}"
+
+    def test_nonce_start_and_no_hit(self, native, scalar):
+        native.force_scalar(scalar)
+        prefix = _prefix(1)
+        assert native.search(prefix, 500, 64, 0).nonce == 500
+        assert native.search(prefix, 0, 64, 255).nonce is None
+
+
+def test_env_gate_matches(native):
+    # The cross-backend parity suite includes "native" when this env var is
+    # set (tests/test_hash_backends.py); make sure the gate stays wired.
+    if os.environ.get("P1_TEST_NATIVE"):
+        from p1_tpu.hashx import available_backends
+
+        assert "native" in list(available_backends())
