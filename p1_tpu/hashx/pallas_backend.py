@@ -117,17 +117,20 @@ def _search_kernel(
     out_ref[0] = jnp.minimum(out_ref[0], local)
 
 
-@functools.cache
-def jit_pallas_search_step(
+def pallas_search_fn(
     batch: int,
     sub: int = _DEFAULT_SUB,
-    platform: str | None = None,
     interpret: bool = False,
     unroll: int | None = None,
-) -> StepFn:
-    """Jitted Pallas search step with jit_search_step's exact signature:
-    (midstate(8,), tail(3,), target(8,), nonce_base) -> uint32 first-hit
-    offset in [0, batch], where ``batch`` means "no hit"."""
+):
+    """The UNJITTED Pallas search step: (midstate(8,), tail(3,), target(8,),
+    nonce_base) -> uint32 first-hit offset in [0, batch] (``batch`` = miss).
+
+    Composable into larger traced programs — the ``sharded`` backend calls
+    it inside ``shard_map`` so each chip of a mesh runs the kernel on its
+    own nonce block; ``jit_pallas_search_step`` is the single-device jitted
+    form.
+    """
     block = sub * 128
     if batch % block:
         raise ValueError(f"batch {batch} not a multiple of the {block} tile")
@@ -156,6 +159,10 @@ def jit_pallas_search_step(
         out_specs=pl.BlockSpec(
             (1,), lambda i: (0,), memory_space=smem
         ),
+        # NOTE: composing this into shard_map requires check_vma=False on
+        # the shard_map (the sharded backend does this): the pallas
+        # machinery emits unvarying internal operands (grid indexing) that
+        # the varying-manual-axes checker rejects.
         out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
         interpret=interpret,
     )
@@ -170,6 +177,20 @@ def jit_pallas_search_step(
             jnp.asarray(_IV_WORDS),
         )[0].astype(_U32)
 
+    return step
+
+
+@functools.cache
+def jit_pallas_search_step(
+    batch: int,
+    sub: int = _DEFAULT_SUB,
+    platform: str | None = None,
+    interpret: bool = False,
+    unroll: int | None = None,
+) -> StepFn:
+    """Jitted single-device ``pallas_search_fn`` (jit_search_step's exact
+    signature)."""
+    step = pallas_search_fn(batch, sub, interpret, unroll)
     device = jax.devices(platform)[0] if platform else None
     return jax.jit(step, device=device)
 

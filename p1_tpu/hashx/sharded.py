@@ -51,7 +51,10 @@ def make_mesh(
 
 @functools.cache
 def jit_sharded_step(
-    mesh: Mesh, batch_per_device: int, unroll: int | None = None
+    mesh: Mesh,
+    batch_per_device: int,
+    unroll: int | None = None,
+    kernel: str = "xla",
 ) -> StepFn:
     """Jitted sharded step closed over mesh + per-device batch.
 
@@ -60,16 +63,42 @@ def jit_sharded_step(
     [nonce_base, nonce_base + n_devices*batch_per_device), or the span.
     All inputs are replicated (``P()``); the output is replicated too —
     ``pmin`` makes it device-invariant, so any shard can be read back.
+
+    ``kernel`` picks the per-device search body: ``"xla"`` (the jax_sha256
+    formulation — also what CPU validation meshes run) or ``"pallas"``
+    (the Mosaic kernel of pallas_backend inside shard_map, so every chip
+    of a real TPU mesh mines at the single-chip kernel rate, docs/PERF.md).
     """
     n = mesh.devices.size
     span = n * batch_per_device
     if span >= 1 << 32:
         raise ValueError("step span must stay below uint32 nonce space")
-    if unroll is None:
-        # Resolve against the mesh's platform, not the ambient default
-        # backend: a CPU validation mesh on a TPU host must get the
-        # trace-tiny body, and vice versa.
-        unroll = default_unroll(mesh.devices.flat[0].platform)
+    platform = mesh.devices.flat[0].platform
+    if kernel == "pallas":
+        from p1_tpu.hashx.pallas_backend import pallas_search_fn
+
+        device_search = pallas_search_fn(
+            batch_per_device,
+            interpret=platform not in ("tpu", "axon"),
+            unroll=unroll,
+        )
+    elif kernel == "xla":
+        if unroll is None:
+            # Resolve against the mesh's platform, not the ambient default
+            # backend: a CPU validation mesh on a TPU host must get the
+            # trace-tiny body, and vice versa.
+            unroll = default_unroll(platform)
+        device_search = functools.partial(
+            search_step, batch=batch_per_device, unroll=unroll
+        )
+    else:
+        raise ValueError(f"unknown sharded kernel {kernel!r}")
+
+    # The pallas body needs check_vma off: pallas' internal grid indexing
+    # emits unvarying operands the varying-manual-axes checker rejects
+    # (JAX's own suggested workaround).  The XLA body keeps the check and
+    # the explicit pcast promotion it requires.
+    check_vma = kernel != "pallas"
 
     @jax.jit
     @functools.partial(
@@ -77,17 +106,21 @@ def jit_sharded_step(
         mesh=mesh,
         in_specs=(P(), P(), P(), P()),
         out_specs=P(),
+        check_vma=check_vma,
     )
     def step(midstate, tail, target, nonce_base):
         d = lax.axis_index(AXIS).astype(_U32)
         base = nonce_base + d * _U32(batch_per_device)
-        # ``base`` varies per device, so the whole hash dataflow is varying
-        # over the mesh axis; promote the replicated inputs to match, or the
-        # fori_loop carry in the compression rejects the mixed types.
-        midstate, tail, target = (
-            lax.pcast(x, AXIS, to="varying") for x in (midstate, tail, target)
-        )
-        off = search_step(midstate, tail, target, base, batch_per_device, unroll)
+        if check_vma:
+            # ``base`` varies per device, so the whole hash dataflow is
+            # varying over the mesh axis; promote the replicated inputs to
+            # match, or the fori_loop carry in the compression rejects the
+            # mixed types.
+            midstate, tail, target = (
+                lax.pcast(x, AXIS, to="varying")
+                for x in (midstate, tail, target)
+            )
+        off = device_search(midstate, tail, target, base)
         hit = off < _U32(batch_per_device)
         global_off = jnp.where(hit, d * _U32(batch_per_device) + off, _U32(span))
         return lax.pmin(global_off, AXIS)
@@ -111,14 +144,44 @@ class ShardedBackend(PipelinedSearchMixin, HashBackend):
         n_devices: int | None = None,
         platform: str | None = None,
         unroll: int | None = None,
+        kernel: str | None = None,
     ):
         self.mesh = make_mesh(n_devices, platform)
+        mesh_platform = self.mesh.devices.flat[0].platform
+        if kernel is None:
+            # Real TPU chips run the Mosaic kernel (7x the XLA formulation,
+            # docs/PERF.md); CPU validation meshes keep the XLA body — the
+            # interpreted Pallas kernel is a correctness tool, too slow to
+            # be the default 8-virtual-device path.
+            kernel = "pallas" if mesh_platform in ("tpu", "axon") else "xla"
         if batch is None:
-            batch = default_batch(self.mesh.devices.flat[0].platform)
+            if kernel == "pallas" and mesh_platform in ("tpu", "axon"):
+                # The kernel's rate comes from big dispatch-amortizing
+                # steps (docs/PERF.md), not the XLA-carry-sized default.
+                from p1_tpu.hashx.pallas_backend import _DEFAULT_BATCH
+
+                batch = _DEFAULT_BATCH
+            else:
+                batch = default_batch(mesh_platform)
         if batch <= 0 or batch & (batch - 1):
             raise ValueError(f"batch must be a power of two, got {batch}")
+        if kernel == "pallas":
+            # Mirror PallasTPUBackend's construction-time guards: the
+            # kernel's first-hit min runs in int32 and nonces tile as
+            # (sub, 128) blocks — fail here, not at the first search.
+            from p1_tpu.hashx.pallas_backend import _DEFAULT_SUB
+
+            block = _DEFAULT_SUB * 128
+            if batch % block:
+                raise ValueError(
+                    f"per-device batch {batch} must be a multiple of {block} "
+                    "for the pallas kernel"
+                )
+            if batch >= 1 << 31:
+                raise ValueError(f"per-device batch {batch} must be < 2**31")
         self.n_devices = self.mesh.devices.size
         self.batch = batch
+        self.kernel = kernel
         self.step_span = self.n_devices * batch
         self.unroll = unroll
         # No opening ramp: the per-device batch is baked into the mesh
@@ -127,4 +190,4 @@ class ShardedBackend(PipelinedSearchMixin, HashBackend):
 
     def _make_step(self, span: int) -> StepFn:
         assert span == self.step_span, "sharded step span is fixed"
-        return jit_sharded_step(self.mesh, self.batch, self.unroll)
+        return jit_sharded_step(self.mesh, self.batch, self.unroll, self.kernel)

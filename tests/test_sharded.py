@@ -137,3 +137,29 @@ class TestShardedBackend:
             sealed.mining_prefix() + struct.pack(">I", sealed.nonce)
         )
         assert digest == sealed.block_hash()
+
+
+class TestPallasInMesh:
+    def test_pallas_kernel_inside_shard_map(self):
+        # The Mosaic kernel composed into the mesh program (interpret mode
+        # on the CPU test mesh): first-hit parity with the host scan across
+        # a 2-device span, exercising the pcast + pmin plumbing around the
+        # pallas_call.
+        backend = get_backend(
+            "sharded", batch=2048, n_devices=2, kernel="pallas"
+        )
+        assert backend.kernel == "pallas"
+        prefix = _prefix(35)
+        truth = get_backend("cpu").search(prefix, 0, 4096, 8)
+        got = backend.search(prefix, 0, 4096, 8)
+        assert got.nonce == truth.nonce
+
+    def test_cpu_mesh_defaults_to_xla_kernel(self):
+        backend = get_backend("sharded", batch=256, n_devices=2)
+        assert backend.kernel == "xla"
+
+    def test_pallas_kernel_constructor_guards(self):
+        with pytest.raises(ValueError, match="multiple"):
+            get_backend("sharded", batch=1024, n_devices=1, kernel="pallas")
+        with pytest.raises(ValueError, match="2\\*\\*31"):
+            get_backend("sharded", batch=1 << 31, n_devices=1, kernel="pallas")
