@@ -60,8 +60,10 @@ def main() -> None:
     from p1_tpu.hashx import get_backend
     from p1_tpu.miner import Miner
 
+    from p1_tpu.hashx.jax_backend import is_tpu_platform
+
     platform = jax.default_backend()
-    on_tpu = platform in ("tpu", "axon")
+    on_tpu = is_tpu_platform(platform)
     prefix = make_genesis(20).header.mining_prefix()
 
     cpu_hps = _throughput(get_backend("cpu"), prefix, 1 << 18, repeats=1)

@@ -43,6 +43,14 @@ def default_batch(platform: str | None = None) -> int:
     return _PLATFORM_BATCH.get(p, 1 << 20)
 
 
+def is_tpu_platform(platform: str | None = None) -> bool:
+    """True when ``platform`` is a real TPU (directly or via the axon
+    relay) — the single source of truth for compiled-Mosaic / kernel
+    defaults, so the platform list can't drift between call sites."""
+    p = platform or jax.default_backend()
+    return p in ("tpu", "axon")
+
+
 #: Opening-ramp parameters (see ``PipelinedSearchMixin.search``).  The floor
 #: is sized so a difficulty-20 hit (expected at ~2²⁰ nonces) lands in the
 #: first step with ~98% probability; through the axon relay one dispatch

@@ -134,6 +134,12 @@ def pallas_search_fn(
     block = sub * 128
     if batch % block:
         raise ValueError(f"batch {batch} not a multiple of the {block} tile")
+    if batch >= 1 << 31:
+        # The kernel's first-hit min runs in int32 (Mosaic has no unsigned
+        # reductions): a 2³¹ batch wraps the miss sentinel negative and
+        # silently masks every hit.  Guard at the layer that owns the
+        # constraint so every composer (backends, shard_map) inherits it.
+        raise ValueError(f"batch {batch} must be < 2**31")
     if unroll is None:
         # Interpret mode lowers through XLA:CPU, where a fully-unrolled
         # 128-round trace compiles for minutes (the trap jax_sha256's
@@ -210,9 +216,11 @@ class PallasTPUBackend(PipelinedSearchMixin, HashBackend):
         platform: str | None = None,
         interpret: bool | None = None,
     ):
+        from p1_tpu.hashx.jax_backend import is_tpu_platform
+
         resolved = platform or jax.default_backend()
         if interpret is None:
-            interpret = resolved not in ("tpu", "axon")
+            interpret = not is_tpu_platform(resolved)
         if batch is None:
             # Interpreted runs are for parity tests: keep steps small.
             batch = 1 << 12 if interpret else _DEFAULT_BATCH
@@ -220,9 +228,9 @@ class PallasTPUBackend(PipelinedSearchMixin, HashBackend):
         if batch % block:
             raise ValueError(f"batch {batch} must be a multiple of {block}")
         if batch >= 1 << 31:
-            # The kernel's first-hit min runs in int32 (Mosaic has no
-            # unsigned reductions), so flat indices and the miss sentinel
-            # must stay below 2³¹ — fail here, not at first trace.
+            # Same int32-sentinel bound pallas_search_fn enforces; checked
+            # here too so misconfiguration fails at construction, not at
+            # the first search's trace.
             raise ValueError(f"batch {batch} must be < 2**31")
         if _RAMP_FLOOR % block:
             # Ramp spans are powers of two; a tile that doesn't divide them

@@ -28,7 +28,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from p1_tpu.hashx.backend import HashBackend, register
-from p1_tpu.hashx.jax_backend import PipelinedSearchMixin, StepFn, default_batch
+from p1_tpu.hashx.jax_backend import (
+    PipelinedSearchMixin,
+    StepFn,
+    default_batch,
+    is_tpu_platform,
+)
 from p1_tpu.hashx.jax_sha256 import default_unroll, search_step
 
 _U32 = jnp.uint32
@@ -79,7 +84,7 @@ def jit_sharded_step(
 
         device_search = pallas_search_fn(
             batch_per_device,
-            interpret=platform not in ("tpu", "axon"),
+            interpret=not is_tpu_platform(platform),
             unroll=unroll,
         )
     elif kernel == "xla":
@@ -153,9 +158,9 @@ class ShardedBackend(PipelinedSearchMixin, HashBackend):
             # docs/PERF.md); CPU validation meshes keep the XLA body — the
             # interpreted Pallas kernel is a correctness tool, too slow to
             # be the default 8-virtual-device path.
-            kernel = "pallas" if mesh_platform in ("tpu", "axon") else "xla"
+            kernel = "pallas" if is_tpu_platform(mesh_platform) else "xla"
         if batch is None:
-            if kernel == "pallas" and mesh_platform in ("tpu", "axon"):
+            if kernel == "pallas" and is_tpu_platform(mesh_platform):
                 # The kernel's rate comes from big dispatch-amortizing
                 # steps (docs/PERF.md), not the XLA-carry-sized default.
                 from p1_tpu.hashx.pallas_backend import _DEFAULT_BATCH
@@ -183,6 +188,14 @@ class ShardedBackend(PipelinedSearchMixin, HashBackend):
         self.batch = batch
         self.kernel = kernel
         self.step_span = self.n_devices * batch
+        if self.step_span >= 1 << 32:
+            # jit_sharded_step would reject this at first search; fail at
+            # construction instead (reachable: 32 devices x the 2**27
+            # pallas default).
+            raise ValueError(
+                f"step span {self.step_span} (= {self.n_devices} devices x "
+                f"batch {batch}) must stay below uint32 nonce space"
+            )
         self.unroll = unroll
         # No opening ramp: the per-device batch is baked into the mesh
         # program, and a v5e-8 step is already granular enough per chip.

@@ -37,6 +37,10 @@ from p1_tpu.node.protocol import Hello, MsgType
 log = logging.getLogger("p1_tpu.node")
 
 SYNC_BATCH = 500
+#: Connected-peer cap: the last unbounded per-peer resource (sessions +
+#: writer buffers).  Gossip needs a handful of peers; a dialer flood past
+#: the cap is refused at handshake time.
+MAX_PEERS = 64
 #: Byte budget for one BLOCKS reply — safely under protocol.MAX_FRAME so a
 #: sync reply is never a frame the receiver is guaranteed to reject.
 SYNC_BYTES = 8 << 20
@@ -237,6 +241,8 @@ class Node:
     ) -> None:
         peer = _Peer(writer, label)
         try:
+            if len(self._peers) >= MAX_PEERS:
+                raise ValueError(f"peer limit {MAX_PEERS} reached")
             await peer.send(self._hello())
             payload = await protocol.read_frame(reader)
             mtype, hello = protocol.decode(payload)
@@ -244,6 +250,11 @@ class Node:
                 raise ValueError("expected HELLO")
             if hello.genesis_hash != self.chain.genesis.block_hash():
                 raise ValueError("genesis mismatch")
+            if len(self._peers) >= MAX_PEERS:
+                # Re-check at registration: the pre-handshake check above
+                # races across the two awaits (a flood of simultaneous
+                # dials all pass it while _peers is still small).
+                raise ValueError(f"peer limit {MAX_PEERS} reached")
             self._peers[writer] = peer
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
             if hello.tip_height > self.chain.height:
@@ -448,6 +459,7 @@ class Node:
     def status(self) -> dict:
         """The two BASELINE metrics + node state, JSON-ready."""
         return {
+            "miner_id": self.miner_id,
             "height": self.chain.height,
             "tip": self.chain.tip_hash.hex(),
             "peers": self.peer_count(),

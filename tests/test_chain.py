@@ -394,3 +394,53 @@ class TestPersistence:
         path.write_bytes(b"not a chain store")
         with pytest.raises(ValueError, match="not a chain store"):
             ChainStore(path).load_blocks()
+
+
+class TestForkChoiceProperty:
+    """Randomized property test (SURVEY §5): for ANY block DAG delivered in
+    ANY order, every node converges to the same tip, and that tip is the
+    brute-force best (max cumulative work, lexicographically smallest hash
+    on ties).  Exercises orphan parking, cascaded connects, and reorgs far
+    beyond the hand-written cases."""
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_random_dag_converges_to_brute_force_best(self, seed):
+        import random as rnd
+
+        rng = rnd.Random(seed)
+        diff = 2
+        genesis = make_genesis(diff)
+        blocks = [genesis]
+        heights = {genesis.block_hash(): 0}
+        for i in range(60):
+            parent = rng.choice(blocks)
+            # Distinct sibling blocks via a unique coinbase-style tx.
+            tx = Transaction("coinbase", f"m{seed}", 50, 0, i)
+            child = _mine_child(parent, txs=(tx,), ts_offset=rng.randint(1, 9))
+            blocks.append(child)
+            heights[child.block_hash()] = heights[parent.block_hash()] + 1
+
+        # Brute-force best: max height (fixed difficulty => work ~ height),
+        # tie-break smallest hash.
+        best_h = max(heights.values())
+        expect_tip = min(
+            b.block_hash() for b in blocks if heights[b.block_hash()] == best_h
+        )
+
+        non_genesis = blocks[1:]
+        tips = set()
+        for trial in range(3):
+            order = non_genesis[:]
+            rng.shuffle(order)
+            chain = Chain(diff, genesis=genesis)
+            for block in order:
+                chain.add_block(block)
+            assert chain.height == best_h
+            # Every block must have connected despite arbitrary order.
+            assert len(chain) == len(blocks)
+            # The height index must agree with the tip walk.
+            main = list(chain.main_chain())
+            assert len(main) == best_h + 1
+            assert main[-1].block_hash() == chain.tip_hash
+            tips.add(chain.tip_hash)
+        assert tips == {expect_tip}

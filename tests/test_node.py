@@ -131,6 +131,29 @@ class TestGossip:
         run(scenario())
 
 
+class TestPeerCap:
+    def test_inbound_refused_past_limit(self, monkeypatch):
+        from p1_tpu.node import node as node_mod
+
+        monkeypatch.setattr(node_mod, "MAX_PEERS", 1)
+
+        async def scenario():
+            hub = Node(_config())
+            await hub.start()
+            a = Node(_config(peers=[f"127.0.0.1:{hub.port}"]))
+            await a.start()
+            b = Node(_config(peers=[f"127.0.0.1:{hub.port}"]))
+            await b.start()
+            try:
+                assert await wait_until(lambda: hub.peer_count() == 1)
+                await asyncio.sleep(0.5)  # give b's dial loop time to retry
+                assert hub.peer_count() == 1  # second connection refused
+            finally:
+                await stop_all([hub, a, b])
+
+        run(scenario())
+
+
 class TestMinerIdentity:
     def test_unpeered_miners_diverge(self):
         """Round-2 judge experiment, inverted: two *unconnected* nodes must
