@@ -96,6 +96,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--status-interval", type=float, default=10.0)
 
+    p = sub.add_parser("tx", help="submit a transaction to a running node")
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument("--sender", required=True)
+    p.add_argument("--recipient", required=True)
+    p.add_argument("--amount", type=int, required=True)
+    p.add_argument("--fee", type=int, default=1)
+    p.add_argument(
+        "--seq", type=int, default=0, help="per-sender sequence number"
+    )
+
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
     p.add_argument("--nodes", type=int, default=4)
@@ -357,6 +369,40 @@ def cmd_node(args) -> int:
         return 0
 
 
+# -- tx ------------------------------------------------------------------
+
+
+def cmd_tx(args) -> int:
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.node.client import send_tx
+
+    try:
+        tx = Transaction(
+            args.sender, args.recipient, args.amount, args.fee, args.seq
+        )
+        if tx.is_coinbase:
+            print("coinbase transactions cannot be submitted", file=sys.stderr)
+            return 2
+        height = asyncio.run(
+            send_tx(args.host, args.port, tx, args.difficulty)
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,  # clean close mid-handshake (EOFError)
+    ) as e:
+        print(f"tx submission failed: {e}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(
+            {"config": "tx", "txid": tx.txid().hex(), "peer_height": height}
+        )
+    )
+    return 0
+
+
 # -- net -----------------------------------------------------------------
 
 
@@ -461,6 +507,7 @@ def main(argv=None) -> int:
         "sweep": cmd_sweep,
         "replay": cmd_replay,
         "node": cmd_node,
+        "tx": cmd_tx,
         "net": cmd_net,
         "bench": cmd_bench,
     }[args.cmd]

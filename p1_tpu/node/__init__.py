@@ -1,4 +1,5 @@
+from p1_tpu.node.client import send_tx
 from p1_tpu.node.node import Node, NodeMetrics
 from p1_tpu.node.protocol import Hello, MsgType
 
-__all__ = ["Node", "NodeMetrics", "Hello", "MsgType"]
+__all__ = ["Node", "NodeMetrics", "Hello", "MsgType", "send_tx"]

@@ -1,0 +1,56 @@
+"""Thin p2p client: inject a transaction into a running node.
+
+Capability parity: a usable mempool needs an entry point for transactions
+from outside the node process (BASELINE.json:5 names the mempool; without
+this, only miners' own processes could ever create payload for blocks).
+The client speaks one round of the ordinary peer protocol — HELLO exchange
+(validating genesis, i.e. that both sides run the same chain parameters),
+then a single TX frame — and disconnects; the receiving node gossips the
+transaction onward like any other.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from p1_tpu.core.genesis import make_genesis
+from p1_tpu.core.tx import Transaction
+from p1_tpu.node import protocol
+from p1_tpu.node.protocol import Hello, MsgType
+
+
+async def send_tx(
+    host: str, port: int, tx: Transaction, difficulty: int, timeout: float = 10.0
+) -> int:
+    """Push ``tx`` to the node at host:port; return the node's tip height.
+
+    ``difficulty`` selects the chain (it determines the genesis block the
+    HELLO handshake validates against); a mismatch raises ValueError
+    rather than silently feeding a transaction to the wrong network.
+    """
+
+    async def _run() -> int:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            genesis_hash = make_genesis(difficulty).block_hash()
+            await protocol.write_frame(
+                writer, protocol.encode_hello(Hello(genesis_hash, 0, 0))
+            )
+            mtype, hello = protocol.decode(await protocol.read_frame(reader))
+            if mtype is not MsgType.HELLO:
+                raise ValueError("node did not HELLO")
+            if hello.genesis_hash != genesis_hash:
+                raise ValueError(
+                    "genesis mismatch: node runs a different chain "
+                    "(check --difficulty)"
+                )
+            await protocol.write_frame(writer, protocol.encode_tx(tx))
+            return hello.tip_height
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    return await asyncio.wait_for(_run(), timeout)

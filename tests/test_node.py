@@ -131,6 +131,56 @@ class TestGossip:
         run(scenario())
 
 
+class TestTxClient:
+    def test_submit_propagates_and_mines(self):
+        from p1_tpu.node.client import send_tx
+
+        async def scenario():
+            nodes = await start_mesh(2)
+            try:
+                assert await wait_until(lambda: nodes[1].peer_count())
+                tx = Transaction("alice", "bob", 7, 1, 0)
+                height = await send_tx(
+                    "127.0.0.1", nodes[0].port, tx, DIFF
+                )
+                assert height == 0
+                # reaches the directly-connected node AND its peer
+                assert await wait_until(lambda: tx.txid() in nodes[0].mempool)
+                assert await wait_until(lambda: tx.txid() in nodes[1].mempool)
+                # ... and ends up in a mined block
+                nodes[1].start_mining()
+                assert await wait_until(
+                    lambda: tx.txid() not in nodes[1].mempool
+                )
+                await nodes[1].stop_mining()
+                mined = [
+                    b
+                    for b in nodes[1].chain.main_chain()
+                    if any(t.txid() == tx.txid() for t in b.txs)
+                ]
+                assert mined, "submitted tx never mined"
+            finally:
+                await stop_all(nodes)
+
+        run(scenario())
+
+    def test_wrong_chain_rejected(self):
+        from p1_tpu.node.client import send_tx
+
+        async def scenario():
+            a = Node(_config())
+            await a.start()
+            try:
+                tx = Transaction("alice", "bob", 7, 1, 0)
+                with pytest.raises(ValueError, match="genesis mismatch"):
+                    await send_tx("127.0.0.1", a.port, tx, DIFF + 1)
+                assert tx.txid() not in a.mempool
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+
 class TestPeerCap:
     def test_inbound_refused_past_limit(self, monkeypatch):
         from p1_tpu.node import node as node_mod
