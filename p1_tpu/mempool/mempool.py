@@ -2,23 +2,49 @@
 
 Capability parity: the reference's mempool (BASELINE.json:5).  Fee-priority
 selection with insertion-order tie-breaks (deterministic for tests), txid
-dedup for gossip, eviction of mined transactions, and resurrection of
-transactions from blocks a reorg abandoned — wired to the removed/added
-paths ``Chain.add_block`` reports.
+dedup for gossip, **per-(sender, seq) replay suppression with
+replace-by-fee** (the ``seq`` field's documented purpose — see
+``Transaction.seq`` in core/tx.py: two competing spends of one sequence
+slot never sit in the pool together, the higher fee wins, and slots
+confirmed within a bounded recent window are refused re-entry), eviction
+of mined transactions, and resurrection of transactions from blocks a
+reorg abandoned — wired to the removed/added paths ``Chain.add_block``
+reports.
+
+Scope note: this is *pool-level anti-spam*, not consensus.  The chain
+itself carries no account state, so a spend of a long-ago-confirmed seq
+(older than the confirmed-slot window) is not invalid at block level —
+bounded memory is traded for a bounded suppression window.
 """
 
 from __future__ import annotations
 
+import collections
+
 from p1_tpu.core.block import Block
 from p1_tpu.core.tx import Transaction
 
+#: How many recently-confirmed (sender, seq) slots to remember (FIFO).
+#: A replayed spend of a confirmed slot is refused while the slot is in
+#: the window — sized to cover any realistic gossip-reordering horizon.
+CONFIRMED_SLOT_WINDOW = 16_384
+
 
 class Mempool:
-    """Txid-keyed pending-transaction pool."""
+    """Txid-keyed pending-transaction pool with per-(sender, seq) slots."""
 
     def __init__(self, max_txs: int = 100_000):
         self.max_txs = max_txs
         self._txs: dict[bytes, Transaction] = {}  # insertion-ordered
+        self._by_slot: dict[tuple[str, int], bytes] = {}  # (sender, seq) -> txid
+        #: FIFO window of recently confirmed slots -> confirmation count.
+        #: Counted, not a set: nothing validates per-chain slot uniqueness,
+        #: so one slot can be confirmed by several connected blocks and a
+        #: partial reorg must not reopen it while another confirmation
+        #: still stands.
+        self._confirmed_slots: collections.OrderedDict[
+            tuple[str, int], int
+        ] = collections.OrderedDict()
 
     def __len__(self) -> int:
         return len(self._txs)
@@ -27,20 +53,58 @@ class Mempool:
         return txid in self._txs
 
     def add(self, tx: Transaction) -> bool:
-        """Admit ``tx``; False if coinbase, already known, or the pool is full.
+        """Admit ``tx``; False if coinbase, already known, outbid, or full.
 
         Coinbases never belong in a mempool: they are minted per block by
         the assembling miner, so a gossiped one is invalid and a reorg's
         resurrection path (``apply_block_delta``) must drop the abandoned
         branch's rewards rather than re-mine them into the new branch.
+
+        A transaction occupying an already-pending (sender, seq) slot must
+        strictly outbid the incumbent's fee to replace it (replace-by-fee;
+        fees are integers, so "strictly more" is an absolute bump of >= 1 —
+        an N-replacement gossip flood costs the attacker an N-unit fee,
+        keeping amplification linear-cost).  Replacement frees the
+        incumbent's capacity, so it works even when the pool is otherwise
+        full.  A slot confirmed within the recent window is refused
+        outright — a reordered or replayed spend of it can't re-enter.
         """
         if tx.is_coinbase:
             return False
         txid = tx.txid()
-        if txid in self._txs or len(self._txs) >= self.max_txs:
+        if txid in self._txs:
+            return False
+        slot = (tx.sender, tx.seq)
+        if slot in self._confirmed_slots:
+            return False
+        incumbent = self._by_slot.get(slot)
+        if incumbent is not None:
+            if tx.fee <= self._txs[incumbent].fee:
+                return False
+            del self._txs[incumbent]
+        elif len(self._txs) >= self.max_txs:
             return False
         self._txs[txid] = tx
+        self._by_slot[slot] = txid
         return True
+
+    def _evict(self, tx: Transaction) -> None:
+        """Mark ``tx``'s (sender, seq) slot confirmed: its pending occupant
+        (``tx`` itself or a rival spend) leaves the pool, and the slot
+        enters the bounded confirmed window so late replays are refused.
+
+        (Any tx present in ``_txs`` is its slot's occupant — the maintained
+        invariant — so the slot pop alone removes it.)
+        """
+        occupant = self._by_slot.pop((tx.sender, tx.seq), None)
+        if occupant is not None:
+            self._txs.pop(occupant, None)
+        if not tx.is_coinbase:  # coinbase slots can never re-enter anyway
+            slot = (tx.sender, tx.seq)
+            self._confirmed_slots[slot] = self._confirmed_slots.get(slot, 0) + 1
+            self._confirmed_slots.move_to_end(slot)
+            while len(self._confirmed_slots) > CONFIRMED_SLOT_WINDOW:
+                self._confirmed_slots.popitem(last=False)
 
     def select(self, max_txs: int = 1000) -> list[Transaction]:
         """Highest-fee-first block candidates (insertion order on ties —
@@ -61,7 +125,17 @@ class Mempool:
         """
         for block in removed:
             for tx in block.txs:
+                # ONE confirmation of this slot is being rolled back; the
+                # slot reopens only when no other connected block still
+                # confirms it (hence the count, not a set-discard).
+                slot = (tx.sender, tx.seq)
+                count = self._confirmed_slots.get(slot)
+                if count is not None:
+                    if count <= 1:
+                        del self._confirmed_slots[slot]
+                    else:
+                        self._confirmed_slots[slot] = count - 1
                 self.add(tx)
         for block in added:
             for tx in block.txs:
-                self._txs.pop(tx.txid(), None)
+                self._evict(tx)

@@ -371,6 +371,72 @@ class TestMempoolUnit:
         assert not pool.add(cheap)  # dedup
         assert pool.select() == [rich, cheap]
 
+    def test_replace_by_fee_on_same_slot(self):
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        cheap = Transaction("alice", "bob", 5, 1, 7)
+        rich = Transaction("alice", "carol", 5, 3, 7)  # same (sender, seq)
+        equal = Transaction("alice", "dave", 5, 3, 7)
+        assert pool.add(cheap)
+        assert pool.add(rich)  # outbids -> replaces
+        assert cheap.txid() not in pool and rich.txid() in pool
+        assert not pool.add(equal)  # must STRICTLY outbid
+        assert not pool.add(cheap)  # replay of an outbid tx
+        assert len(pool) == 1
+        # independent slots coexist
+        assert pool.add(Transaction("alice", "bob", 5, 1, 8))
+        assert len(pool) == 2
+
+    def test_confirmation_evicts_slot_rivals(self):
+        from p1_tpu.core.block import Block, merkle_root
+        from p1_tpu.core.header import BlockHeader
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        confirmed = Transaction("alice", "bob", 5, 1, 7)
+        rival = Transaction("alice", "carol", 5, 9, 7)
+        assert pool.add(rival)
+        # A block confirms the OTHER spend of slot (alice, 7): the pending
+        # rival is now a replay and must leave the pool with it.
+        header = BlockHeader(
+            1, bytes(32), merkle_root([confirmed.txid()]), 1, DIFF, 0
+        )
+        pool.apply_block_delta((), (Block(header, (confirmed,)),))
+        assert rival.txid() not in pool and len(pool) == 0
+
+    def test_rbf_bypasses_full_pool_capacity(self):
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool(max_txs=1)
+        assert pool.add(Transaction("alice", "bob", 5, 1, 7))
+        # Same slot, higher fee: replacement frees the incumbent's
+        # capacity, so it is admitted even though the pool is full...
+        assert pool.add(Transaction("alice", "carol", 5, 2, 7))
+        # ...while a NEW slot is refused for capacity.
+        assert not pool.add(Transaction("dave", "erin", 5, 9, 0))
+        assert len(pool) == 1
+
+    def test_confirmed_slot_refuses_late_replay(self):
+        from p1_tpu.core.block import Block, merkle_root
+        from p1_tpu.core.header import BlockHeader
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        confirmed = Transaction("alice", "bob", 5, 1, 7)
+        header = BlockHeader(
+            1, bytes(32), merkle_root([confirmed.txid()]), 1, DIFF, 0
+        )
+        block = Block(header, (confirmed,))
+        pool.apply_block_delta((), (block,))
+        # A spend of the confirmed slot arriving AFTER confirmation (gossip
+        # reorder) is refused, whatever its fee.
+        late = Transaction("alice", "mallory", 5, 99, 7)
+        assert not pool.add(late)
+        # ... until a reorg rolls the confirmation back.
+        pool.apply_block_delta((block,), ())
+        assert confirmed.txid() in pool
+
     def test_coinbase_never_enters_pool(self):
         from p1_tpu.core.block import Block, merkle_root
         from p1_tpu.core.header import BlockHeader
