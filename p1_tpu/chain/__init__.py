@@ -1,4 +1,5 @@
 from p1_tpu.chain.chain import AddResult, AddStatus, Chain
+from p1_tpu.chain.ledger import balances
 from p1_tpu.chain.replay import (
     ReplayReport,
     generate_headers,
@@ -15,6 +16,7 @@ __all__ = [
     "ChainStore",
     "ReplayReport",
     "ValidationError",
+    "balances",
     "check_block",
     "generate_headers",
     "replay_device",

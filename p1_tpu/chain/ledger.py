@@ -1,0 +1,43 @@
+"""Ledger view: account balances derived from the main chain.
+
+Capability parity: the reference is "a Bitcoin-like toy cryptocurrency"
+(BASELINE.json:5 via SURVEY.md §0) — a currency needs a way to ask who
+owns what.  This is a pure *view* over the chain's account model: coinbase
+credits the miner the block reward, a transfer debits sender by
+amount + fee and credits the recipient, and fees go to the block's miner
+(its coinbase recipient) or are burned for the rare coinbase-less block.
+
+Deliberately NOT consensus: chain validation does not enforce
+non-negative balances (the chain carries no account state — see the
+mempool scope note), so a balance can legitimately print negative here;
+that is information about the chain, not an error in the view.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from p1_tpu.core.block import Block
+
+
+def balances(blocks: Iterable[Block]) -> dict[str, int]:
+    """Account -> balance over ``blocks`` (pass ``chain.main_chain()``)."""
+    out: dict[str, int] = {}
+
+    def credit(account: str, amount: int) -> None:
+        out[account] = out.get(account, 0) + amount
+
+    for block in blocks:
+        miner = None
+        fees = 0
+        for i, tx in enumerate(block.txs):
+            if i == 0 and tx.is_coinbase:
+                miner = tx.recipient
+                credit(miner, tx.amount)
+                continue
+            credit(tx.sender, -(tx.amount + tx.fee))
+            credit(tx.recipient, tx.amount)
+            fees += tx.fee
+        if miner is not None and fees:
+            credit(miner, fees)
+    return out

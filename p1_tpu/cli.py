@@ -108,6 +108,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--seq", type=int, default=0, help="per-sender sequence number"
     )
 
+    p = sub.add_parser(
+        "balances", help="account balances from a persisted chain"
+    )
+    p.add_argument(
+        "--difficulty",
+        type=int,
+        default=None,
+        help="chain selector (default: inferred from the store's records)",
+    )
+    p.add_argument("--store", required=True, help="chain persistence path")
+    p.add_argument(
+        "--account", default=None, help="print one account instead of all"
+    )
+
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
     p.add_argument("--nodes", type=int, default=4)
@@ -403,6 +417,57 @@ def cmd_tx(args) -> int:
     return 0
 
 
+# -- balances ------------------------------------------------------------
+
+
+def cmd_balances(args) -> int:
+    from p1_tpu.chain import ChainStore, balances
+
+    store = ChainStore(args.store)
+    try:
+        blocks = store.load_blocks()
+        if not blocks:
+            print(f"{args.store}: empty or missing chain store", file=sys.stderr)
+            return 2
+        # Every stored block declares the chain difficulty (validation
+        # enforces it), so the store is self-describing — a wrong flag
+        # would otherwise silently report an empty ledger at height 0.
+        stored = blocks[0].header.difficulty
+        if args.difficulty is not None and args.difficulty != stored:
+            print(
+                f"--difficulty {args.difficulty} does not match the store's "
+                f"chain (difficulty {stored})",
+                file=sys.stderr,
+            )
+            return 2
+        chain = store.load_chain(stored)
+    finally:
+        store.close()
+    ledger = balances(chain.main_chain())
+    if args.account is not None:
+        print(
+            json.dumps(
+                {
+                    "config": "balances",
+                    "height": chain.height,
+                    "account": args.account,
+                    "balance": ledger.get(args.account, 0),
+                }
+            )
+        )
+        return 0
+    print(
+        json.dumps(
+            {
+                "config": "balances",
+                "height": chain.height,
+                "balances": dict(sorted(ledger.items())),
+            }
+        )
+    )
+    return 0
+
+
 # -- net -----------------------------------------------------------------
 
 
@@ -508,6 +573,7 @@ def main(argv=None) -> int:
         "replay": cmd_replay,
         "node": cmd_node,
         "tx": cmd_tx,
+        "balances": cmd_balances,
         "net": cmd_net,
         "bench": cmd_bench,
     }[args.cmd]

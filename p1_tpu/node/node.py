@@ -44,6 +44,9 @@ MAX_PEERS = 64
 #: Byte budget for one BLOCKS reply — safely under protocol.MAX_FRAME so a
 #: sync reply is never a frame the receiver is guaranteed to reject.
 SYNC_BYTES = 8 << 20
+#: Caps for one MEMPOOL sync reply (count and encoded bytes).
+MEMPOOL_SYNC_TXS = 2000
+MEMPOOL_SYNC_BYTES = 2 << 20
 RECONNECT_DELAY_S = 0.5
 GOSSIP_SEND_TIMEOUT_S = 5.0
 
@@ -259,6 +262,9 @@ class Node:
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
             if hello.tip_height > self.chain.height:
                 await peer.send(protocol.encode_getblocks(self.chain.locator()))
+            # Learn the peer's pending transactions too: block sync alone
+            # would leave a late joiner's pool empty until fresh gossip.
+            await peer.send(protocol.encode_getmempool())
             while self._running:
                 payload = await protocol.read_frame(reader)
                 await self._dispatch(peer, payload)
@@ -300,6 +306,29 @@ class Node:
             # more behind it (an empty/duplicate reply ends the loop).
             if accepted_any and body:
                 await peer.send(protocol.encode_getblocks(self.chain.locator()))
+        elif mtype is MsgType.GETMEMPOOL:
+            offset = body
+            ranked = self.mempool.select(offset + MEMPOOL_SYNC_TXS)[offset:]
+            txs, total = [], 0
+            for tx in ranked:
+                total += len(tx.serialize()) + 2
+                if txs and total > MEMPOOL_SYNC_BYTES:
+                    break
+                txs.append(tx)
+            consumed = offset + len(txs)
+            # Continuation cursor: fee-rank is stable between requests
+            # (barring churn), so paging by offset delivers the whole pool
+            # instead of silently truncating at one reply.
+            next_offset = consumed if len(self.mempool) > consumed else 0
+            await peer.send(protocol.encode_mempool(txs, next_offset))
+        elif mtype is MsgType.MEMPOOL:
+            next_offset, txs = body
+            for tx in txs:
+                await self._handle_tx(tx, origin=peer)
+            # Empty-batch guard: a hostile next_offset with no progress
+            # must not ping-pong forever.
+            if next_offset and txs:
+                await peer.send(protocol.encode_getmempool(next_offset))
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
 

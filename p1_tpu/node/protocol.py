@@ -13,6 +13,13 @@ Messages:
 - TX:        one serialized transaction (push gossip).
 - GETBLOCKS: u16 count + count * 32-byte locator hashes (sync request).
 - BLOCKS:    u16 count + count * (u32 len + serialized block) (sync reply).
+- GETMEMPOOL: u32 offset — request the peer's pending transactions from
+             that position of its fee-ranked pool.
+- MEMPOOL:   u32 next_offset (0 = no more) + u16 count +
+             count * (u16 len + serialized tx).  Late joiners learn
+             in-flight transactions this way (blocks-only sync would leave
+             their pools empty until fresh gossip); pools larger than one
+             reply continue via next_offset instead of silently truncating.
 """
 
 from __future__ import annotations
@@ -36,6 +43,8 @@ class MsgType(enum.IntEnum):
     TX = 3
     GETBLOCKS = 4
     BLOCKS = 5
+    GETMEMPOOL = 6
+    MEMPOOL = 7
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +83,24 @@ def encode_blocks(blocks: list[Block]) -> bytes:
     for block in blocks:
         raw = block.serialize()
         parts.append(_LEN.pack(len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def encode_getmempool(offset: int = 0) -> bytes:
+    return bytes([MsgType.GETMEMPOOL]) + struct.pack(">I", offset)
+
+
+def encode_mempool(txs: list[Transaction], next_offset: int = 0) -> bytes:
+    if len(txs) > 0xFFFF:
+        raise ValueError("too many transactions for one MEMPOOL frame")
+    parts = [
+        bytes([MsgType.MEMPOOL]),
+        struct.pack(">IH", next_offset, len(txs)),
+    ]
+    for tx in txs:
+        raw = tx.serialize()
+        parts.append(struct.pack(">H", len(raw)))
         parts.append(raw)
     return b"".join(parts)
 
@@ -121,6 +148,28 @@ def decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in BLOCKS")
         return mtype, blocks
+    if mtype is MsgType.GETMEMPOOL:
+        if len(body) != 4:
+            raise ValueError("bad GETMEMPOOL")
+        return mtype, struct.unpack(">I", body)[0]
+    if mtype is MsgType.MEMPOOL:
+        if len(body) < 6:
+            raise ValueError("bad MEMPOOL")
+        next_offset, n = struct.unpack_from(">IH", body)
+        off = 6
+        txs = []
+        for _ in range(n):
+            if len(body) < off + 2:
+                raise ValueError("truncated MEMPOOL")
+            (tlen,) = struct.unpack_from(">H", body, off)
+            off += 2
+            if len(body) < off + tlen:
+                raise ValueError("truncated MEMPOOL entry")
+            txs.append(Transaction.deserialize(body[off : off + tlen]))
+            off += tlen
+        if off != len(body):
+            raise ValueError("trailing bytes in MEMPOOL")
+        return mtype, (next_offset, txs)
     raise AssertionError(mtype)
 
 

@@ -396,6 +396,62 @@ class TestPersistence:
             ChainStore(path).load_blocks()
 
 
+class TestLedger:
+    def test_balances_over_mined_chain(self):
+        from p1_tpu.chain import balances
+
+        genesis = make_genesis(DIFF)
+        cb1 = Transaction.coinbase("alice", 1)
+        b1 = _mine_child(genesis, txs=(cb1,))
+        # alice pays bob 20 (fee 2) in a block mined by carol.
+        cb2 = Transaction.coinbase("carol", 2)
+        pay = Transaction("alice", "bob", 20, 2, 0)
+        b2 = _mine_child(b1, txs=(cb2, pay))
+        ledger = balances([genesis, b1, b2])
+        assert ledger["alice"] == 50 - 20 - 2
+        assert ledger["bob"] == 20
+        assert ledger["carol"] == 50 + 2  # reward + fees
+        assert sum(ledger.values()) == 100  # rewards minted, fees conserved
+
+    def test_coinbase_less_block_burns_fees(self):
+        from p1_tpu.chain import balances
+
+        genesis = make_genesis(DIFF)
+        pay = Transaction("alice", "bob", 5, 3, 0)
+        b1 = _mine_child(genesis, txs=(pay,))
+        ledger = balances([genesis, b1])
+        assert ledger["alice"] == -8 and ledger["bob"] == 5
+        assert sum(ledger.values()) == -3  # the fee is burned
+
+    def test_cli_balances_from_store(self, tmp_path):
+        import json as json_mod
+        import subprocess
+        import sys
+
+        from p1_tpu.chain import Chain, save_chain
+
+        genesis = make_genesis(DIFF)
+        chain = Chain(DIFF, genesis=genesis)
+        cb = Transaction.coinbase("alice", 1)
+        chain.add_block(_mine_child(genesis, txs=(cb,)))
+        store = tmp_path / "chain.dat"
+        save_chain(chain, store)
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "balances",
+                "--store", str(store), "--difficulty", str(DIFF),
+                "--account", "alice",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json_mod.loads(proc.stdout.strip())
+        assert out["balance"] == 50 and out["height"] == 1
+
+
 class TestForkChoiceProperty:
     """Randomized property test (SURVEY §5): for ANY block DAG delivered in
     ANY order, every node converges to the same tip, and that tip is the

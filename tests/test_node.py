@@ -290,6 +290,57 @@ class TestConvergence:
 
         run(scenario())
 
+    def test_late_joiner_learns_large_mempool_in_pages(self, monkeypatch):
+        from p1_tpu.node import node as node_mod
+
+        monkeypatch.setattr(node_mod, "MEMPOOL_SYNC_TXS", 3)
+
+        async def scenario():
+            a = Node(_config())
+            await a.start()
+            try:
+                txs = [
+                    Transaction("alice", "bob", 5, f + 1, f) for f in range(8)
+                ]
+                for tx in txs:
+                    await a.submit_tx(tx)
+                b = Node(_config(peers=[f"127.0.0.1:{a.port}"]))
+                await b.start()
+                try:
+                    # 8 txs at 3 per page: continuation must deliver ALL.
+                    assert await wait_until(
+                        lambda: all(tx.txid() in b.mempool for tx in txs)
+                    )
+                finally:
+                    await b.stop()
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+    def test_late_joiner_learns_mempool(self):
+        async def scenario():
+            a = Node(_config())
+            await a.start()
+            try:
+                txs = [Transaction("alice", "bob", 5, f, 0 + f) for f in (1, 2, 3)]
+                for tx in txs:
+                    await a.submit_tx(tx)
+                # b joins AFTER the txs exist; block sync alone would leave
+                # its pool empty.
+                b = Node(_config(peers=[f"127.0.0.1:{a.port}"]))
+                await b.start()
+                try:
+                    assert await wait_until(
+                        lambda: all(tx.txid() in b.mempool for tx in txs)
+                    )
+                finally:
+                    await b.stop()
+            finally:
+                await a.stop()
+
+        run(scenario())
+
     def test_late_joiner_syncs(self):
         async def scenario():
             a = Node(_config(mine=True))
