@@ -83,12 +83,17 @@ class ChainStore:
             off += _LEN.size + n
         return out
 
-    def load_chain(self, difficulty: int) -> Chain:
+    def load_chain(
+        self, difficulty: int, blocks: list[Block] | None = None
+    ) -> Chain:
         """Rebuild a validated chain from the log (skipping the genesis
-        record, which the Chain constructor provides)."""
+        record, which the Chain constructor provides).  Pass ``blocks``
+        when the caller already ran ``load_blocks`` (avoids a second full
+        read+parse of the log)."""
         chain = Chain(difficulty)
-        for block in self.load_blocks():
-            if block.block_hash() == chain.genesis.block_hash():
+        ghash = chain.genesis.block_hash()
+        for block in self.load_blocks() if blocks is None else blocks:
+            if block.block_hash() == ghash:
                 continue
             chain.add_block(block)
         return chain

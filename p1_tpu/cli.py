@@ -426,23 +426,23 @@ def cmd_balances(args) -> int:
     store = ChainStore(args.store)
     try:
         blocks = store.load_blocks()
-        if not blocks:
-            print(f"{args.store}: empty or missing chain store", file=sys.stderr)
-            return 2
-        # Every stored block declares the chain difficulty (validation
-        # enforces it), so the store is self-describing — a wrong flag
-        # would otherwise silently report an empty ledger at height 0.
-        stored = blocks[0].header.difficulty
-        if args.difficulty is not None and args.difficulty != stored:
-            print(
-                f"--difficulty {args.difficulty} does not match the store's "
-                f"chain (difficulty {stored})",
-                file=sys.stderr,
-            )
-            return 2
-        chain = store.load_chain(stored)
     finally:
         store.close()
+    if not blocks:
+        print(f"{args.store}: empty or missing chain store", file=sys.stderr)
+        return 2
+    # Every stored block declares the chain difficulty (validation
+    # enforces it), so the store is self-describing — a wrong flag
+    # would otherwise silently report an empty ledger at height 0.
+    stored = blocks[0].header.difficulty
+    if args.difficulty is not None and args.difficulty != stored:
+        print(
+            f"--difficulty {args.difficulty} does not match the store's "
+            f"chain (difficulty {stored})",
+            file=sys.stderr,
+        )
+        return 2
+    chain = ChainStore(args.store).load_chain(stored, blocks)
     ledger = balances(chain.main_chain())
     if args.account is not None:
         print(

@@ -1,3 +1,3 @@
-from p1_tpu.mempool.mempool import Mempool
+from p1_tpu.mempool.mempool import Mempool, sync_key
 
-__all__ = ["Mempool"]
+__all__ = ["Mempool", "sync_key"]

@@ -24,6 +24,14 @@ import collections
 from p1_tpu.core.block import Block
 from p1_tpu.core.tx import Transaction
 
+def sync_key(fee: int, txid: bytes) -> tuple[int, bytes]:
+    """The mempool-sync page ordering: fee-descending, txid-ascending.
+    One definition shared by the pager and both requester-side cursor
+    computations (continuation pick + strictly-advancing check) so the
+    ordering cannot drift between sites."""
+    return (-fee, txid)
+
+
 #: How many recently-confirmed (sender, seq) slots to remember (FIFO).
 #: A replayed spend of a confirmed slot is refused while the slot is in
 #: the window — sized to cover any realistic gossip-reordering horizon.
@@ -105,6 +113,32 @@ class Mempool:
             self._confirmed_slots.move_to_end(slot)
             while len(self._confirmed_slots) > CONFIRMED_SLOT_WINDOW:
                 self._confirmed_slots.popitem(last=False)
+
+    def sync_page(
+        self, cursor: tuple[int, bytes] | None, max_txs: int
+    ) -> tuple[list[Transaction], bool]:
+        """One page of the pool for peer sync: fee-descending (txid-ascending
+        on ties), strictly after ``cursor`` = (fee, txid) of the last
+        transaction the requester already has.  Returns (page, more).
+
+        The cursor is a *stable key*, not a position: evictions and
+        replacements between pages can't shift unseen transactions behind
+        it (a positional offset would silently skip them under churn), and
+        transactions added mid-sync reach the requester through normal TX
+        gossip since it is a connected peer by then.
+        """
+        import heapq
+
+        def key(item: tuple[bytes, Transaction]) -> tuple[int, bytes]:
+            txid, tx = item
+            return sync_key(tx.fee, txid)
+
+        ckey = sync_key(*cursor) if cursor is not None else None
+        eligible = [
+            item for item in self._txs.items() if ckey is None or key(item) > ckey
+        ]
+        page = heapq.nsmallest(max_txs, eligible, key=key)
+        return [tx for _, tx in page], len(eligible) > len(page)
 
     def select(self, max_txs: int = 1000) -> list[Transaction]:
         """Highest-fee-first block candidates (insertion order on ties —

@@ -74,6 +74,10 @@ class _Peer:
         self.writer = writer
         self.label = label
         self.synced_once = False
+        #: (fee, txid) of the last mempool-sync tx received from this peer;
+        #: must strictly advance in key order or the sync stops (hostile
+        #: responders can't loop us).
+        self.mempool_cursor: tuple[int, bytes] | None = None
 
     async def send(self, payload: bytes) -> None:
         await protocol.write_frame(self.writer, payload)
@@ -296,7 +300,7 @@ class Node:
                 if capped and total > SYNC_BYTES:
                     break
                 capped.append(blk)
-            await peer.send(protocol.encode_blocks(capped))
+            await self._send_guarded(peer, protocol.encode_blocks(capped))
         elif mtype is MsgType.BLOCKS:
             accepted_any = False
             for block in body:
@@ -305,48 +309,62 @@ class Node:
             # Progress was made and the batch was non-empty: there may be
             # more behind it (an empty/duplicate reply ends the loop).
             if accepted_any and body:
-                await peer.send(protocol.encode_getblocks(self.chain.locator()))
+                await self._send_guarded(
+                    peer, protocol.encode_getblocks(self.chain.locator())
+                )
         elif mtype is MsgType.GETMEMPOOL:
-            offset = body
-            ranked = self.mempool.select(offset + MEMPOOL_SYNC_TXS)[offset:]
-            txs, total = [], 0
-            for tx in ranked:
-                total += len(tx.serialize()) + 2
-                if txs and total > MEMPOOL_SYNC_BYTES:
+            page, more = self.mempool.sync_page(body, MEMPOOL_SYNC_TXS)
+            raws, total = [], 0
+            for tx in page:
+                raw = tx.serialize()
+                total += len(raw) + 2
+                if raws and total > MEMPOOL_SYNC_BYTES:
+                    more = True  # byte-trimmed: the rest is still out there
                     break
-                txs.append(tx)
-            consumed = offset + len(txs)
-            # Continuation cursor: fee-rank is stable between requests
-            # (barring churn), so paging by offset delivers the whole pool
-            # instead of silently truncating at one reply.
-            next_offset = consumed if len(self.mempool) > consumed else 0
-            await peer.send(protocol.encode_mempool(txs, next_offset))
+                raws.append(raw)
+            await self._send_guarded(peer, protocol.encode_mempool(raws, more))
         elif mtype is MsgType.MEMPOOL:
-            next_offset, txs = body
+            more, txs = body
             for tx in txs:
                 await self._handle_tx(tx, origin=peer)
-            # Empty-batch guard: a hostile next_offset with no progress
-            # must not ping-pong forever.
-            if next_offset and txs:
-                await peer.send(protocol.encode_getmempool(next_offset))
+            if more and txs:
+                # Continue from the largest key received, and only if it
+                # strictly advances — key-ordering is (-fee, txid), so a
+                # responder replaying old keys can't spin the sync.
+                from p1_tpu.mempool import sync_key
+
+                last = max(txs, key=lambda t: sync_key(t.fee, t.txid()))
+                cursor = (last.fee, last.txid())
+                prev = peer.mempool_cursor
+                if prev is None or sync_key(*cursor) > sync_key(*prev):
+                    peer.mempool_cursor = cursor
+                    await self._send_guarded(
+                        peer, protocol.encode_getmempool(cursor)
+                    )
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
+
+    async def _send_guarded(self, peer: _Peer, payload: bytes) -> None:
+        """Reply/continuation send with a timeout: a peer that stops
+        reading while we block in drain() must not wedge the dispatch
+        loop.  Without this, two peers answering each other's sync
+        requests with multi-MB replies can fill both transport buffers
+        and deadlock — a stalled peer is dropped instead."""
+        try:
+            await asyncio.wait_for(
+                peer.send(payload), timeout=GOSSIP_SEND_TIMEOUT_S
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            peer.writer.close()  # reader loop will reap it
 
     async def _gossip(self, payload: bytes, skip: _Peer | None = None) -> None:
         """Send to all peers concurrently; a stalled peer times out and is
         dropped instead of blocking propagation (and the mining loop)."""
-
-        async def send_one(peer: _Peer) -> None:
-            try:
-                await asyncio.wait_for(
-                    peer.send(payload), timeout=GOSSIP_SEND_TIMEOUT_S
-                )
-            except (ConnectionError, OSError, asyncio.TimeoutError):
-                peer.writer.close()  # reader loop will reap it
-
         targets = [p for p in self._peers.values() if p is not skip]
         if targets:
-            await asyncio.gather(*(send_one(p) for p in targets))
+            await asyncio.gather(
+                *(self._send_guarded(p, payload) for p in targets)
+            )
 
     # -- chain/mempool handlers -----------------------------------------
 
@@ -377,7 +395,9 @@ class Node:
             if gossip:
                 await self._gossip(protocol.encode_block(block), skip=origin)
         elif res.status is AddStatus.ORPHAN and origin is not None:
-            await origin.send(protocol.encode_getblocks(self.chain.locator()))
+            await self._send_guarded(
+                origin, protocol.encode_getblocks(self.chain.locator())
+            )
         elif res.status is AddStatus.REJECTED:
             self.metrics.blocks_rejected += 1
             log.warning(

@@ -13,13 +13,17 @@ Messages:
 - TX:        one serialized transaction (push gossip).
 - GETBLOCKS: u16 count + count * 32-byte locator hashes (sync request).
 - BLOCKS:    u16 count + count * (u32 len + serialized block) (sync reply).
-- GETMEMPOOL: u32 offset — request the peer's pending transactions from
-             that position of its fee-ranked pool.
-- MEMPOOL:   u32 next_offset (0 = no more) + u16 count +
-             count * (u16 len + serialized tx).  Late joiners learn
-             in-flight transactions this way (blocks-only sync would leave
-             their pools empty until fresh gossip); pools larger than one
-             reply continue via next_offset instead of silently truncating.
+- GETMEMPOOL: empty body (start of sync) or u64 fee + 32-byte txid — the
+             stable cursor of the last transaction already received; the
+             reply covers fee-descending (txid-ascending) keys strictly
+             after it.
+- MEMPOOL:   u8 more + u16 count + count * (u16 len + serialized tx).
+             Late joiners learn in-flight transactions this way
+             (blocks-only sync would leave their pools empty); pools
+             larger than one reply continue while ``more`` is set.  A key
+             cursor, not a positional one: pool churn between pages can't
+             skip entries, and the requester enforces strictly-advancing
+             cursors so a hostile responder can't loop it.
 """
 
 from __future__ import annotations
@@ -87,19 +91,24 @@ def encode_blocks(blocks: list[Block]) -> bytes:
     return b"".join(parts)
 
 
-def encode_getmempool(offset: int = 0) -> bytes:
-    return bytes([MsgType.GETMEMPOOL]) + struct.pack(">I", offset)
+def encode_getmempool(cursor: tuple[int, bytes] | None = None) -> bytes:
+    head = bytes([MsgType.GETMEMPOOL])
+    if cursor is None:
+        return head
+    fee, txid = cursor
+    return head + struct.pack(">Q32s", fee, txid)
 
 
-def encode_mempool(txs: list[Transaction], next_offset: int = 0) -> bytes:
-    if len(txs) > 0xFFFF:
+def encode_mempool(raw_txs: list[bytes], more: bool = False) -> bytes:
+    """``raw_txs`` are pre-serialized transactions (the caller already
+    serialized them for its byte budget — don't pay that twice)."""
+    if len(raw_txs) > 0xFFFF:
         raise ValueError("too many transactions for one MEMPOOL frame")
     parts = [
         bytes([MsgType.MEMPOOL]),
-        struct.pack(">IH", next_offset, len(txs)),
+        struct.pack(">BH", int(more), len(raw_txs)),
     ]
-    for tx in txs:
-        raw = tx.serialize()
+    for raw in raw_txs:
         parts.append(struct.pack(">H", len(raw)))
         parts.append(raw)
     return b"".join(parts)
@@ -149,14 +158,17 @@ def decode(payload: bytes):
             raise ValueError("trailing bytes in BLOCKS")
         return mtype, blocks
     if mtype is MsgType.GETMEMPOOL:
-        if len(body) != 4:
+        if not body:
+            return mtype, None
+        if len(body) != 40:
             raise ValueError("bad GETMEMPOOL")
-        return mtype, struct.unpack(">I", body)[0]
+        fee, txid = struct.unpack(">Q32s", body)
+        return mtype, (fee, txid)
     if mtype is MsgType.MEMPOOL:
-        if len(body) < 6:
+        if len(body) < 3:
             raise ValueError("bad MEMPOOL")
-        next_offset, n = struct.unpack_from(">IH", body)
-        off = 6
+        more, n = struct.unpack_from(">BH", body)
+        off = 3
         txs = []
         for _ in range(n):
             if len(body) < off + 2:
@@ -169,7 +181,7 @@ def decode(payload: bytes):
             off += tlen
         if off != len(body):
             raise ValueError("trailing bytes in MEMPOOL")
-        return mtype, (next_offset, txs)
+        return mtype, (bool(more), txs)
     raise AssertionError(mtype)
 
 

@@ -456,6 +456,27 @@ class TestMempoolUnit:
         pool.apply_block_delta((), (Block(header, (confirmed,)),))
         assert rival.txid() not in pool and len(pool) == 0
 
+    def test_sync_page_key_cursor_survives_churn(self):
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        txs = [Transaction("alice", "bob", 5, 10 - f, f) for f in range(8)]
+        for tx in txs:
+            assert pool.add(tx)
+        page1, more = pool.sync_page(None, 3)
+        assert more and len(page1) == 3
+        got = {t.txid() for t in page1}
+        # Churn between pages: evict two high-fee txs already delivered.
+        for tx in page1[:2]:
+            pool._evict(tx)
+        last = page1[-1]
+        page2, more2 = pool.sync_page((last.fee, last.txid()), 100)
+        got |= {t.txid() for t in page2}
+        assert not more2
+        # A positional cursor would have skipped entries after the
+        # eviction shifted ranks; the key cursor delivers every tx.
+        assert got == {t.txid() for t in txs}
+
     def test_rbf_bypasses_full_pool_capacity(self):
         from p1_tpu.mempool import Mempool
 
