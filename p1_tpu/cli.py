@@ -122,6 +122,38 @@ def build_parser() -> argparse.ArgumentParser:
         "--account", default=None, help="print one account instead of all"
     )
 
+    p = sub.add_parser(
+        "pod",
+        help="multi-host pod miner: N processes, one miner on the network",
+    )
+    # Not _add_common: the pod always runs the sharded mesh backend, so a
+    # --backend flag would be a silent no-op.  chunk/batch MUST match
+    # across processes (PodMiner validates at startup).
+    p.add_argument("--difficulty", type=int, default=16)
+    p.add_argument("--batch", type=int, default=None, help="per-device batch")
+    p.add_argument("--chunk", type=int, default=None, help="miner abort granularity")
+    p.add_argument("--coordinator", required=True, help="host:port of process 0")
+    p.add_argument("--num-hosts", type=int, required=True)
+    p.add_argument("--host-id", type=int, required=True)
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="pin the JAX platform (e.g. cpu) before distributed init",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="leader's p2p port")
+    p.add_argument("--peers", nargs="*", default=[], help="host:port ...")
+    p.add_argument("--miner-id", default=None)
+    p.add_argument("--store", default=None)
+    p.add_argument(
+        "--duration",
+        type=float,
+        default=None,
+        help="leader: stop mining after N s; followers: leader-loss "
+        "watchdog (force-exit N+60s after start if no SHUTDOWN arrives)",
+    )
+    p.set_defaults(no_mine=False, deadline=None, status_interval=10.0)
+
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
     p.add_argument("--nodes", type=int, default=4)
@@ -315,7 +347,7 @@ def cmd_replay(args) -> int:
 # -- node ----------------------------------------------------------------
 
 
-async def _run_node(args) -> int:
+async def _run_node(args, miner=None) -> int:
     from p1_tpu.config import NodeConfig
     from p1_tpu.node import Node
 
@@ -331,7 +363,7 @@ async def _run_node(args) -> int:
         chunk=args.chunk,
         miner_id=args.miner_id,
     )
-    node = Node(config)
+    node = Node(config, miner=miner)
     await node.start()
     try:
         if args.deadline is not None or args.duration is not None:
@@ -415,6 +447,100 @@ def cmd_tx(args) -> int:
         )
     )
     return 0
+
+
+# -- pod -----------------------------------------------------------------
+
+
+def cmd_pod(args) -> int:
+    """Multi-host mining (north star config 5, multi-host form): every
+    process joins one jax.distributed mesh and mirrors the same sharded
+    search in lockstep; process 0 additionally runs the p2p node, so the
+    whole pod presents as a single miner on the gossip network."""
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.parallel import PodMiner, init_distributed
+
+    init_distributed(args.coordinator, args.num_hosts, args.host_id)
+    kwargs = {"batch": args.batch} if args.batch else {}
+    backend = get_backend("sharded", **kwargs)
+    is_leader = args.host_id == 0
+    try:
+        miner = PodMiner(is_leader=is_leader, backend=backend, chunk=args.chunk)
+    except ValueError as e:
+        # The pod is already broken (config mismatch); a normal exit would
+        # hang in jax.distributed's atexit barrier waiting for peers that
+        # will never agree — leave immediately and loudly.
+        import os
+
+        print(f"pod startup failed: {e}", file=sys.stderr, flush=True)
+        os._exit(2)
+    logging.info(
+        "pod process %d/%d: %d global devices, %s",
+        args.host_id,
+        args.num_hosts,
+        backend.n_devices,
+        "leader" if is_leader else "follower",
+    )
+    if not is_leader:
+        if args.duration is not None:
+            # Leader-loss watchdog: follow() blocks inside a collective
+            # with no timeout, so a SIGKILLed leader (no SHUTDOWN frame)
+            # would hang followers forever.  A clean shutdown cancels this.
+            import os
+            import threading
+
+            grace = args.duration + 60.0
+
+            def _watchdog():
+                logging.error(
+                    "pod watchdog: no SHUTDOWN within %.0fs, exiting", grace
+                )
+                os._exit(3)
+
+            timer = threading.Timer(grace, _watchdog)
+            timer.daemon = True
+            timer.start()
+        else:
+            timer = None
+        mirrored = miner.follow()
+        if timer is not None:
+            timer.cancel()
+        print(json.dumps({"config": "pod", "role": "follower", "searches": mirrored}))
+        return 0
+    args.backend = "sharded"  # for _run_node's NodeConfig (miner overrides)
+    if args.duration is not None:
+        # Follower-loss watchdog, symmetric to the follower's: a dead
+        # follower leaves the leader's search thread blocked in a
+        # collective forever (abort can't unblock it), which would also
+        # hang interpreter exit on the executor join.
+        import os as os_mod
+        import threading
+
+        grace = args.duration + 90.0
+
+        def _leader_watchdog():
+            logging.error(
+                "pod watchdog: leader did not finish within %.0fs "
+                "(follower lost?), exiting",
+                grace,
+            )
+            os_mod._exit(3)
+
+        leader_timer = threading.Timer(grace, _leader_watchdog)
+        leader_timer.daemon = True
+        leader_timer.start()
+    else:
+        leader_timer = None
+    try:
+        return asyncio.run(_run_node(args, miner=miner))
+    finally:
+        miner.shutdown()
+        if leader_timer is not None:
+            leader_timer.cancel()
 
 
 # -- balances ------------------------------------------------------------
@@ -574,6 +700,7 @@ def main(argv=None) -> int:
         "node": cmd_node,
         "tx": cmd_tx,
         "balances": cmd_balances,
+        "pod": cmd_pod,
         "net": cmd_net,
         "bench": cmd_bench,
     }[args.cmd]

@@ -86,7 +86,7 @@ class Miner:
                 prefix = header.mining_prefix()
                 nonce = start_nonce
                 while nonce < NONCE_SPACE:
-                    if abort is not None and abort.is_set():
+                    if self._chunk_sync(abort):
                         stats.aborted = True
                         return None
                     count = min(self.chunk, NONCE_SPACE - nonce)
@@ -108,3 +108,13 @@ class Miner:
                 start_nonce = 0
         finally:
             stats.elapsed_s = time.perf_counter() - t0
+
+    def _chunk_sync(self, abort: threading.Event | None) -> bool:
+        """Per-chunk stop decision, called before every backend call.
+
+        Hook point for lockstep mining: the default is a local abort-event
+        check; the multi-host PodMiner (p1_tpu/parallel/pod.py) overrides
+        it to broadcast the leader's decision so every process leaves the
+        chunk loop at the same iteration.
+        """
+        return abort is not None and abort.is_set()

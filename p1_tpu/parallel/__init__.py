@@ -1,0 +1,3 @@
+from p1_tpu.parallel.pod import PodMiner, init_distributed
+
+__all__ = ["PodMiner", "init_distributed"]

@@ -1,0 +1,183 @@
+"""Multi-host pod mining: many processes, one miner on the gossip network.
+
+Capability parity: the north star's pod-scale mode — "a v5e-8 pod presents
+as a single miner on the gossip network" (BASELINE.json:5, config 5 at
+BASELINE.json:11) — extended to MULTI-HOST the way the reference's
+NCCL/MPI-style backend would scale: ``jax.distributed`` forms one global
+device mesh across processes/hosts, the unmodified ``sharded`` backend's
+``shard_map``+``pmin`` step runs over it (collectives ride ICI within a
+host and the JAX distributed transport across hosts), and only the leader
+process speaks the p2p gossip protocol.
+
+**Lockstep design** (multi-controller SPMD): every process must execute
+the same sequence of jitted collectives.  Everything inside a nonce search
+is deterministic given its inputs — the sharded backend's fixed step spans,
+the chunk loop, the timestamp roll, and the ``pmin``-reduced result that
+every process observes identically — so only two things ever need
+host-level agreement, both broadcast from the leader with
+``multihost_utils.broadcast_one_to_all``:
+
+1. what to search (START: the 80-byte draft header + start nonce), and
+2. whether to keep going (one CONTINUE/ABORT byte per chunk, hooked into
+   ``Miner._chunk_sync`` — the leader's abort event, e.g. "new tip arrived
+   via gossip", reaches every process at the same chunk boundary).
+
+A follower therefore runs the IDENTICAL ``Miner.search_nonce`` loop and
+leaves it at the same iteration with the same result; it just discards
+the sealed header (the leader's node gossips the block).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from p1_tpu.core.header import HEADER_SIZE, BlockHeader
+from p1_tpu.miner import Miner
+
+# START/SHUTDOWN frame: op(1) + pad(7) + start_nonce(u64) + header(80).
+_CTRL = 96
+_OP_START = 1
+_OP_SHUTDOWN = 2
+
+
+def init_distributed(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """Join the JAX distributed runtime (call before ANY other JAX use).
+
+    After this, ``jax.devices()`` is the global mesh across all processes
+    and ``get_backend("sharded")`` shards nonce ranges over every chip of
+    every host.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _broadcast_bytes(data: bytes | None, size: int) -> bytes:
+    """Leader (data != None) -> everyone; returns the agreed bytes."""
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros((size,), dtype=np.uint8)
+    if data is not None:
+        if len(data) > size:
+            raise ValueError(f"control frame {len(data)} > {size}")
+        buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return bytes(np.asarray(out))
+
+
+class PodMiner(Miner):
+    """A Miner whose chunk loop runs in lockstep across all processes.
+
+    Leader (process 0): plug into a ``Node`` like any Miner — every
+    ``search_nonce`` broadcasts a START frame, then mines normally with
+    per-chunk CONTINUE/ABORT broadcasts.  Followers: call ``follow()``,
+    which mirrors each search until ``shutdown()``.
+    """
+
+    def __init__(self, *, is_leader: bool, **kwargs):
+        super().__init__(**kwargs)
+        self.is_leader = is_leader
+        self._cv = threading.Condition()
+        self._busy = False
+        # Construction-time config handshake: lockstep depends on every
+        # process using the same chunk and per-step span — a mismatch would
+        # diverge the collective sequence and hang the pod with no
+        # diagnostic.  One broadcast turns that into a loud error.
+        mine = (self.chunk, getattr(self.backend, "step_span", 0))
+        agreed_raw = _broadcast_bytes(
+            b"".join(v.to_bytes(8, "big") for v in mine) if is_leader else None,
+            16,
+        )
+        agreed = tuple(
+            int.from_bytes(agreed_raw[8 * i : 8 * (i + 1)], "big")
+            for i in range(2)
+        )
+        if agreed != mine:
+            raise ValueError(
+                f"pod config mismatch: leader (chunk, step_span)={agreed}, "
+                f"this process has {mine} — launch every process with "
+                "identical --chunk/--batch"
+            )
+
+    # -- leader ----------------------------------------------------------
+
+    def search_nonce(
+        self,
+        header: BlockHeader,
+        abort: threading.Event | None = None,
+        start_nonce: int = 0,
+    ) -> BlockHeader | None:
+        if not self.is_leader:
+            raise RuntimeError("followers mirror via follow(), not search_nonce")
+        with self._cv:
+            self._busy = True
+        try:
+            frame = (
+                bytes([_OP_START])
+                + bytes(7)
+                + int(start_nonce).to_bytes(8, "big")
+                + header.serialize()
+            )
+            _broadcast_bytes(frame, _CTRL)
+            return super().search_nonce(header, abort, start_nonce)
+        finally:
+            with self._cv:
+                self._busy = False
+                self._cv.notify_all()
+
+    def shutdown(self, timeout: float = 120.0) -> None:
+        """Leader: release followers from ``follow()``.
+
+        Joins any in-flight search first: its worker thread still owes the
+        followers per-chunk broadcasts, and a SHUTDOWN frame interleaved
+        with those would desync the collective sequence pod-wide.  The
+        caller must have aborted the search already (Node.stop_mining does)
+        or this times out.
+        """
+        if not self.is_leader:
+            return
+        with self._cv:
+            if not self._cv.wait_for(lambda: not self._busy, timeout=timeout):
+                raise RuntimeError(
+                    "shutdown() while a search is still running — abort it "
+                    "first (stop_mining)"
+                )
+        _broadcast_bytes(bytes([_OP_SHUTDOWN]), _CTRL)
+
+    # -- follower --------------------------------------------------------
+
+    def follow(self) -> int:
+        """Mirror the leader's searches until SHUTDOWN; returns how many
+        searches were mirrored."""
+        if self.is_leader:
+            raise RuntimeError("the leader drives searches itself")
+        mirrored = 0
+        while True:
+            frame = _broadcast_bytes(None, _CTRL)
+            op = frame[0]
+            if op == _OP_SHUTDOWN:
+                return mirrored
+            if op != _OP_START:
+                raise ValueError(f"unexpected pod control op {op}")
+            start_nonce = int.from_bytes(frame[8:16], "big")
+            header = BlockHeader.deserialize(frame[16 : 16 + HEADER_SIZE])
+            super().search_nonce(header, abort=None, start_nonce=start_nonce)
+            mirrored += 1
+
+    # -- lockstep chunk gate ---------------------------------------------
+
+    def _chunk_sync(self, abort: threading.Event | None) -> bool:
+        """One byte of leader truth per chunk: every process leaves the
+        chunk loop at the same iteration."""
+        if self.is_leader:
+            stop = abort is not None and abort.is_set()
+            return _broadcast_bytes(bytes([int(stop)]), 1)[0] != 0
+        return _broadcast_bytes(None, 1)[0] != 0
