@@ -149,8 +149,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--duration",
         type=float,
         default=None,
-        help="leader: stop mining after N s; followers: leader-loss "
-        "watchdog (force-exit N+60s after start if no SHUTDOWN arrives)",
+        help="leader: stop mining after N s; both roles also arm a "
+        "peer-loss watchdog (force-exit after 600s with no lockstep "
+        "progress — the grace covers first-search jit compile)",
     )
     p.set_defaults(no_mine=False, deadline=None, status_interval=10.0)
 
@@ -452,6 +453,52 @@ def cmd_tx(args) -> int:
 # -- pod -----------------------------------------------------------------
 
 
+class _PodWatchdog:
+    """No-progress failsafe: a vanished pod peer leaves the survivor
+    blocked inside a collective forever (aborts can't unblock it, and
+    interpreter exit would hang on the executor join), so if no lockstep
+    point is reached for ``grace`` seconds the process force-exits.
+    ``grace`` covers the longest LEGITIMATE inter-beat gap — the first
+    search's jit compile on a real mesh plus one chunk — independent of
+    run length (progress-based, not an absolute deadline).
+
+    ``beat()`` is a plain monotonic-timestamp store (the hot path runs it
+    per chunk); one long-lived daemon thread polls, instead of spawning a
+    Timer thread per beat.
+    """
+
+    GRACE_S = 600.0
+    _POLL_S = 5.0
+
+    def __init__(self, role: str):
+        import threading
+
+        self.role = role
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def cancel(self) -> None:
+        self._stop.set()
+
+    def _poll(self) -> None:
+        import os
+
+        while not self._stop.wait(self._POLL_S):
+            if time.monotonic() - self._last > self.GRACE_S:
+                logging.error(
+                    "pod watchdog (%s): no lockstep progress for %.0fs "
+                    "(peer lost?), exiting",
+                    self.role,
+                    self.GRACE_S,
+                )
+                os._exit(3)
+
+
 def cmd_pod(args) -> int:
     """Multi-host mining (north star config 5, multi-host form): every
     process joins one jax.distributed mesh and mirrors the same sharded
@@ -465,9 +512,16 @@ def cmd_pod(args) -> int:
     from p1_tpu.parallel import PodMiner, init_distributed
 
     init_distributed(args.coordinator, args.num_hosts, args.host_id)
+    is_leader = args.host_id == 0
+    # Arm the watchdog BEFORE any blocking collective (the construction
+    # handshake included): a peer that dies during startup must not hang a
+    # bounded run.  Long-running services (no --duration) supervise
+    # externally.
+    watchdog = None
+    if args.duration is not None:
+        watchdog = _PodWatchdog(role="leader" if is_leader else "follower")
     kwargs = {"batch": args.batch} if args.batch else {}
     backend = get_backend("sharded", **kwargs)
-    is_leader = args.host_id == 0
     try:
         miner = PodMiner(is_leader=is_leader, backend=backend, chunk=args.chunk)
     except ValueError as e:
@@ -485,62 +539,21 @@ def cmd_pod(args) -> int:
         backend.n_devices,
         "leader" if is_leader else "follower",
     )
+    if watchdog is not None:
+        miner.heartbeat = watchdog.beat
     if not is_leader:
-        if args.duration is not None:
-            # Leader-loss watchdog: follow() blocks inside a collective
-            # with no timeout, so a SIGKILLed leader (no SHUTDOWN frame)
-            # would hang followers forever.  A clean shutdown cancels this.
-            import os
-            import threading
-
-            grace = args.duration + 60.0
-
-            def _watchdog():
-                logging.error(
-                    "pod watchdog: no SHUTDOWN within %.0fs, exiting", grace
-                )
-                os._exit(3)
-
-            timer = threading.Timer(grace, _watchdog)
-            timer.daemon = True
-            timer.start()
-        else:
-            timer = None
         mirrored = miner.follow()
-        if timer is not None:
-            timer.cancel()
+        if watchdog is not None:
+            watchdog.cancel()
         print(json.dumps({"config": "pod", "role": "follower", "searches": mirrored}))
         return 0
     args.backend = "sharded"  # for _run_node's NodeConfig (miner overrides)
-    if args.duration is not None:
-        # Follower-loss watchdog, symmetric to the follower's: a dead
-        # follower leaves the leader's search thread blocked in a
-        # collective forever (abort can't unblock it), which would also
-        # hang interpreter exit on the executor join.
-        import os as os_mod
-        import threading
-
-        grace = args.duration + 90.0
-
-        def _leader_watchdog():
-            logging.error(
-                "pod watchdog: leader did not finish within %.0fs "
-                "(follower lost?), exiting",
-                grace,
-            )
-            os_mod._exit(3)
-
-        leader_timer = threading.Timer(grace, _leader_watchdog)
-        leader_timer.daemon = True
-        leader_timer.start()
-    else:
-        leader_timer = None
     try:
         return asyncio.run(_run_node(args, miner=miner))
     finally:
         miner.shutdown()
-        if leader_timer is not None:
-            leader_timer.cancel()
+        if watchdog is not None:
+            watchdog.cancel()
 
 
 # -- balances ------------------------------------------------------------

@@ -87,24 +87,27 @@ class PodMiner(Miner):
         self.is_leader = is_leader
         self._cv = threading.Condition()
         self._busy = False
+        #: Optional liveness callback, invoked at every lockstep point
+        #: (search start, each chunk, each mirrored search) — the CLI wires
+        #: a watchdog to it so a vanished peer doesn't hang the survivor in
+        #: a collective forever.
+        self.heartbeat = None
         # Construction-time config handshake: lockstep depends on every
         # process using the same chunk and per-step span — a mismatch would
         # diverge the collective sequence and hang the pod with no
-        # diagnostic.  One broadcast turns that into a loud error.
-        mine = (self.chunk, getattr(self.backend, "step_span", 0))
-        agreed_raw = _broadcast_bytes(
-            b"".join(v.to_bytes(8, "big") for v in mine) if is_leader else None,
-            16,
+        # diagnostic.  allgather (not broadcast) so EVERY rank — leader
+        # included — sees the disagreement and fails loudly.
+        from jax.experimental import multihost_utils
+
+        mine = np.array(
+            [self.chunk, getattr(self.backend, "step_span", 0)], dtype=np.int64
         )
-        agreed = tuple(
-            int.from_bytes(agreed_raw[8 * i : 8 * (i + 1)], "big")
-            for i in range(2)
-        )
-        if agreed != mine:
+        everyone = np.asarray(multihost_utils.process_allgather(mine))
+        if not (everyone == mine).all():
             raise ValueError(
-                f"pod config mismatch: leader (chunk, step_span)={agreed}, "
-                f"this process has {mine} — launch every process with "
-                "identical --chunk/--batch"
+                "pod config mismatch: per-process (chunk, step_span) = "
+                f"{everyone.tolist()} — launch every process with identical "
+                "--chunk/--batch"
             )
 
     # -- leader ----------------------------------------------------------
@@ -120,6 +123,8 @@ class PodMiner(Miner):
         with self._cv:
             self._busy = True
         try:
+            if self.heartbeat is not None:
+                self.heartbeat()
             frame = (
                 bytes([_OP_START])
                 + bytes(7)
@@ -161,6 +166,8 @@ class PodMiner(Miner):
             raise RuntimeError("the leader drives searches itself")
         mirrored = 0
         while True:
+            if self.heartbeat is not None:
+                self.heartbeat()
             frame = _broadcast_bytes(None, _CTRL)
             op = frame[0]
             if op == _OP_SHUTDOWN:
@@ -177,6 +184,8 @@ class PodMiner(Miner):
     def _chunk_sync(self, abort: threading.Event | None) -> bool:
         """One byte of leader truth per chunk: every process leaves the
         chunk loop at the same iteration."""
+        if self.heartbeat is not None:
+            self.heartbeat()
         if self.is_leader:
             stop = abort is not None and abort.is_set()
             return _broadcast_bytes(bytes([int(stop)]), 1)[0] != 0
