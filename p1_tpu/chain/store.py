@@ -11,6 +11,7 @@ side branches survive restarts too.
 
 from __future__ import annotations
 
+import fcntl
 import io
 import os
 import struct
@@ -30,20 +31,49 @@ class ChainStore:
         self.path = Path(path)
         self._fh: io.BufferedWriter | None = None
 
-    def append(self, block: Block) -> None:
-        if self._fh is None:
-            new = not self.path.exists() or self.path.stat().st_size == 0
-            self.path.parent.mkdir(parents=True, exist_ok=True)
-            if not new:
+    def acquire(self) -> None:
+        """Open + exclusively lock the store for this writer's lifetime
+        (idempotent; released by ``close``).  Raises RuntimeError when
+        another process holds the lock — two nodes appending to one store,
+        or a compaction racing a live node, would corrupt or silently
+        orphan records.
+
+        Lock ordering matters: the torn-tail truncation runs strictly
+        UNDER the lock, so a refused second writer can never truncate a
+        live writer's in-flight record on its way to the refusal.
+        """
+        if self._fh is not None:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fh = open(self.path, "a+b")  # "a": every write appends
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            fh.close()
+            raise RuntimeError(
+                f"{self.path} is locked by another process (a running node?)"
+            ) from e
+        try:
+            if self.path.stat().st_size == 0:
+                fh.write(MAGIC)
+                fh.flush()
+            else:
                 # Drop any truncated tail record (crash mid-append) before
                 # writing behind it, or its stale length prefix would point
                 # into the new records and corrupt the whole log.
                 good_end = self._scan_good_end(self.path.read_bytes())
                 if good_end < self.path.stat().st_size:
                     os.truncate(self.path, good_end)
-            self._fh = open(self.path, "ab")
-            if new:
-                self._fh.write(MAGIC)
+        except ValueError as e:
+            # e.g. "not a chain store": release the lock + handle instead
+            # of leaking an exclusively-flocked fd, and surface the same
+            # clean error type as the lock conflict.
+            fh.close()
+            raise RuntimeError(str(e)) from e
+        self._fh = fh
+
+    def append(self, block: Block) -> None:
+        self.acquire()
         raw = block.serialize()
         self._fh.write(_LEN.pack(len(raw)))
         self._fh.write(raw)

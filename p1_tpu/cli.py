@@ -155,6 +155,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.set_defaults(no_mine=False, deadline=None, status_interval=10.0)
 
+    p = sub.add_parser(
+        "compact", help="rewrite a chain store to just its main branch"
+    )
+    p.add_argument("--store", required=True, help="chain persistence path")
+    p.add_argument(
+        "--out",
+        default=None,
+        help="write here instead of replacing the store in place",
+    )
+
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
     p.add_argument("--nodes", type=int, default=4)
@@ -559,29 +569,39 @@ def cmd_pod(args) -> int:
 # -- balances ------------------------------------------------------------
 
 
-def cmd_balances(args) -> int:
-    from p1_tpu.chain import ChainStore, balances
+def _load_store(path: str, expected_difficulty: int | None = None):
+    """(blocks, chain) from a persisted store, difficulty inferred from the
+    records (every block declares the chain difficulty — validation
+    enforces it — so the store is self-describing).  Raises SystemExit 2
+    for an empty/missing store or an ``expected_difficulty`` mismatch —
+    both checked BEFORE the (potentially expensive) validated replay."""
+    from p1_tpu.chain import ChainStore
 
-    store = ChainStore(args.store)
+    store = ChainStore(path)
     try:
         blocks = store.load_blocks()
     finally:
         store.close()
     if not blocks:
-        print(f"{args.store}: empty or missing chain store", file=sys.stderr)
-        return 2
-    # Every stored block declares the chain difficulty (validation
-    # enforces it), so the store is self-describing — a wrong flag
-    # would otherwise silently report an empty ledger at height 0.
+        print(f"{path}: empty or missing chain store", file=sys.stderr)
+        raise SystemExit(2)
     stored = blocks[0].header.difficulty
-    if args.difficulty is not None and args.difficulty != stored:
+    if expected_difficulty is not None and expected_difficulty != stored:
+        # A wrong flag would otherwise silently yield an empty chain.
         print(
-            f"--difficulty {args.difficulty} does not match the store's "
+            f"--difficulty {expected_difficulty} does not match the store's "
             f"chain (difficulty {stored})",
             file=sys.stderr,
         )
-        return 2
-    chain = ChainStore(args.store).load_chain(stored, blocks)
+        raise SystemExit(2)
+    chain = store.load_chain(stored, blocks)
+    return blocks, chain
+
+
+def cmd_balances(args) -> int:
+    from p1_tpu.chain import balances
+
+    blocks, chain = _load_store(args.store, args.difficulty)
     ledger = balances(chain.main_chain())
     if args.account is not None:
         print(
@@ -601,6 +621,78 @@ def cmd_balances(args) -> int:
                 "config": "balances",
                 "height": chain.height,
                 "balances": dict(sorted(ledger.items())),
+            }
+        )
+    )
+    return 0
+
+
+# -- compact -------------------------------------------------------------
+
+
+def cmd_compact(args) -> int:
+    """Store maintenance: the append-only log keeps every side branch and
+    reorged-away block forever (that's what makes restarts deterministic);
+    compaction snapshots just the current main branch, shrinking the file
+    while resume behavior for the surviving chain is unchanged."""
+    import os
+
+    from p1_tpu.chain import ChainStore, save_chain
+
+    if not os.path.exists(args.store):
+        print(f"{args.store}: empty or missing chain store", file=sys.stderr)
+        return 2
+    # Lock FIRST, then load: records appended between an unlocked read and
+    # the rewrite would be silently dropped, and replacing the inode under
+    # a live node would orphan everything it appends afterwards.
+    src = ChainStore(args.store)
+    try:
+        try:
+            src.acquire()
+        except RuntimeError as e:
+            print(f"{e} — stop it before compacting", file=sys.stderr)
+            return 2
+        blocks = src.load_blocks()
+        if not blocks:
+            print(f"{args.store}: empty chain store", file=sys.stderr)
+            return 2
+        chain = src.load_chain(blocks[0].header.difficulty, blocks)
+        before = os.path.getsize(args.store)
+        out = args.out or args.store
+        dst = None
+        if args.out and os.path.realpath(out) != os.path.realpath(args.store):
+            # The destination needs the same in-use guard: replacing it
+            # would orphan a live node's inode there.
+            dst = ChainStore(out)
+            try:
+                dst.acquire()
+            except RuntimeError as e:
+                print(f"{e} — stop it before overwriting", file=sys.stderr)
+                return 2
+        else:
+            out = args.store
+        try:
+            # Always write a sibling temp file and atomically replace, so
+            # a crash mid-write can never leave EITHER path deleted or
+            # truncated.
+            tmp = f"{out}.compact.{os.getpid()}"
+            save_chain(chain, tmp)
+            os.replace(tmp, out)
+        finally:
+            if dst is not None:
+                dst.close()
+    finally:
+        src.close()
+    print(
+        json.dumps(
+            {
+                "config": "compact",
+                "height": chain.height,
+                "records_before": len(blocks),
+                "records_after": chain.height + 1,
+                "bytes_before": before,
+                "bytes_after": os.path.getsize(out),
+                "out": out,
             }
         )
     )
@@ -713,6 +805,7 @@ def main(argv=None) -> int:
         "node": cmd_node,
         "tx": cmd_tx,
         "balances": cmd_balances,
+        "compact": cmd_compact,
         "pod": cmd_pod,
         "net": cmd_net,
         "bench": cmd_bench,

@@ -121,10 +121,24 @@ class Node:
 
     async def start(self) -> None:
         if self.store is not None:
+            # Hold the store's writer lock for the node's whole lifetime
+            # (not just from the first append): a second node on the same
+            # store, or a compaction while we run, must fail loudly.
+            self.store.acquire()
+            blocks = self.store.load_blocks()
+            if blocks and blocks[0].header.difficulty != self.config.difficulty:
+                # Restarting with a different --difficulty would silently
+                # reject every persisted record and interleave a second,
+                # incompatible chain behind them.
+                raise RuntimeError(
+                    f"store {self.store.path} holds a difficulty-"
+                    f"{blocks[0].header.difficulty} chain; node configured "
+                    f"for {self.config.difficulty}"
+                )
             # load_chain already routes every record through full add_block
             # validation, and keeps persisted side branches alive (store.py)
             # — adopt it wholesale instead of re-validating main_chain only.
-            self.chain = self.store.load_chain(self.config.difficulty)
+            self.chain = self.store.load_chain(self.config.difficulty, blocks)
             if self.chain.height:
                 log.info(
                     "resumed chain height=%d tip=%s",

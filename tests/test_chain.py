@@ -452,6 +452,81 @@ class TestLedger:
         assert out["balance"] == 50 and out["height"] == 1
 
 
+class TestCompact:
+    def test_cli_compact_drops_side_branches(self, tmp_path):
+        import json as json_mod
+        import subprocess
+        import sys
+
+        genesis = make_genesis(DIFF)
+        main = [genesis]
+        for _ in range(4):
+            main.append(_mine_child(main[-1]))
+        fork = _mine_child(genesis, version=2)  # loses fork choice
+        store_path = tmp_path / "chain.dat"
+        store = ChainStore(store_path)
+        for block in [*main[1:3], fork, *main[3:]]:
+            store.append(block)
+        store.close()
+
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "compact",
+                "--store", str(store_path),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json_mod.loads(proc.stdout.strip())
+        assert out["height"] == 4
+        assert out["records_before"] == 5  # 4 main + 1 fork (no genesis rec)
+        assert out["records_after"] == 5  # genesis + 4 main
+        # The compacted store reloads to the same tip, fork gone.
+        reloaded = ChainStore(store_path).load_chain(DIFF)
+        assert reloaded.tip_hash == main[-1].block_hash()
+        assert len(reloaded) == 5
+
+
+    def test_compact_refuses_locked_store(self, tmp_path):
+        import subprocess
+        import sys
+
+        genesis = make_genesis(DIFF)
+        store_path = tmp_path / "live.dat"
+        writer = ChainStore(store_path)
+        writer.append(_mine_child(genesis))  # holds the writer flock
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "compact",
+                    "--store", str(store_path),
+                ],
+                capture_output=True,
+                text=True,
+                timeout=110,
+                cwd="/root/repo",
+            )
+            assert proc.returncode == 2
+            assert "locked by another process" in proc.stderr
+        finally:
+            writer.close()
+
+    def test_second_writer_refused(self, tmp_path):
+        genesis = make_genesis(DIFF)
+        store_path = tmp_path / "one_writer.dat"
+        a = ChainStore(store_path)
+        a.append(_mine_child(genesis))
+        b = ChainStore(store_path)
+        try:
+            with pytest.raises(RuntimeError, match="locked"):
+                b.append(_mine_child(genesis, ts_offset=2))
+        finally:
+            a.close()
+
+
 class TestForkChoiceProperty:
     """Randomized property test (SURVEY §5): for ANY block DAG delivered in
     ANY order, every node converges to the same tip, and that tip is the
