@@ -411,6 +411,42 @@ class TestRestart:
         run(scenario())
 
 
+class TestRestartGuards:
+    def test_restart_with_wrong_difficulty_refused(self, tmp_path):
+        async def scenario():
+            store = tmp_path / "node.dat"
+            a = Node(_config(mine=True, store_path=str(store)))
+            await a.start()
+            try:
+                assert await wait_until(lambda: a.chain.height >= 1)
+            finally:
+                await a.stop()
+            # Same store, different chain parameters: must refuse loudly
+            # instead of silently interleaving two chains in one log.
+            b = Node(_config(difficulty=DIFF + 1, store_path=str(store)))
+            with pytest.raises(RuntimeError, match="difficulty"):
+                await b.start()
+            await b.stop()  # cleanup of whatever start() opened
+
+        run(scenario())
+
+    def test_second_node_same_store_refused(self, tmp_path):
+        async def scenario():
+            store = tmp_path / "shared.dat"
+            a = Node(_config(mine=True, store_path=str(store)))
+            await a.start()
+            try:
+                assert await wait_until(lambda: a.chain.height >= 1)
+                b = Node(_config(store_path=str(store)))
+                with pytest.raises(RuntimeError, match="locked"):
+                    await b.start()
+                await b.stop()
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+
 class TestMempoolUnit:
     def test_fee_priority_and_dedup(self):
         from p1_tpu.mempool import Mempool
