@@ -48,7 +48,9 @@ def test_config_mismatch_fails_loudly():
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
     try:
-        _, err = follower.communicate(timeout=90)
+        # Generous timeout: two interpreter+distributed-runtime startups on
+        # a loaded 1-vCPU box can take a while before the handshake runs.
+        _, err = follower.communicate(timeout=150)
     finally:
         for proc in (leader, follower):
             if proc.poll() is None:
