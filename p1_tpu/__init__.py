@@ -15,8 +15,13 @@ against the driver-recorded capability model in /root/repo/BASELINE.json):
   reduction over a ``jax.sharding.Mesh``.
 - ``p1_tpu.chain``   — chain validation, longest-chain fork choice with reorg,
   persistence (checkpoint/resume), header-chain replay.
-- ``p1_tpu.mempool`` — pending-transaction pool.
-- ``p1_tpu.node``    — asyncio TCP p2p gossip node (blocks + txs, sync).
+- ``p1_tpu.mempool`` — pending-transaction pool (per-(sender, seq) slots,
+  replace-by-fee, confirmed-slot replay window).
+- ``p1_tpu.node``    — asyncio TCP p2p gossip node (blocks + txs, locator
+  block sync, paged mempool sync) + a thin tx-submission client.
+- ``p1_tpu.parallel``— multi-host pod mining: one ``jax.distributed``
+  mesh across processes/hosts, lockstep searches, one miner on the
+  gossip network.
 """
 
 __version__ = "0.1.0"
