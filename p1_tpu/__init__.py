@@ -19,7 +19,7 @@ against the driver-recorded capability model in /root/repo/BASELINE.json):
   replace-by-fee, confirmed-slot replay window).
 - ``p1_tpu.node``    — asyncio TCP p2p gossip node (blocks + txs, locator
   block sync, paged mempool sync) + a thin tx-submission client.
-- ``p1_tpu.parallel``— multi-host pod mining: one ``jax.distributed``
+- ``p1_tpu.parallel`` — multi-host pod mining: one ``jax.distributed``
   mesh across processes/hosts, lockstep searches, one miner on the
   gossip network.
 """
