@@ -362,6 +362,52 @@ class TestConvergence:
 
         run(scenario())
 
+    def test_deep_sync_spans_many_batches(self):
+        """A late joiner pulling a chain much longer than SYNC_BATCH (500)
+        must iterate the GETBLOCKS/BLOCKS continuation until caught up —
+        exercising the height-indexed blocks_after serving path at depth."""
+
+        async def scenario():
+            from p1_tpu.chain import Chain
+            from p1_tpu.core import Block, BlockHeader, Transaction, merkle_root
+            from p1_tpu.hashx import get_backend
+            from p1_tpu.miner import Miner
+
+            diff = 2  # ~4 hashes/block: 1200 blocks stay fast
+            miner = Miner(backend=get_backend("cpu"))
+            chain = Chain(diff)
+            tip = chain.genesis
+            for height in range(1, 1201):
+                tx = Transaction.coinbase("deep", height)
+                header = BlockHeader(
+                    1,
+                    tip.block_hash(),
+                    merkle_root([tx.txid()]),
+                    tip.header.timestamp + 1,
+                    diff,
+                    0,
+                )
+                sealed = miner.search_nonce(header)
+                assert sealed is not None
+                block = Block(sealed, (tx,))
+                assert chain.add_block(block).tip_changed
+                tip = block
+
+            a = Node(_config(difficulty=diff))
+            a.chain = chain
+            await a.start()
+            b = Node(_config(difficulty=diff, peers=[f"127.0.0.1:{a.port}"]))
+            await b.start()
+            try:
+                assert await wait_until(
+                    lambda: b.chain.height == 1200, timeout=40
+                ), b.chain.height
+                assert b.chain.tip_hash == a.chain.tip_hash
+            finally:
+                await stop_all([a, b])
+
+        run(scenario())
+
     def test_peer_death_and_recovery(self):
         async def scenario():
             nodes = await start_mesh(3, mine=True)
