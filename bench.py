@@ -79,7 +79,26 @@ def main() -> None:
         count = 1 << 21
     device_hps = _throughput(device, prefix, count)
 
+    # The relay occasionally degrades ~25x for a few minutes (observed
+    # 2026-07-30: 30 MH/s vs the usual ~750 on identical code; host-side
+    # rates unaffected).  If the measurement is far below the recorded
+    # healthy number (docs/PERF.md), wait out the window a few times and
+    # re-measure — the FINAL measurement is reported either way, with the
+    # retry count, so a genuinely slower chip still reports honestly.
+    healthy_hps = 750e6
+    degraded_retries = 0
+    while (
+        on_tpu
+        and device_hps < 0.3 * healthy_hps
+        and degraded_retries < 3
+    ):
+        degraded_retries += 1
+        time.sleep(60)
+        device_hps = _throughput(device, prefix, count)
+
     extra = {}
+    if degraded_retries:
+        extra["degraded_retries"] = degraded_retries
     if on_tpu:
         # The pure-XLA formulation, for the Pallas-vs-XLA record
         # (docs/PERF.md): same chip, same session.

@@ -466,6 +466,7 @@ class TestCrashRecovery:
 
         store = tmp_path / "crash.dat"
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo_root = str(__import__("pathlib").Path(__file__).resolve().parents[1])
         cmd = [
             sys.executable, "-m", "p1_tpu", "node",
             "--port", "0", "--difficulty", "10", "--backend", "cpu",
@@ -474,7 +475,7 @@ class TestCrashRecovery:
         err_path = tmp_path / "node.err"
         with open(err_path, "w") as err_fh:
             proc = subprocess.Popen(
-                cmd, env=env, cwd="/root/repo",
+                cmd, env=env, cwd=repo_root,
                 stdout=subprocess.DEVNULL, stderr=err_fh,
             )
             try:
@@ -504,7 +505,7 @@ class TestCrashRecovery:
                 "--port", "0", "--difficulty", "10", "--backend", "cpu",
                 "--store", str(store), "--duration", "2",
             ],
-            env=env, cwd="/root/repo",
+            env=env, cwd=repo_root,
             capture_output=True, text=True, timeout=110,
         )
         assert out.returncode == 0, out.stderr[-2000:]
