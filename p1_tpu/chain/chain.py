@@ -23,6 +23,16 @@ paths" and "longest-chain" resolution on the gossip network
 - ``add_block`` reports what happened — including the reorg's removed/added
   block lists so the mempool can resurrect transactions from abandoned
   blocks and the miner knows to abort a stale search.
+- **Contextual (ledger) validity is enforced at connect time**, Bitcoin
+  style: stateless checks (PoW, merkle, signatures, subsidy) gate indexing,
+  but whether a transfer overdraws its sender depends on the block's whole
+  ancestor chain — so the incremental ``Ledger`` held at the tip validates
+  blocks exactly when the tip tries to move onto them.  A branch containing
+  an overdraw is marked **invalid** (the block and every descendant,
+  permanently — contextual validity is a pure function of a block's
+  ancestor chain, so all nodes agree) and fork choice falls back to the
+  best valid tip.  Side branches are indexed without ledger checks (their
+  state isn't materialized) and get validated if work ever favors them.
 """
 
 from __future__ import annotations
@@ -34,6 +44,7 @@ from typing import Iterator
 
 from p1_tpu.core.block import Block
 from p1_tpu.core.genesis import make_genesis
+from p1_tpu.chain.ledger import Ledger, LedgerError
 from p1_tpu.chain.validate import ValidationError, check_block
 
 
@@ -98,6 +109,15 @@ class Chain:
         self._orphan_fifo: collections.deque[tuple[bytes, bytes]] = (
             collections.deque()
         )  # (prev_hash, block_hash) in arrival order, for FIFO eviction
+        #: Account state at the current tip — advanced/rewound with every
+        #: tip move, so contextual validation is O(blocks moved).
+        self._ledger = Ledger()
+        self._ledger.apply_block(self.genesis)
+        #: Contextually invalid blocks (overdraw somewhere in their history)
+        #: + why.  Membership is permanent; descendants inherit it.
+        self._invalid: dict[bytes, str] = {}
+        #: parent hash -> child hashes, for invalidating indexed subtrees.
+        self._children: dict[bytes, list[bytes]] = {}
 
     # -- queries ---------------------------------------------------------
 
@@ -125,6 +145,20 @@ class Chain:
 
     def height_of(self, block_hash: bytes) -> int:
         return self._index[block_hash].height
+
+    def balance(self, account: str) -> int:
+        """``account``'s balance at the current tip (consensus ledger) —
+        never negative, because an overdrawing block cannot connect."""
+        return self._ledger.balance(account)
+
+    def balances_snapshot(self) -> dict[str, int]:
+        """All non-zero balances at the current tip."""
+        return self._ledger.snapshot()
+
+    def nonce(self, account: str) -> int:
+        """The seq ``account``'s next transfer must carry (strict account
+        nonce — see ledger.py's replay rule)."""
+        return self._ledger.nonce(account)
 
     def main_chain(self) -> Iterator[Block]:
         """Genesis-first iteration of the current best chain."""
@@ -198,19 +232,89 @@ class Chain:
                 e for e in self._orphan_fifo if e[1] in self._orphan_hashes
             )
 
-        removed: tuple[Block, ...] = ()
-        added: tuple[Block, ...] = ()
-        if self._tip_hash != old_tip:
-            removed, added = self._reorg_paths(old_tip, self._tip_hash)
-            if removed:
-                del self._main_hashes[len(self._main_hashes) - len(removed) :]
-            self._main_hashes.extend(b.block_hash() for b in added)
+        removed, added = self._settle_tip(old_tip)
+        if removed:
+            del self._main_hashes[len(self._main_hashes) - len(removed) :]
+        self._main_hashes.extend(b.block_hash() for b in added)
+        bhash = block.block_hash()
+        if bhash in self._invalid:
+            # Indexed but contextually invalid (its transfers overdraw
+            # somewhere on its branch) — callers see a rejection, and the
+            # block is excluded from ``connected`` so persistence skips it.
+            return AddResult(AddStatus.REJECTED, reason=self._invalid[bhash])
         return AddResult(
             AddStatus.ACCEPTED,
             removed=removed,
             added=added,
-            connected=tuple(connected),
+            connected=tuple(
+                b for b in connected if b.block_hash() not in self._invalid
+            ),
         )
+
+    def _settle_tip(
+        self, old_tip: bytes
+    ) -> tuple[tuple[Block, ...], tuple[Block, ...]]:
+        """Advance the ledger to the work-chosen tip, demoting invalid
+        branches until a contextually valid tip wins.
+
+        Returns the net (removed, added) paths from ``old_tip`` to the
+        settled tip.  Terminates: each failed candidate marks at least one
+        block permanently invalid, and ``old_tip`` itself (whose state the
+        ledger currently holds) is always a valid fallback.
+        """
+        while self._tip_hash != old_tip:
+            removed, added = self._reorg_paths(old_tip, self._tip_hash)
+            for b in removed:
+                self._ledger.undo_block(b)
+            applied: list[Block] = []
+            failed: LedgerError | None = None
+            for b in added:
+                try:
+                    self._ledger.apply_block(b)
+                except LedgerError as e:
+                    self._mark_invalid_subtree(b.block_hash(), str(e))
+                    failed = e
+                    break
+                applied.append(b)
+            if failed is None:
+                return removed, added
+            # Roll the ledger back to old_tip and re-run fork choice over
+            # the remaining valid blocks.
+            for b in reversed(applied):
+                self._ledger.undo_block(b)
+            for b in reversed(removed):
+                self._ledger.apply_block(b)
+            self._tip_hash = self._best_valid_tip()
+        return (), ()
+
+    def _mark_invalid_subtree(self, bhash: bytes, reason: str) -> None:
+        """Permanently invalidate ``bhash`` and every indexed descendant."""
+        pending = [(bhash, reason)]
+        while pending:
+            h, why = pending.pop()
+            if h in self._invalid:
+                continue
+            self._invalid[h] = why
+            pending.extend(
+                (c, "descends from invalid block") for c in self._children.get(h, [])
+            )
+
+    def _best_valid_tip(self) -> bytes:
+        """Most-work non-invalid block (smaller hash on ties) — the same
+        ordering ``_insert`` applies incrementally, re-derived over the
+        whole index.  Only runs when a branch was just invalidated."""
+        best_hash, best = None, None
+        for h, entry in self._index.items():
+            if h in self._invalid:
+                continue
+            if (
+                best is None
+                or entry.work > best.work
+                or (entry.work == best.work and h < best_hash)
+            ):
+                best_hash, best = h, entry
+        assert best_hash is not None  # genesis is always valid
+        return best_hash
 
     def _insert(
         self, block: Block, prevalidated: bool = False
@@ -224,13 +328,23 @@ class Chain:
             return self._park_orphan(block, bhash)
         if not prevalidated:
             try:
-                check_block(block, self.difficulty)
+                check_block(
+                    block,
+                    self.difficulty,
+                    chain_tag=self.genesis.block_hash(),
+                )
             except ValidationError as e:
                 return AddStatus.REJECTED, str(e)
         entry = _Entry(
             block, prev.height + 1, prev.work + (1 << block.header.difficulty)
         )
         self._index[bhash] = entry
+        self._children.setdefault(block.header.prev_hash, []).append(bhash)
+        if block.header.prev_hash in self._invalid:
+            # An extension of an invalid branch is invalid by inheritance —
+            # index it (dedup/duplicate detection) but never offer it as tip.
+            self._invalid[bhash] = "descends from invalid block"
+            return AddStatus.ACCEPTED, ""
         tip = self._index[self._tip_hash]
         if entry.work > tip.work or (
             entry.work == tip.work and bhash < self._tip_hash
@@ -250,7 +364,9 @@ class Chain:
         if bhash in self._orphan_hashes:
             return AddStatus.ORPHAN, "already parked"
         try:
-            check_block(block, self.difficulty)
+            check_block(
+                block, self.difficulty, chain_tag=self.genesis.block_hash()
+            )
         except ValidationError as e:
             return AddStatus.REJECTED, str(e)
         self._orphans.setdefault(block.header.prev_hash, []).append(block)

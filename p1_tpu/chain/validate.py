@@ -9,21 +9,33 @@ block index is.
 from __future__ import annotations
 
 from p1_tpu.core.block import Block
+from p1_tpu.core.genesis import genesis_hash
 from p1_tpu.core.header import meets_target
+from p1_tpu.core.tx import BLOCK_REWARD
 
 
 class ValidationError(Exception):
     """A block or header failed consensus validation."""
 
 
-def check_block(block: Block, expected_difficulty: int, *, is_genesis: bool = False) -> None:
+def check_block(
+    block: Block,
+    expected_difficulty: int,
+    *,
+    is_genesis: bool = False,
+    chain_tag: bytes | None = None,
+) -> None:
     """Raise ``ValidationError`` unless ``block`` is internally valid.
 
     Checks: declared difficulty matches the chain's, proof-of-work meets the
     target (waived for genesis, which anchors by identity), the merkle root
-    commits to exactly these transactions, and no txid appears twice —
+    commits to exactly these transactions, no txid appears twice —
     the duplicate-txid rejection promised at p1_tpu/core/block.py:25
-    (CVE-2012-2459: duplicating the odd tail leaf forges a same-root block).
+    (CVE-2012-2459: duplicating the odd tail leaf forges a same-root block) —
+    the coinbase mints exactly ``BLOCK_REWARD`` (a hostile miner cannot set
+    an arbitrary subsidy; fees are credited separately by the ledger), and
+    every transfer carries a valid Ed25519 ownership proof
+    (``Transaction.verify_signature`` — only the key holder can spend).
     """
     header = block.header
     if header.difficulty != expected_difficulty:
@@ -35,11 +47,37 @@ def check_block(block: Block, expected_difficulty: int, *, is_genesis: bool = Fa
     txids = [tx.txid() for tx in block.txs]
     if len(set(txids)) != len(txids):
         raise ValidationError("duplicate txid in block")
+    # Structure before signatures (cheap hash checks gate the ~100 µs/tx
+    # Ed25519 verifies): the root must commit to these exact transactions
+    # before their ownership proofs are worth checking.
+    if block.compute_merkle_root() != header.merkle_root:
+        raise ValidationError("merkle root mismatch")
     # A coinbase (block-reward tx) is optional, but if present it must be
     # the first transaction and unique — any coinbase at index > 0 covers
     # both the misplaced and the duplicate case.
+    # The chain id transfers must be signed for: the ACTUAL genesis when
+    # the caller has one (Chain passes its own — which may be a custom
+    # genesis — so we never diverge from what HELLO/mempool advertise);
+    # derived from the difficulty for standalone stateless checks.
+    if chain_tag is None:
+        chain_tag = genesis_hash(expected_difficulty)
     for i, tx in enumerate(block.txs):
-        if i > 0 and tx.is_coinbase:
-            raise ValidationError("coinbase transaction must be first and unique")
-    if block.compute_merkle_root() != header.merkle_root:
-        raise ValidationError("merkle root mismatch")
+        if tx.is_coinbase:
+            if i > 0:
+                raise ValidationError(
+                    "coinbase transaction must be first and unique"
+                )
+            if tx.amount != BLOCK_REWARD:
+                raise ValidationError(
+                    f"coinbase mints {tx.amount}, subsidy is {BLOCK_REWARD}"
+                )
+        elif tx.chain != chain_tag:
+            # The signature is chain-bound: a spend signed for another
+            # chain (or with no tag at all) cannot be replayed here.
+            raise ValidationError("transaction signed for a different chain")
+        if not tx.verify_signature():
+            raise ValidationError(
+                "bad transaction signature"
+                if not tx.is_coinbase
+                else "coinbase must be unsigned"
+            )

@@ -20,6 +20,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import statistics
 import sys
 import time
@@ -74,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("node", help="run one p2p node")
     _add_common(p)
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="pin the JAX platform (e.g. cpu) before backend init — the "
+        "axon sitecustomize overrides the JAX_PLATFORMS env var, so an "
+        "explicit pin is the only reliable way to force CPU",
+    )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9444)
     p.add_argument("--peers", nargs="*", default=[], help="host:port ...")
@@ -96,16 +104,38 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--status-interval", type=float, default=10.0)
 
-    p = sub.add_parser("tx", help="submit a transaction to a running node")
+    p = sub.add_parser("tx", help="submit a signed transaction to a running node")
     p.add_argument("--difficulty", type=int, default=16, help="chain selector")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9444)
-    p.add_argument("--sender", required=True)
+    p.add_argument(
+        "--key",
+        required=True,
+        help="sender key file from `p1 keygen` (the sender id is the "
+        "key's account fingerprint — spends are signed, not asserted)",
+    )
     p.add_argument("--recipient", required=True)
     p.add_argument("--amount", type=int, required=True)
     p.add_argument("--fee", type=int, default=1)
     p.add_argument(
         "--seq", type=int, default=0, help="per-sender sequence number"
+    )
+
+    p = sub.add_parser(
+        "keygen", help="create an Ed25519 spending key (account = fingerprint)"
+    )
+    p.add_argument("--out", required=True, help="key file to write (0600)")
+    p.add_argument(
+        "--seed-text",
+        default=None,
+        help="derive deterministically from this label (TESTS ONLY: the "
+        "seed is sha256(label), so the account is publicly spendable)",
+    )
+    p.add_argument(
+        "--force",
+        action="store_true",
+        help="overwrite an existing key file (DESTROYS the old seed — "
+        "coins held by its account become unspendable)",
     )
 
     p = sub.add_parser(
@@ -420,6 +450,10 @@ async def _run_node(args, miner=None) -> int:
 
 
 def cmd_node(args) -> int:
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     try:
         return asyncio.run(_run_node(args))
     except KeyboardInterrupt:
@@ -430,16 +464,22 @@ def cmd_node(args) -> int:
 
 
 def cmd_tx(args) -> int:
+    from p1_tpu.core.keys import Keypair
     from p1_tpu.core.tx import Transaction
     from p1_tpu.node.client import send_tx
 
     try:
-        tx = Transaction(
-            args.sender, args.recipient, args.amount, args.fee, args.seq
+        from p1_tpu.core.genesis import genesis_hash
+
+        key = Keypair.load(args.key)
+        tx = Transaction.transfer(
+            key,
+            args.recipient,
+            args.amount,
+            args.fee,
+            args.seq,
+            chain=genesis_hash(args.difficulty),
         )
-        if tx.is_coinbase:
-            print("coinbase transactions cannot be submitted", file=sys.stderr)
-            return 2
         height = asyncio.run(
             send_tx(args.host, args.port, tx, args.difficulty)
         )
@@ -454,9 +494,38 @@ def cmd_tx(args) -> int:
         return 1
     print(
         json.dumps(
-            {"config": "tx", "txid": tx.txid().hex(), "peer_height": height}
+            {
+                "config": "tx",
+                "txid": tx.txid().hex(),
+                "sender": tx.sender,
+                "peer_height": height,
+            }
         )
     )
+    return 0
+
+
+# -- keygen --------------------------------------------------------------
+
+
+def cmd_keygen(args) -> int:
+    from p1_tpu.core.keys import Keypair
+
+    key = (
+        Keypair.from_seed_text(args.seed_text)
+        if args.seed_text is not None
+        else Keypair.generate()
+    )
+    try:
+        key.save(args.out, overwrite=args.force)
+    except FileExistsError:
+        print(
+            f"{args.out} already exists; refusing to destroy its seed "
+            "(use --force to overwrite)",
+            file=sys.stderr,
+        )
+        return 2
+    print(json.dumps({"config": "keygen", "account": key.account, "path": args.out}))
     return 0
 
 
@@ -467,23 +536,31 @@ class _PodWatchdog:
     """No-progress failsafe: a vanished pod peer leaves the survivor
     blocked inside a collective forever (aborts can't unblock it, and
     interpreter exit would hang on the executor join), so if no lockstep
-    point is reached for ``grace`` seconds the process force-exits.
+    point is reached for ``grace`` seconds the process fails over.
     ``grace`` covers the longest LEGITIMATE inter-beat gap — the first
     search's jit compile on a real mesh plus one chunk — independent of
-    run length (progress-based, not an absolute deadline).
+    run length (progress-based, not an absolute deadline).  Override with
+    ``P1_POD_GRACE_S`` (tests shrink it; operators can tune it).
+
+    On trip the watchdog runs ``on_trip`` — the LEADER re-execs itself
+    into a single-process ``p1 node`` against the same store and identity
+    (SURVEY §5 elastic recovery: mining degrades instead of going dark;
+    see ``cmd_pod``), while followers, whose chain state lives in the
+    leader, still just exit 3 for their external supervisor to restart.
 
     ``beat()`` is a plain monotonic-timestamp store (the hot path runs it
     per chunk); one long-lived daemon thread polls, instead of spawning a
     Timer thread per beat.
     """
 
-    GRACE_S = 600.0
-    _POLL_S = 5.0
+    _POLL_S = 1.0
 
-    def __init__(self, role: str):
+    def __init__(self, role: str, on_trip=None):
         import threading
 
         self.role = role
+        self.grace_s = float(os.environ.get("P1_POD_GRACE_S", "600"))
+        self._on_trip = on_trip
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._poll, daemon=True)
@@ -496,24 +573,82 @@ class _PodWatchdog:
         self._stop.set()
 
     def _poll(self) -> None:
-        import os
-
         while not self._stop.wait(self._POLL_S):
-            if time.monotonic() - self._last > self.GRACE_S:
+            if time.monotonic() - self._last > self.grace_s:
                 logging.error(
                     "pod watchdog (%s): no lockstep progress for %.0fs "
-                    "(peer lost?), exiting",
+                    "(peer lost?), failing over",
                     self.role,
-                    self.GRACE_S,
+                    self.grace_s,
                 )
-                os._exit(3)
+                if self._on_trip is not None:
+                    try:
+                        self._on_trip()
+                    except Exception:
+                        # A failed leader failover (os.execv can raise
+                        # ENOMEM/E2BIG, or the interpreter path vanished)
+                        # must still END the wedged process — the exit
+                        # code is the supervisor's only signal.
+                        logging.exception("pod failover failed")
+                os._exit(3)  # followers, or a failed on_trip
+
+
+def _pod_leader_failover(args, deadline: float) -> None:
+    """Degrade the pod leader to a single-process ``p1 node`` when a pod
+    peer vanishes (VERDICT r3 item 8 / SURVEY §5 elastic recovery).
+
+    ``os.execv`` replaces the wedged process image in place: the thread
+    stuck inside the dead collective, the jax.distributed client, and the
+    executor all go with it, while the pid (for the operator) and the
+    environment (JAX platform pins, XLA flags) survive.  The store's
+    writer flock is released automatically — Python opens files
+    close-on-exec — so the SAME process re-acquires the SAME store and
+    mining continues on the persisted chain with the same coinbase
+    identity and peer list, for the remainder of the original window.
+    Followers hold no chain state, so they still exit for their
+    supervisor (cmd_pod docstring documents the recipe).  A leader
+    configured with ``--port 0`` re-binds a fresh ephemeral port; pinned
+    ports are re-bound exactly (the old socket died with the exec).
+    """
+    argv = [
+        sys.executable, "-m", "p1_tpu", "node",
+        "--difficulty", str(args.difficulty),
+        "--backend", "sharded",  # local mesh only, no jax.distributed
+        "--host", args.host,
+        "--port", str(args.port),
+        "--duration", f"{max(5.0, deadline - time.time()):.1f}",
+    ]
+    if args.peers:
+        argv += ["--peers", *args.peers]
+    if args.miner_id:
+        argv += ["--miner-id", args.miner_id]
+    if args.store:
+        argv += ["--store", args.store]
+    if args.chunk:
+        argv += ["--chunk", str(args.chunk)]
+    if args.batch:
+        argv += ["--batch", str(args.batch)]
+    if args.platform:
+        argv += ["--platform", args.platform]
+    logging.error("pod leader failing over to solo mining: %s", " ".join(argv))
+    sys.stderr.flush()
+    os.execv(sys.executable, argv)
 
 
 def cmd_pod(args) -> int:
     """Multi-host mining (north star config 5, multi-host form): every
     process joins one jax.distributed mesh and mirrors the same sharded
     search in lockstep; process 0 additionally runs the p2p node, so the
-    whole pod presents as a single miner on the gossip network."""
+    whole pod presents as a single miner on the gossip network.
+
+    Failure handling: each role arms a no-progress watchdog (bounded runs
+    only).  A follower that loses the pod exits 3 — restart it with the
+    same ``--host-id`` under any supervisor (systemd ``Restart=on-failure``,
+    a shell loop) once the pod coordinator is back.  The LEADER owns the
+    chain store and the gossip identity, so it does NOT go dark: the
+    watchdog re-execs it into single-process sharded mining against the
+    same store/port/peers (``_pod_leader_failover``) and the chain keeps
+    growing while the pod is rebuilt."""
     if args.platform:
         import jax
 
@@ -529,7 +664,13 @@ def cmd_pod(args) -> int:
     # externally.
     watchdog = None
     if args.duration is not None:
-        watchdog = _PodWatchdog(role="leader" if is_leader else "follower")
+        deadline = time.time() + args.duration
+        on_trip = (
+            (lambda: _pod_leader_failover(args, deadline)) if is_leader else None
+        )
+        watchdog = _PodWatchdog(
+            role="leader" if is_leader else "follower", on_trip=on_trip
+        )
     kwargs = {"batch": args.batch} if args.batch else {}
     backend = get_backend("sharded", **kwargs)
     try:
@@ -770,6 +911,20 @@ def cmd_net(args) -> int:
         "height": max(s["height"] for s in statuses),
         "blocks_mined_total": sum(s["blocks_mined"] for s in statuses),
         "reorgs_total": sum(s["reorgs"] for s in statuses),
+        # Network-level propagation delay (gossip send -> accept), the
+        # worst node's view: median of per-node medians would hide a slow
+        # peer, so report the max median and the max p95 across nodes.
+        "propagation_delay_ms": {
+            "max_median": max(
+                (s["propagation"]["median_ms"] or 0.0 for s in statuses),
+                default=0.0,
+            ),
+            "max_p95": max(
+                (s["propagation"]["p95_ms"] or 0.0 for s in statuses),
+                default=0.0,
+            ),
+            "samples_total": sum(s["propagation"]["samples"] for s in statuses),
+        },
         "statuses": statuses,
     }
     print(json.dumps(result))
@@ -804,6 +959,7 @@ def main(argv=None) -> int:
         "replay": cmd_replay,
         "node": cmd_node,
         "tx": cmd_tx,
+        "keygen": cmd_keygen,
         "balances": cmd_balances,
         "compact": cmd_compact,
         "pod": cmd_pod,

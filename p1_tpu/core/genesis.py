@@ -9,6 +9,8 @@ check (it anchors the chain by identity, not by work) — validation in
 
 from __future__ import annotations
 
+import functools
+
 from p1_tpu.core.block import EMPTY_MERKLE_ROOT, Block
 from p1_tpu.core.header import BlockHeader
 
@@ -26,3 +28,10 @@ def make_genesis(difficulty: int) -> Block:
         nonce=0,
     )
     return Block(header, ())
+
+
+@functools.lru_cache(maxsize=256)
+def genesis_hash(difficulty: int) -> bytes:
+    """The chain id: genesis block hash for a difficulty (memoized — it is
+    the signing-domain tag of every transfer, checked per tx)."""
+    return make_genesis(difficulty).block_hash()

@@ -1,0 +1,137 @@
+"""Ed25519 account keys: ownership for the account model.
+
+Capability parity: the reference is "a Bitcoin-like toy cryptocurrency"
+(BASELINE.json:5) — "only the owner can spend" is the property that makes
+a ledger mean anything.  Design (tpu rebuild, round 4):
+
+- An **account id is a key fingerprint**: ``p1`` + first 16 hex chars of
+  SHA-256(public key).  Any string can *receive* coins (miner ids stay
+  free-form; coins sent to a non-fingerprint id are simply unspendable),
+  but only a transaction carrying the matching public key and a valid
+  Ed25519 signature can *spend* from a fingerprint account — enforced at
+  mempool admission AND block validation (p1_tpu/chain/validate.py).
+- Ed25519 via the ``cryptography`` package (present in this image; no
+  network egress to fetch anything else).  Signatures are 64 bytes,
+  public keys 32 — both fit the transaction's length-prefixed layout.
+- Deterministic from a 32-byte seed, so tests can use fixed keys and the
+  CLI can persist one JSON file per identity (``p1 keygen``).
+
+Verification is memoized (bounded LRU): a transaction is typically seen
+several times (gossip admission, block validation, reorg resurrection) and
+Ed25519 verify costs ~100 µs — the cache makes every re-check O(1).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric import ed25519
+
+#: Account-id prefix: distinguishes spendable (key-backed) accounts from
+#: free-form receive-only ids at a glance.
+ACCOUNT_PREFIX = "p1"
+_FINGERPRINT_HEX = 16
+
+PUBKEY_SIZE = 32
+SIG_SIZE = 64
+SEED_SIZE = 32
+
+
+def account_id(pubkey: bytes) -> str:
+    """The spendable account id owned by ``pubkey``."""
+    if len(pubkey) != PUBKEY_SIZE:
+        raise ValueError(f"public key must be {PUBKEY_SIZE} bytes")
+    return ACCOUNT_PREFIX + hashlib.sha256(pubkey).hexdigest()[:_FINGERPRINT_HEX]
+
+
+def account_id_or_none(pubkey: bytes) -> str | None:
+    """``account_id`` that maps a malformed key to None (never a valid
+    sender id) instead of raising — for use in validation predicates."""
+    return account_id(pubkey) if len(pubkey) == PUBKEY_SIZE else None
+
+
+class Keypair:
+    """One Ed25519 identity: seed -> (private, public, account id)."""
+
+    def __init__(self, seed: bytes):
+        if len(seed) != SEED_SIZE:
+            raise ValueError(f"seed must be {SEED_SIZE} bytes")
+        self._seed = seed
+        self._private = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
+        self.pubkey: bytes = self._private.public_key().public_bytes_raw()
+        self.account: str = account_id(self.pubkey)
+
+    @classmethod
+    def generate(cls) -> "Keypair":
+        return cls(os.urandom(SEED_SIZE))
+
+    @classmethod
+    def from_seed_text(cls, text: str) -> "Keypair":
+        """Deterministic keypair from any text label (tests/tools only —
+        the seed is the SHA-256 of the label, so the 'secret' is public)."""
+        return cls(hashlib.sha256(text.encode("utf-8")).digest())
+
+    def sign(self, message: bytes) -> bytes:
+        return self._private.sign(message)
+
+    # -- persistence (p1 keygen / p1 tx --key) ---------------------------
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        """Write the key as JSON {seed_hex, pubkey_hex, account} with
+        owner-only permissions (it contains the private seed).
+
+        Refuses to clobber an existing file unless ``overwrite`` — a seed
+        exists nowhere else, so silently truncating one would make every
+        coin its fingerprint holds permanently unspendable.
+        """
+        payload = json.dumps(
+            {
+                "seed_hex": self._seed.hex(),
+                "pubkey_hex": self.pubkey.hex(),
+                "account": self.account,
+            },
+            indent=2,
+        )
+        flags = os.O_WRONLY | os.O_CREAT | (
+            os.O_TRUNC if overwrite else os.O_EXCL
+        )
+        fd = os.open(path, flags, 0o600)
+        # os.open's mode only applies at creation — an overwrite of a
+        # pre-existing world-readable file must still end up owner-only.
+        os.fchmod(fd, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(payload + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Keypair":
+        with open(path) as f:
+            data = json.load(f)
+        kp = cls(bytes.fromhex(data["seed_hex"]))
+        if data.get("account") not in (None, kp.account):
+            raise ValueError(
+                f"key file {path} claims account {data['account']} but its "
+                f"seed derives {kp.account}"
+            )
+        return kp
+
+
+@functools.lru_cache(maxsize=65_536)
+def _verify_cached(pubkey: bytes, sig: bytes, message: bytes) -> bool:
+    try:
+        ed25519.Ed25519PublicKey.from_public_bytes(pubkey).verify(sig, message)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
+    """True iff ``sig`` is ``pubkey``'s valid Ed25519 signature over
+    ``message``.  Memoized — safe because the answer is a pure function
+    of the three byte strings."""
+    if len(pubkey) != PUBKEY_SIZE or len(sig) != SIG_SIZE:
+        return False
+    return _verify_cached(pubkey, sig, message)

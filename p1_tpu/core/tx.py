@@ -1,4 +1,4 @@
-"""Minimal transaction type with deterministic serialization.
+"""Minimal transaction type with deterministic serialization + ownership.
 
 Capability parity: the reference has a mempool of pending transactions feeding
 block assembly (BASELINE.json:5).  The exact reference tx format is unknown
@@ -6,12 +6,30 @@ block assembly (BASELINE.json:5).  The exact reference tx format is unknown
 simple account-model transfer: sender/recipient ids, amount, fee, and a
 sender-sequence number for uniqueness.  Deterministic big-endian serialization
 with length-prefixed ids; txid = SHA-256d of the serialization.
+
+Ownership (round 4): a non-coinbase transaction carries the sender's Ed25519
+public key and a signature over ``signing_bytes()`` — the five core fields
+PLUS the ``chain`` tag (the target chain's genesis hash), so a signature
+authorizes one spend on one chain: without the tag, a spend observed on a
+difficulty-16 chain could be replayed byte-identically against the same
+account's funds on a difficulty-20 chain.  Consensus
+(p1_tpu/chain/validate.py) checks the tag against the chain's genesis and
+the mempool against its configured chain, and both require
+``verify_signature()`` — the sender id must be the key's fingerprint
+(p1_tpu/core/keys.py) and the signature must check out, so only the key
+holder can spend from a fingerprint account.  Coinbases stay unsigned (they
+are minted by consensus per chain, not spent by an owner) and MUST carry
+empty pubkey/sig/chain.  The txid commits to the signature too (like a
+pre-segwit Bitcoin txid commits to scriptSig); Ed25519 signing is
+deterministic, so an honest signer produces one txid per transaction.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import struct
+
+from p1_tpu.core import keys as _keys
 
 _MAX_ID_LEN = 255
 
@@ -32,6 +50,9 @@ class Transaction:
     amount: int
     fee: int
     seq: int  # per-sender sequence number (uniqueness / replay protection)
+    pubkey: bytes = b""  # sender's Ed25519 public key (empty for coinbase)
+    sig: bytes = b""  # Ed25519 signature over signing_bytes()
+    chain: bytes = b""  # target chain's genesis hash (empty for coinbase)
 
     def __post_init__(self) -> None:
         for name in ("sender", "recipient"):
@@ -42,8 +63,13 @@ class Transaction:
             v = getattr(self, name)
             if not 0 <= v <= 0xFFFFFFFFFFFFFFFF:
                 raise ValueError(f"{name}={v} out of uint64 range")
+        for name in ("pubkey", "sig", "chain"):
+            if len(getattr(self, name)) > _MAX_ID_LEN:
+                raise ValueError(f"{name} exceeds {_MAX_ID_LEN} bytes")
 
-    def serialize(self) -> bytes:
+    def signing_bytes(self) -> bytes:
+        """What the sender signs: the five core fields plus the chain tag
+        (everything except the proof itself) — signatures are chain-bound."""
         s = self.sender.encode("utf-8")
         r = self.recipient.encode("utf-8")
         return b"".join(
@@ -53,6 +79,19 @@ class Transaction:
                 struct.pack(">B", len(r)),
                 r,
                 struct.pack(">QQQ", self.amount, self.fee, self.seq),
+                struct.pack(">B", len(self.chain)),
+                self.chain,
+            )
+        )
+
+    def serialize(self) -> bytes:
+        return b"".join(
+            (
+                self.signing_bytes(),
+                struct.pack(">B", len(self.pubkey)),
+                self.pubkey,
+                struct.pack(">B", len(self.sig)),
+                self.sig,
             )
         )
 
@@ -78,8 +117,23 @@ class Transaction:
         r, data = take(data, lb[0])
         nums, data = take(data, 24)
         amount, fee, seq = struct.unpack(">QQQ", nums)
+        lb, data = take(data, 1)
+        chain, data = take(data, lb[0])
+        lb, data = take(data, 1)
+        pubkey, data = take(data, lb[0])
+        lb, data = take(data, 1)
+        sig, data = take(data, lb[0])
         return (
-            cls(s.decode("utf-8"), r.decode("utf-8"), amount, fee, seq),
+            cls(
+                s.decode("utf-8"),
+                r.decode("utf-8"),
+                amount,
+                fee,
+                seq,
+                pubkey,
+                sig,
+                chain,
+            ),
             data,
         )
 
@@ -91,6 +145,40 @@ class Transaction:
     @property
     def is_coinbase(self) -> bool:
         return self.sender == COINBASE_SENDER
+
+    def verify_signature(self) -> bool:
+        """True iff this transaction proves ownership of its sender account.
+
+        Coinbase: must be bare (no pubkey/sig/chain) — minted, not spent.
+        Transfer: sender id must be the carried pubkey's fingerprint and the
+        signature must verify over ``signing_bytes()`` (which commits to the
+        ``chain`` tag — whether the tag names the RIGHT chain is the
+        caller's contextual check).  Memoized inside ``keys.verify`` so
+        gossip + block validation + resurrection re-checks are O(1) after
+        the first.
+        """
+        if self.is_coinbase:
+            return not self.pubkey and not self.sig and not self.chain
+        if self.sender != _keys.account_id_or_none(self.pubkey):
+            return False
+        return _keys.verify(self.pubkey, self.sig, self.signing_bytes())
+
+    @classmethod
+    def transfer(
+        cls,
+        key: "_keys.Keypair",
+        recipient: str,
+        amount: int,
+        fee: int,
+        seq: int,
+        chain: bytes = b"",
+    ) -> "Transaction":
+        """Build and sign a spend from ``key``'s account, bound to the
+        chain whose genesis hash is ``chain`` (consensus rejects transfers
+        whose tag names a different chain)."""
+        unsigned = cls(key.account, recipient, amount, fee, seq, chain=chain)
+        sig = key.sign(unsigned.signing_bytes())
+        return dataclasses.replace(unsigned, pubkey=key.pubkey, sig=sig)
 
     @classmethod
     def coinbase(

@@ -20,6 +20,7 @@ localhost.  Design (SURVEY.md §5):
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import logging
 import time
@@ -63,10 +64,27 @@ class NodeMetrics:
     hashes_done: int = 0
     mine_elapsed_s: float = 0.0
     last_block_time_s: float = 0.0
+    #: Rolling window of block propagation delays (peer's gossip send ->
+    #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
+    #: round-trips".  Bounded so a long-lived node's memory is too.
+    propagation_delays_s: collections.deque = dataclasses.field(
+        default_factory=lambda: collections.deque(maxlen=1024)
+    )
 
     @property
     def hashes_per_sec(self) -> float:
         return self.hashes_done / self.mine_elapsed_s if self.mine_elapsed_s else 0.0
+
+    def propagation_summary(self) -> dict:
+        """{median_ms, p95_ms, samples} over the rolling delay window."""
+        delays = sorted(self.propagation_delays_s)
+        if not delays:
+            return {"median_ms": None, "p95_ms": None, "samples": 0}
+        return {
+            "median_ms": round(1e3 * delays[len(delays) // 2], 3),
+            "p95_ms": round(1e3 * delays[min(len(delays) - 1, int(0.95 * len(delays)))], 3),
+            "samples": len(delays),
+        }
 
 
 class _Peer:
@@ -74,6 +92,20 @@ class _Peer:
         self.writer = writer
         self.label = label
         self.synced_once = False
+        #: The tip height the peer advertised in its HELLO — the bar our
+        #: own chain must reach before the initial mempool sync is worth
+        #: requesting (see ``mempool_requested``).
+        self.hello_height = 0
+        #: One-shot: the initial mempool sync for this peer has been
+        #: requested.  It is deferred until our chain has caught up to the
+        #: peer's advertised height — pool admission checks affordability
+        #: against OUR tip, so asking for transactions while our chain is
+        #: still behind would refuse perfectly valid spends of balances we
+        #: haven't learned yet.  (Keyed on the advertised height, not on
+        #: one peer's batch quiescing: with several peers serving the same
+        #: blocks, a duplicate batch quiesces early while the ledger is
+        #: still behind.)
+        self.mempool_requested = False
         #: (fee, txid) of the last mempool-sync tx received from this peer;
         #: must strictly advance in key order or the sync stops (hostile
         #: responders can't loop us).
@@ -95,7 +127,16 @@ class Node:
         #: fork-choice machinery is actually exercised at network level.
         self.miner_id = config.miner_id or f"m-{secrets.token_hex(4)}"
         self.chain = Chain(config.difficulty)
-        self.mempool = Mempool()
+        # balance_of is a bound-late lambda (not a bound method) so the
+        # store-resume path in start(), which REPLACES self.chain, keeps
+        # the pool pointed at the live chain's ledger.  The chain tag is
+        # safe to bind eagerly: it is a pure function of the difficulty,
+        # which a resume cannot change (start() refuses mismatched stores).
+        self.mempool = Mempool(
+            balance_of=lambda acct: self.chain.balance(acct),
+            nonce_of=lambda acct: self.chain.nonce(acct),
+            chain_tag=self.chain.genesis.block_hash(),
+        )
         self.metrics = NodeMetrics()
         self.store = ChainStore(config.store_path) if config.store_path else None
         if miner is not None:
@@ -129,10 +170,14 @@ class Node:
             if blocks and blocks[0].header.difficulty != self.config.difficulty:
                 # Restarting with a different --difficulty would silently
                 # reject every persisted record and interleave a second,
-                # incompatible chain behind them.
+                # incompatible chain behind them.  Release the writer lock
+                # before raising: an in-process retry with the corrected
+                # difficulty must not find its own leaked flock (ADVICE r3).
+                held_difficulty = blocks[0].header.difficulty
+                self.store.close()
                 raise RuntimeError(
                     f"store {self.store.path} holds a difficulty-"
-                    f"{blocks[0].header.difficulty} chain; node configured "
+                    f"{held_difficulty} chain; node configured "
                     f"for {self.config.difficulty}"
                 )
             # load_chain already routes every record through full add_block
@@ -278,11 +323,19 @@ class Node:
                 raise ValueError(f"peer limit {MAX_PEERS} reached")
             self._peers[writer] = peer
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
+            peer.hello_height = hello.tip_height
             if hello.tip_height > self.chain.height:
+                # Blocks first, mempool after: the BLOCKS handler requests
+                # the pool once our chain reaches the advertised height,
+                # so admission's affordability check runs against a
+                # caught-up ledger.
                 await peer.send(protocol.encode_getblocks(self.chain.locator()))
-            # Learn the peer's pending transactions too: block sync alone
-            # would leave a late joiner's pool empty until fresh gossip.
-            await peer.send(protocol.encode_getmempool())
+            else:
+                # Learn the peer's pending transactions too: block sync
+                # alone would leave a late joiner's pool empty until fresh
+                # gossip.
+                peer.mempool_requested = True
+                await peer.send(protocol.encode_getmempool())
             while self._running:
                 payload = await protocol.read_frame(reader)
                 await self._dispatch(peer, payload)
@@ -300,7 +353,8 @@ class Node:
     async def _dispatch(self, peer: _Peer, payload: bytes) -> None:
         mtype, body = protocol.decode(payload)
         if mtype is MsgType.BLOCK:
-            await self._handle_block(body, origin=peer)
+            sent_ts, block = body
+            await self._handle_block(block, origin=peer, sent_ts=sent_ts)
         elif mtype is MsgType.TX:
             await self._handle_tx(body, origin=peer)
         elif mtype is MsgType.GETBLOCKS:
@@ -316,16 +370,40 @@ class Node:
                 capped.append(blk)
             await self._send_guarded(peer, protocol.encode_blocks(capped))
         elif mtype is MsgType.BLOCKS:
+            # Batch the store's durability: per-append fsync (~2 ms) is
+            # right for the one-block gossip cadence but would stall this
+            # event loop for seconds across a deep resync batch — and a
+            # crash mid-batch only loses blocks the peer will re-serve.
+            batch_fsync = self.store is not None and self.store.fsync
+            if batch_fsync:
+                self.store.fsync = False
             accepted_any = False
-            for block in body:
-                res = await self._handle_block(block, origin=peer, gossip=False)
-                accepted_any |= res.status is AddStatus.ACCEPTED
+            try:
+                for block in body:
+                    res = await self._handle_block(
+                        block, origin=peer, gossip=False
+                    )
+                    accepted_any |= res.status is AddStatus.ACCEPTED
+            finally:
+                if batch_fsync:
+                    self.store.fsync = True
+                    self.store.sync()
             # Progress was made and the batch was non-empty: there may be
             # more behind it (an empty/duplicate reply ends the loop).
             if accepted_any and body:
                 await self._send_guarded(
                     peer, protocol.encode_getblocks(self.chain.locator())
                 )
+            elif (
+                not peer.mempool_requested
+                and self.chain.height >= peer.hello_height
+            ):
+                # Block sync with this peer quiesced AND our chain reached
+                # what it advertised: NOW ask for its pool, with our ledger
+                # caught up (one-shot per peer).  If another peer's sync is
+                # still filling the gap, the next quiesced batch re-checks.
+                peer.mempool_requested = True
+                await self._send_guarded(peer, protocol.encode_getmempool())
         elif mtype is MsgType.GETMEMPOOL:
             page, more = self.mempool.sync_page(body, MEMPOOL_SYNC_TXS)
             raws, total = [], 0
@@ -363,11 +441,17 @@ class Node:
         reading while we block in drain() must not wedge the dispatch
         loop.  Without this, two peers answering each other's sync
         requests with multi-MB replies can fill both transport buffers
-        and deadlock — a stalled peer is dropped instead."""
+        and deadlock — a stalled peer is dropped instead.
+
+        The timeout scales with payload size (ADVICE r3): a flat 5 s on an
+        8 MB sync reply would drop every healthy peer on a link slower
+        than ~1.6 MB/s and livelock its initial sync through the reconnect
+        loop.  The floor stays at GOSSIP_SEND_TIMEOUT_S for small pushes;
+        big replies get 1 s per 100 KB — still far faster than any link
+        worth keeping, but tolerant of a slow-but-live one."""
+        timeout = GOSSIP_SEND_TIMEOUT_S + len(payload) / 100_000
         try:
-            await asyncio.wait_for(
-                peer.send(payload), timeout=GOSSIP_SEND_TIMEOUT_S
-            )
+            await asyncio.wait_for(peer.send(payload), timeout=timeout)
         except (ConnectionError, OSError, asyncio.TimeoutError):
             peer.writer.close()  # reader loop will reap it
 
@@ -383,10 +467,21 @@ class Node:
     # -- chain/mempool handlers -----------------------------------------
 
     async def _handle_block(
-        self, block: Block, origin: _Peer | None = None, gossip: bool = True
+        self,
+        block: Block,
+        origin: _Peer | None = None,
+        gossip: bool = True,
+        sent_ts: float | None = None,
     ):
         res = self.chain.add_block(block)
         if res.status is AddStatus.ACCEPTED:
+            if sent_ts is not None:
+                # Push-gossip propagation delay (send -> accept), recorded
+                # only for blocks that actually connected: duplicates and
+                # orphans would skew the figure toward re-delivery noise.
+                self.metrics.propagation_delays_s.append(
+                    max(0.0, time.time() - sent_ts)
+                )
             self.metrics.blocks_accepted += 1
             if self.store is not None:
                 for connected in res.connected:  # incl. cascaded orphans
@@ -532,4 +627,5 @@ class Node:
             "blocks_mined": self.metrics.blocks_mined,
             "blocks_accepted": self.metrics.blocks_accepted,
             "reorgs": self.metrics.reorgs,
+            "propagation": self.metrics.propagation_summary(),
         }

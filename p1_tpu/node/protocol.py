@@ -9,7 +9,12 @@ Messages:
 
 - HELLO:     genesis hash (32) + tip height (4) + listen port (2).
              Sent both ways on connect; genesis mismatch = disconnect.
-- BLOCK:     one serialized block (push gossip).
+- BLOCK:     f64 sender wall-clock send time + one serialized block (push
+             gossip).  The timestamp is *telemetry only* — receivers use
+             it to measure propagation delay (send -> accept), never for
+             consensus.  Clocks are trusted to the extent NTP keeps hosts
+             in sync; the benchmark topology is localhost, where the skew
+             is zero by construction (SURVEY §5 gossip round-trip timing).
 - TX:        one serialized transaction (push gossip).
 - GETBLOCKS: u16 count + count * 32-byte locator hashes (sync request).
 - BLOCKS:    u16 count + count * (u32 len + serialized block) (sync reply).
@@ -38,7 +43,14 @@ from p1_tpu.core.tx import Transaction
 
 MAX_FRAME = 32 << 20  # hard cap against hostile length prefixes
 _LEN = struct.Struct(">I")
-_HELLO = struct.Struct(">32sIH")
+#: Wire protocol version, carried in HELLO.  Bump when any message layout
+#: changes incompatibly (round 4 did: BLOCK gained the f64 telemetry
+#: timestamp and transactions gained chain/pubkey/sig fields) so skewed
+#: peers fail the handshake with a clear error instead of mis-parsing the
+#: first gossip frame into a disconnect/reconnect loop.  Round 3 spoke an
+#: unversioned HELLO; its frames fail here as "bad HELLO size".
+PROTOCOL_VERSION = 2
+_HELLO = struct.Struct(">B32sIH")
 
 
 class MsgType(enum.IntEnum):
@@ -60,12 +72,15 @@ class Hello:
 
 def encode_hello(h: Hello) -> bytes:
     return bytes([MsgType.HELLO]) + _HELLO.pack(
-        h.genesis_hash, h.tip_height, h.listen_port
+        PROTOCOL_VERSION, h.genesis_hash, h.tip_height, h.listen_port
     )
 
 
-def encode_block(block: Block) -> bytes:
-    return bytes([MsgType.BLOCK]) + block.serialize()
+def encode_block(block: Block, sent_ts: float | None = None) -> bytes:
+    import time
+
+    ts = time.time() if sent_ts is None else sent_ts
+    return bytes([MsgType.BLOCK]) + struct.pack(">d", ts) + block.serialize()
 
 
 def encode_tx(tx: Transaction) -> bytes:
@@ -127,9 +142,18 @@ def decode(payload: bytes):
     if mtype is MsgType.HELLO:
         if len(body) != _HELLO.size:
             raise ValueError("bad HELLO size")
-        return mtype, Hello(*_HELLO.unpack(body))
+        version, *fields = _HELLO.unpack(body)
+        if version != PROTOCOL_VERSION:
+            raise ValueError(
+                f"protocol version mismatch: peer speaks v{version}, "
+                f"this node v{PROTOCOL_VERSION}"
+            )
+        return mtype, Hello(*fields)
     if mtype is MsgType.BLOCK:
-        return mtype, Block.deserialize(body)
+        if len(body) < 8:
+            raise ValueError("bad BLOCK")
+        (sent_ts,) = struct.unpack_from(">d", body)
+        return mtype, (sent_ts, Block.deserialize(body[8:]))
     if mtype is MsgType.TX:
         return mtype, Transaction.deserialize(body)
     if mtype is MsgType.GETBLOCKS:

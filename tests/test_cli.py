@@ -74,6 +74,75 @@ class TestCli:
         assert out["converged"], out
         assert out["height"] >= 1
 
+    def test_keygen_tx_mine_audit_e2e(self, tmp_path):
+        """The full currency drive, CLI only: keygen two identities, mine
+        to alice's account, alice pays bob with a SIGNED tx, audit the
+        persisted chain — bob got paid, nothing is negative (VERDICT r3
+        items 2+3 'live drive' criterion)."""
+        import time
+
+        alice_key = str(tmp_path / "alice.key")
+        bob_key = str(tmp_path / "bob.key")
+        alice = _run("keygen", "--out", alice_key, "--seed-text", "cli-alice")[
+            "account"
+        ]
+        bob = _run("keygen", "--out", bob_key, "--seed-text", "cli-bob")["account"]
+        import socket
+
+        store = str(tmp_path / "chain.dat")
+        with socket.socket() as s:  # a free port beats a hardcoded one
+            s.bind(("127.0.0.1", 0))
+            port = str(s.getsockname()[1])
+        # File-backed stdio: the node logs 2 lines per block at ms block
+        # times — a PIPE nobody drains fills at 64 KB and deadlocks the
+        # node's synchronous logging (and with it the whole event loop).
+        node_log = open(tmp_path / "node.log", "w")
+        node = subprocess.Popen(
+            [
+                sys.executable, "-m", "p1_tpu", "node",
+                "--difficulty", "12", "--backend", "cpu", "--chunk", "16384",
+                "--port", port, "--miner-id", alice, "--store", store,
+                "--duration", "12",
+            ],
+            stdout=node_log,
+            stderr=node_log,
+            cwd="/root/repo",
+        )
+        try:
+            # Submit once the node is up AND alice has earned a balance
+            # (admission checks affordability, so a too-early tx is
+            # refused silently — retry until the audit can succeed).
+            deadline = time.monotonic() + 30
+            sent = False
+            while not sent and time.monotonic() < deadline:
+                proc = subprocess.run(
+                    [
+                        sys.executable, "-m", "p1_tpu", "tx",
+                        "--difficulty", "12", "--port", port,
+                        "--key", alice_key, "--recipient", bob,
+                        "--amount", "7", "--fee", "1",
+                    ],
+                    capture_output=True, text=True, timeout=30, cwd="/root/repo",
+                )
+                if proc.returncode == 0:
+                    height = json.loads(proc.stdout)["peer_height"]
+                    sent = height >= 1  # alice funded from height 1 on
+                time.sleep(0.3)
+            assert sent, "node never became reachable with a funded miner"
+        finally:
+            # Generous: on a loaded 1-vCPU box the quiesce window and the
+            # interpreter startups above stretch well past the nominal 12s.
+            node.wait(timeout=120)
+            node_log.close()
+        out = _run(
+            "balances", "--store", store, "--difficulty", "12",
+            "--account", bob,
+        )
+        assert out["balance"] == 7, out
+        full = _run("balances", "--store", store, "--difficulty", "12")
+        assert all(v >= 0 for v in full["balances"].values())
+        assert full["balances"][alice] >= 50 - 8
+
     def test_unknown_backend_fails_cleanly(self):
         proc = subprocess.run(
             [sys.executable, "-m", "p1_tpu", "mine", "--backend", "nope"],

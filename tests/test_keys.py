@@ -1,0 +1,68 @@
+"""Ed25519 account keys: fingerprints, signing, persistence."""
+
+import json
+
+import pytest
+
+from p1_tpu.core import keys
+from p1_tpu.core.keys import Keypair
+
+
+class TestKeypair:
+    def test_deterministic_from_seed_text(self):
+        a1 = Keypair.from_seed_text("alice")
+        a2 = Keypair.from_seed_text("alice")
+        b = Keypair.from_seed_text("bob")
+        assert a1.account == a2.account and a1.pubkey == a2.pubkey
+        assert a1.account != b.account
+        assert a1.account.startswith(keys.ACCOUNT_PREFIX)
+
+    def test_sign_verify_round_trip(self):
+        kp = Keypair.generate()
+        msg = b"spend 5 to bob"
+        sig = kp.sign(msg)
+        assert keys.verify(kp.pubkey, sig, msg)
+        assert not keys.verify(kp.pubkey, sig, msg + b"!")
+        assert not keys.verify(Keypair.generate().pubkey, sig, msg)
+        assert not keys.verify(b"short", sig, msg)
+        assert not keys.verify(kp.pubkey, b"short", msg)
+
+    def test_account_id_or_none(self):
+        kp = Keypair.generate()
+        assert keys.account_id_or_none(kp.pubkey) == kp.account
+        assert keys.account_id_or_none(b"") is None
+        assert keys.account_id_or_none(b"x" * 31) is None
+
+    def test_save_load_round_trip(self, tmp_path):
+        import os
+
+        kp = Keypair.generate()
+        path = tmp_path / "id.key"
+        kp.save(str(path))
+        assert (os.stat(path).st_mode & 0o777) == 0o600
+        loaded = Keypair.load(str(path))
+        assert loaded.account == kp.account
+        assert loaded.sign(b"m") == kp.sign(b"m")
+
+    def test_save_refuses_overwrite(self, tmp_path):
+        # A truncated seed is an unrecoverable loss of funds: clobbering
+        # must be an explicit choice.
+        path = tmp_path / "id.key"
+        old = Keypair.generate()
+        old.save(str(path))
+        with pytest.raises(FileExistsError):
+            Keypair.generate().save(str(path))
+        assert Keypair.load(str(path)).account == old.account
+        new = Keypair.generate()
+        new.save(str(path), overwrite=True)
+        assert Keypair.load(str(path)).account == new.account
+
+    def test_load_rejects_tampered_account(self, tmp_path):
+        kp = Keypair.generate()
+        path = tmp_path / "id.key"
+        kp.save(str(path))
+        data = json.loads(path.read_text())
+        data["account"] = "p1" + "0" * 16
+        path.write_text(json.dumps(data))
+        with pytest.raises(ValueError, match="derives"):
+            Keypair.load(str(path))

@@ -11,6 +11,8 @@ import time
 
 import pytest
 
+from txutil import account, stx
+
 from p1_tpu.config import NodeConfig
 from p1_tpu.core import Transaction
 from p1_tpu.node import Node
@@ -21,6 +23,21 @@ CHUNK = 1 << 14  # fine-grained abort so stop() never waits long
 
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def fund(node, label: str, blocks: int = 1) -> None:
+    """Mine ``blocks`` block rewards to ``label``'s account on ``node``.
+
+    Consensus rejects overdraws and the pool mirrors the rule, so tests
+    that spend must first earn — exactly like a real participant.
+    """
+    old_id = node.miner_id
+    node.miner_id = account(label)
+    target = node.chain.height + blocks
+    node.start_mining()
+    assert await wait_until(lambda: node.chain.height >= target)
+    await node.stop_mining()
+    node.miner_id = old_id
 
 
 async def wait_until(cond, timeout=20.0, interval=0.02) -> bool:
@@ -98,7 +115,11 @@ class TestGossip:
                 assert await wait_until(
                     lambda: a.peer_count() and c.peer_count()
                 )
-                tx = Transaction("alice", "bob", 5, 1, 0)
+                # Earn before spending: every pool checks affordability
+                # against its own tip, so the funding block must reach c.
+                await fund(a, "alice")
+                assert await wait_until(lambda: c.chain.height >= 1)
+                tx = stx("alice", "bob", 5, 1, 0, difficulty=DIFF)
                 await a.submit_tx(tx)
                 assert await wait_until(lambda: tx.txid() in c.mempool)
                 assert tx.txid() in b.mempool
@@ -113,18 +134,33 @@ class TestGossip:
             miner_node = nodes[0]
             try:
                 assert await wait_until(lambda: miner_node.peer_count())
-                tx = Transaction("alice", "bob", 5, 1, 0)
+                await fund(miner_node, "alice")
+                assert await wait_until(lambda: nodes[1].chain.height >= 1)
+                tx = stx("alice", "bob", 5, 1, 0, difficulty=DIFF)
                 await nodes[1].submit_tx(tx)
-                await wait_until(lambda: tx.txid() in miner_node.mempool)
+                assert await wait_until(
+                    lambda: tx.txid() in miner_node.mempool
+                )
                 miner_node.start_mining()  # mine exactly on node 0
-                assert await wait_until(lambda: nodes[1].chain.height >= 3)
+                # The real success condition: the tx gets mined out of the
+                # pool (an absolute height target would race fund()'s
+                # overshoot — stop_mining can land before the tx's block).
+                assert await wait_until(
+                    lambda: tx.txid() not in miner_node.mempool
+                )
                 await miner_node.stop_mining()
                 assert await wait_until(
                     lambda: nodes[1].chain.tip_hash == miner_node.chain.tip_hash
                 )
-                # the mined tx landed in a block and left both mempools
-                assert tx.txid() not in miner_node.mempool
+                assert nodes[1].chain.height >= 3
+                # block acceptance at the peer evicted it there too
                 assert tx.txid() not in nodes[1].mempool
+                # Propagation timing (SURVEY §5): the receiving node
+                # measured send->accept delay for the pushed blocks.
+                prop = nodes[1].metrics.propagation_summary()
+                assert prop["samples"] >= 1
+                assert prop["median_ms"] is not None and prop["median_ms"] >= 0
+                assert nodes[1].status()["propagation"] == prop
             finally:
                 await stop_all(nodes)
 
@@ -139,11 +175,13 @@ class TestTxClient:
             nodes = await start_mesh(2)
             try:
                 assert await wait_until(lambda: nodes[1].peer_count())
-                tx = Transaction("alice", "bob", 7, 1, 0)
+                await fund(nodes[0], "alice")
+                assert await wait_until(lambda: nodes[1].chain.height >= 1)
+                tx = stx("alice", "bob", 7, 1, 0, difficulty=DIFF)
                 height = await send_tx(
                     "127.0.0.1", nodes[0].port, tx, DIFF
                 )
-                assert height == 0
+                assert height == nodes[0].chain.height
                 # reaches the directly-connected node AND its peer
                 assert await wait_until(lambda: tx.txid() in nodes[0].mempool)
                 assert await wait_until(lambda: tx.txid() in nodes[1].mempool)
@@ -171,7 +209,7 @@ class TestTxClient:
             a = Node(_config())
             await a.start()
             try:
-                tx = Transaction("alice", "bob", 7, 1, 0)
+                tx = stx("alice", "bob", 7, 1, 0, difficulty=DIFF)
                 with pytest.raises(ValueError, match="genesis mismatch"):
                     await send_tx("127.0.0.1", a.port, tx, DIFF + 1)
                 assert tx.txid() not in a.mempool
@@ -299,8 +337,9 @@ class TestConvergence:
             a = Node(_config())
             await a.start()
             try:
+                await fund(a, "alice", blocks=2)  # 8 txs cost 76 > one reward
                 txs = [
-                    Transaction("alice", "bob", 5, f + 1, f) for f in range(8)
+                    stx("alice", "bob", 5, f + 1, f, difficulty=DIFF) for f in range(8)
                 ]
                 for tx in txs:
                     await a.submit_tx(tx)
@@ -323,7 +362,8 @@ class TestConvergence:
             a = Node(_config())
             await a.start()
             try:
-                txs = [Transaction("alice", "bob", 5, f, 0 + f) for f in (1, 2, 3)]
+                await fund(a, "alice")
+                txs = [stx("alice", "bob", 5, f, 0 + f, difficulty=DIFF) for f in (1, 2, 3)]
                 for tx in txs:
                     await a.submit_tx(tx)
                 # b joins AFTER the txs exist; block sync alone would leave
@@ -498,8 +538,8 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool
 
         pool = Mempool()
-        cheap = Transaction("a", "b", 1, 1, 0)
-        rich = Transaction("c", "d", 1, 9, 0)
+        cheap = stx("a", "b", 1, 1, 0, difficulty=DIFF)
+        rich = stx("c", "d", 1, 9, 0, difficulty=DIFF)
         assert pool.add(cheap) and pool.add(rich)
         assert not pool.add(cheap)  # dedup
         assert pool.select() == [rich, cheap]
@@ -508,9 +548,9 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool
 
         pool = Mempool()
-        cheap = Transaction("alice", "bob", 5, 1, 7)
-        rich = Transaction("alice", "carol", 5, 3, 7)  # same (sender, seq)
-        equal = Transaction("alice", "dave", 5, 3, 7)
+        cheap = stx("alice", "bob", 5, 1, 7, difficulty=DIFF)
+        rich = stx("alice", "carol", 5, 3, 7, difficulty=DIFF)  # same (sender, seq)
+        equal = stx("alice", "dave", 5, 3, 7, difficulty=DIFF)
         assert pool.add(cheap)
         assert pool.add(rich)  # outbids -> replaces
         assert cheap.txid() not in pool and rich.txid() in pool
@@ -518,7 +558,7 @@ class TestMempoolUnit:
         assert not pool.add(cheap)  # replay of an outbid tx
         assert len(pool) == 1
         # independent slots coexist
-        assert pool.add(Transaction("alice", "bob", 5, 1, 8))
+        assert pool.add(stx("alice", "bob", 5, 1, 8, difficulty=DIFF))
         assert len(pool) == 2
 
     def test_confirmation_evicts_slot_rivals(self):
@@ -527,8 +567,8 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool
 
         pool = Mempool()
-        confirmed = Transaction("alice", "bob", 5, 1, 7)
-        rival = Transaction("alice", "carol", 5, 9, 7)
+        confirmed = stx("alice", "bob", 5, 1, 7, difficulty=DIFF)
+        rival = stx("alice", "carol", 5, 9, 7, difficulty=DIFF)
         assert pool.add(rival)
         # A block confirms the OTHER spend of slot (alice, 7): the pending
         # rival is now a replay and must leave the pool with it.
@@ -542,7 +582,7 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool
 
         pool = Mempool()
-        txs = [Transaction("alice", "bob", 5, 10 - f, f) for f in range(8)]
+        txs = [stx("alice", "bob", 5, 10 - f, f, difficulty=DIFF) for f in range(8)]
         for tx in txs:
             assert pool.add(tx)
         page1, more = pool.sync_page(None, 3)
@@ -563,12 +603,12 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool
 
         pool = Mempool(max_txs=1)
-        assert pool.add(Transaction("alice", "bob", 5, 1, 7))
+        assert pool.add(stx("alice", "bob", 5, 1, 7, difficulty=DIFF))
         # Same slot, higher fee: replacement frees the incumbent's
         # capacity, so it is admitted even though the pool is full...
-        assert pool.add(Transaction("alice", "carol", 5, 2, 7))
+        assert pool.add(stx("alice", "carol", 5, 2, 7, difficulty=DIFF))
         # ...while a NEW slot is refused for capacity.
-        assert not pool.add(Transaction("dave", "erin", 5, 9, 0))
+        assert not pool.add(stx("dave", "erin", 5, 9, 0, difficulty=DIFF))
         assert len(pool) == 1
 
     def test_confirmed_slot_refuses_late_replay(self):
@@ -577,7 +617,7 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool
 
         pool = Mempool()
-        confirmed = Transaction("alice", "bob", 5, 1, 7)
+        confirmed = stx("alice", "bob", 5, 1, 7, difficulty=DIFF)
         header = BlockHeader(
             1, bytes(32), merkle_root([confirmed.txid()]), 1, DIFF, 0
         )
@@ -585,11 +625,45 @@ class TestMempoolUnit:
         pool.apply_block_delta((), (block,))
         # A spend of the confirmed slot arriving AFTER confirmation (gossip
         # reorder) is refused, whatever its fee.
-        late = Transaction("alice", "mallory", 5, 99, 7)
+        late = stx("alice", "mallory", 5, 99, 7, difficulty=DIFF)
         assert not pool.add(late)
         # ... until a reorg rolls the confirmation back.
         pool.apply_block_delta((block,), ())
         assert confirmed.txid() in pool
+
+    def test_full_paged_sync_scales(self, monkeypatch):
+        """VERDICT r3 item 9: a late joiner paging a 100k-tx pool must not
+        pay O(n) per page.  Signature verification is patched out (the
+        pager's complexity is under test, not Ed25519 throughput — 100k
+        real signs would dominate the clock and hide a pager regression);
+        churn-correctness of the key cursor is covered separately above."""
+        import time as time_mod
+
+        from p1_tpu.mempool import Mempool, mempool as mempool_mod
+
+        monkeypatch.setattr(
+            mempool_mod.Transaction, "verify_signature", lambda self: True
+        )
+        pool = Mempool(max_txs=200_000)
+        t0 = time_mod.perf_counter()
+        for i in range(100_000):
+            assert pool.add(Transaction("s", "r", 1, i % 1000, i))
+        build_s = time_mod.perf_counter() - t0
+        assert len(pool) == 100_000
+        # Full paged sync, 2000/page (the node's MEMPOOL_SYNC_TXS).
+        t0 = time_mod.perf_counter()
+        cursor, got, more = None, 0, True
+        while more:
+            page, more = pool.sync_page(cursor, 2000)
+            got += len(page)
+            last = page[-1]
+            cursor = (last.fee, last.txid())
+        sync_s = time_mod.perf_counter() - t0
+        assert got == 100_000
+        # The old filter-everything pager took ~2 min for this loop on
+        # this box; the indexed one is sub-second with huge margin even
+        # under CI contention.
+        assert sync_s < 20, f"paged sync took {sync_s:.1f}s (built in {build_s:.1f}s)"
 
     def test_coinbase_never_enters_pool(self):
         from p1_tpu.core.block import Block, merkle_root
@@ -615,8 +689,8 @@ class TestMempoolUnit:
             return Block(header, tuple(txs))
 
         pool = Mempool()
-        t1 = Transaction("a", "b", 1, 1, 0)
-        t2 = Transaction("c", "d", 2, 2, 0)
+        t1 = stx("a", "b", 1, 1, 0, difficulty=DIFF)
+        t2 = stx("c", "d", 2, 2, 0, difficulty=DIFF)
         pool.add(t1)
         pool.add(t2)
         pool.apply_block_delta((), (block_with([t1]),))

@@ -126,3 +126,76 @@ def test_two_process_pod_mines_and_gossips():
     assert listener_status["height"] == leader_status["height"]
     assert listener_status["tip"] == leader_status["tip"]
     assert listener_status["blocks_mined"] == 0
+
+
+def test_leader_survives_follower_sigkill(tmp_path):
+    """VERDICT r3 item 8 / SURVEY §5 elastic recovery: SIGKILL a follower
+    mid-run -> the leader must NOT go dark.  Its watchdog re-execs it into
+    single-process sharded mining against the same store, so the chain
+    keeps growing within the grace window."""
+    import signal
+    import time
+
+    from p1_tpu.chain import ChainStore
+
+    coord = _free_port()
+    store = tmp_path / "pod-chain.dat"
+    env = _env(4)
+    env["P1_POD_GRACE_S"] = "20"  # must still cover the first jit compile
+    pod_cmd = [
+        sys.executable, "-m", "p1_tpu", "pod",
+        "--coordinator", f"127.0.0.1:{coord}",
+        "--num-hosts", "2",
+        "--platform", "cpu",
+        "--difficulty", "12",
+        "--chunk", str(1 << 12),
+        "--batch", "256",
+        "--duration", "90",
+    ]
+    log = open(tmp_path / "leader.log", "w")
+    leader = subprocess.Popen(
+        [*pod_cmd, "--host-id", "0", "--port", "0",
+         "--miner-id", "pod", "--store", str(store)],
+        env=env, stdout=log, stderr=log,
+    )
+    follower = subprocess.Popen(
+        [*pod_cmd, "--host-id", "1"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+    def store_blocks() -> int:
+        try:
+            return len(ChainStore(store).load_blocks())
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    try:
+        # Wait for the pod to actually mine (store grows past genesis).
+        deadline = time.monotonic() + 120
+        while store_blocks() < 3 and time.monotonic() < deadline:
+            time.sleep(0.5)
+        pre_kill = store_blocks()
+        assert pre_kill >= 3, "pod never started mining"
+
+        follower.send_signal(signal.SIGKILL)
+        follower.wait(timeout=10)
+
+        # Within grace (20s) + margin the leader must fail over and keep
+        # extending the SAME store — same pid, new process image.
+        deadline = time.monotonic() + 75
+        grown = False
+        while time.monotonic() < deadline:
+            if store_blocks() >= pre_kill + 3:
+                grown = True
+                break
+            time.sleep(1.0)
+        assert grown, (
+            f"chain stuck at {store_blocks()} blocks after follower kill "
+            f"(pre-kill {pre_kill}); leader.log tail: "
+            + open(tmp_path / "leader.log").read()[-2000:]
+        )
+    finally:
+        for proc in (leader, follower):
+            if proc.poll() is None:
+                proc.kill()
+        log.close()

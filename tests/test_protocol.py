@@ -26,10 +26,29 @@ class TestRoundTrips:
         mtype, got = protocol.decode(protocol.encode_hello(h))
         assert mtype is MsgType.HELLO and got == h
 
+    def test_hello_version_mismatch_rejected(self):
+        # A peer speaking another protocol version (e.g. round 3's
+        # unversioned frames would also fail, by size) must die at the
+        # handshake with a clear error, not mis-parse gossip later.
+        import struct
+
+        payload = bytes([MsgType.HELLO]) + struct.pack(
+            ">B32sIH", protocol.PROTOCOL_VERSION + 1, b"\xab" * 32, 1, 1
+        )
+        with pytest.raises(ValueError, match="protocol version"):
+            protocol.decode(payload)
+
     def test_block(self):
+        import time
+
         block = _block()
-        mtype, got = protocol.decode(protocol.encode_block(block))
+        before = time.time()
+        mtype, (sent_ts, got) = protocol.decode(protocol.encode_block(block))
         assert mtype is MsgType.BLOCK and got == block
+        assert before <= sent_ts <= time.time()
+        # Explicit timestamps survive the round trip exactly (f64).
+        _, (ts2, _) = protocol.decode(protocol.encode_block(block, sent_ts=1.5))
+        assert ts2 == 1.5
 
     def test_tx(self):
         tx = Transaction("alice", "bob", 5, 1, 0)

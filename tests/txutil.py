@@ -1,0 +1,51 @@
+"""Signed-transaction helpers shared by the test suite.
+
+Consensus and the mempool require Ed25519 ownership proofs on every
+transfer (round 4), so tests build spends through ``stx`` — a drop-in for
+the old raw ``Transaction(sender, ...)`` constructor that derives one
+deterministic keypair per sender *label* and signs with it.  Two calls
+with the same label spend from the same account (preserving the
+(sender, seq) slot semantics the mempool tests rely on).
+"""
+
+import functools
+
+from p1_tpu.core.genesis import genesis_hash
+from p1_tpu.core.keys import Keypair
+from p1_tpu.core.tx import Transaction
+
+
+@functools.lru_cache(maxsize=None)
+def key_for(label: str) -> Keypair:
+    """The test suite's deterministic keypair for a human-readable label."""
+    return Keypair.from_seed_text(f"p1-test-{label}")
+
+
+def account(label: str) -> str:
+    return key_for(label).account
+
+
+def stx(
+    sender_label: str,
+    recipient: str,
+    amount: int,
+    fee: int,
+    seq: int,
+    difficulty: int = 8,
+) -> Transaction:
+    """A signed transfer from the account behind ``sender_label``.
+
+    ``recipient`` may be another label's account (pass ``account(label)``)
+    or any free-form id — recipients need no key.  Signatures are
+    chain-bound, so pass the ``difficulty`` of the chain the tx targets;
+    the default matches the chain-test suites' DIFF=8 (pool-only unit
+    tests never check the tag, so any value works there).
+    """
+    return Transaction.transfer(
+        key_for(sender_label),
+        recipient,
+        amount,
+        fee,
+        seq,
+        chain=genesis_hash(difficulty),
+    )
