@@ -5,7 +5,8 @@ A ground-up rebuild of the capabilities of the reference project `qzwlecr/p1`
 against the driver-recorded capability model in /root/repo/BASELINE.json):
 
 - ``p1_tpu.core``    — block/header/transaction types, deterministic
-  serialization, difficulty/target math, genesis.
+  serialization, difficulty/target math, genesis, Ed25519 account keys
+  (account id = key fingerprint; chain-bound signed transfers).
 - ``p1_tpu.hashx``   — the ``HashBackend`` plugin registry (BASELINE.json:5)
   with CPU (hashlib), C++ ``native`` (SHA-NI when available, built lazily
   from p1_tpu/native/), NumPy-oracle, JAX/XLA, Pallas-TPU (``tpu``) and
@@ -13,12 +14,17 @@ against the driver-recorded capability model in /root/repo/BASELINE.json):
 - ``p1_tpu.miner``   — ``Miner.search_nonce()`` (BASELINE.json:5): the nonce
   search as batched device steps; multi-chip sharding with a pmin first-hit
   reduction over a ``jax.sharding.Mesh``.
-- ``p1_tpu.chain``   — chain validation, longest-chain fork choice with reorg,
-  persistence (checkpoint/resume), header-chain replay.
+- ``p1_tpu.chain``   — stateless + contextual validation (signatures,
+  subsidy, overdraw rejection, strict account nonces via the incremental
+  tip ledger), longest-chain fork choice with reorg and invalid-branch
+  demotion, fsync-durable persistence (checkpoint/resume), header-chain
+  replay (host / C++ native / one-dispatch device engines).
 - ``p1_tpu.mempool`` — pending-transaction pool (per-(sender, seq) slots,
-  replace-by-fee, confirmed-slot replay window).
-- ``p1_tpu.node``    — asyncio TCP p2p gossip node (blocks + txs, locator
-  block sync, paged mempool sync) + a thin tx-submission client.
+  replace-by-fee, confirmed-slot replay window, balance/nonce-aware
+  admission + gap-free block selection, sorted sync index).
+- ``p1_tpu.node``    — asyncio TCP p2p gossip node (versioned protocol;
+  blocks + txs, locator block sync, paged mempool sync, account queries,
+  propagation-delay metrics) + thin wallet clients (send_tx, get_account).
 - ``p1_tpu.parallel`` — multi-host pod mining: one ``jax.distributed``
   mesh across processes/hosts, lockstep searches, one miner on the
   gossip network.

@@ -5,6 +5,7 @@ from p1_tpu.chain.replay import (
     generate_headers,
     replay_device,
     replay_host,
+    replay_native,
 )
 from p1_tpu.chain.store import ChainStore, save_chain
 from p1_tpu.chain.validate import ValidationError, check_block
@@ -21,5 +22,6 @@ __all__ = [
     "generate_headers",
     "replay_device",
     "replay_host",
+    "replay_native",
     "save_chain",
 ]

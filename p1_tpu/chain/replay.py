@@ -94,6 +94,28 @@ def replay_host(headers: list[BlockHeader]) -> ReplayReport:
     )
 
 
+def replay_native(headers: list[BlockHeader]) -> ReplayReport:
+    """C++ verification engine: one ctypes call over the packed headers
+    (SHA-NI compressions, no per-header Python) — the native tier of
+    benchmark config 3, same rules as ``replay_host`` (its oracle)."""
+    from p1_tpu.hashx.native_backend import verify_header_chain
+
+    difficulty = headers[0].difficulty if headers else 0
+    # Packing is inside the timer: replay_host pays per-header serialize
+    # in ITS timer too, so the reported rates compare end-to-end (the
+    # Python join costs about as much as the C verify itself).
+    t0 = time.perf_counter()
+    raw = b"".join(h.serialize() for h in headers)
+    first_invalid = verify_header_chain(raw, len(headers), difficulty)
+    return ReplayReport(
+        len(headers),
+        first_invalid is None,
+        first_invalid,
+        time.perf_counter() - t0,
+        "native",
+    )
+
+
 def replay_device(
     headers: list[BlockHeader], segment: int = 8192, platform: str | None = None
 ) -> ReplayReport:

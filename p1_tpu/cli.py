@@ -69,7 +69,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("replay", help="generate+verify a header chain (config 3)")
     _add_common(p)
     p.add_argument("--n", type=int, default=10_000)
-    p.add_argument("--method", choices=["host", "device", "both"], default="both")
+    p.add_argument(
+        "--method",
+        choices=["host", "native", "device", "both", "all"],
+        default="both",
+        help="verification engine(s): host=hashlib oracle, native=C++ "
+        "SHA-NI, device=one-dispatch lax.scan; both=host+device, all=every "
+        "engine",
+    )
     p.add_argument("--out", default=None, help="write generated headers here")
     p.add_argument("--verify", default=None, help="verify this header file instead")
 
@@ -118,7 +125,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--amount", type=int, required=True)
     p.add_argument("--fee", type=int, default=1)
     p.add_argument(
-        "--seq", type=int, default=0, help="per-sender sequence number"
+        "--seq",
+        type=int,
+        default=None,
+        help="account nonce to spend (consensus requires the sender's "
+        "exact next nonce; default: query the node via GETACCOUNT and "
+        "use its next usable seq)",
+    )
+
+    p = sub.add_parser(
+        "account",
+        help="query an account's balance/nonce from a running node",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--account", default=None, help="account id (or use --key)"
+    )
+    p.add_argument(
+        "--key", default=None, help="key file; queries its fingerprint account"
     )
 
     p = sub.add_parser(
@@ -200,6 +226,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--duration", type=float, default=10.0)
     p.add_argument("--base-port", type=int, default=19444)
+    p.add_argument(
+        "--tx-rate",
+        type=float,
+        default=0.0,
+        help="inject ~R signed transfers/sec between the miners' accounts "
+        "during the run (each node mines to a keyed account); the summary "
+        "then audits ledger conservation (sum == reward x height) on "
+        "every node",
+    )
 
     sub.add_parser("bench", help="headline benchmark (one JSON line)")
     return parser
@@ -331,7 +366,12 @@ def cmd_sweep(args) -> int:
 
 
 def cmd_replay(args) -> int:
-    from p1_tpu.chain import generate_headers, replay_device, replay_host
+    from p1_tpu.chain import (
+        generate_headers,
+        replay_device,
+        replay_host,
+        replay_native,
+    )
     from p1_tpu.core.header import HEADER_SIZE, BlockHeader
     from p1_tpu.hashx import get_backend
 
@@ -356,9 +396,11 @@ def cmd_replay(args) -> int:
                     fh.write(h.serialize())
 
     reports = []
-    if args.method in ("host", "both"):
+    if args.method in ("host", "both", "all"):
         reports.append(replay_host(headers))
-    if args.method in ("device", "both"):
+    if args.method in ("native", "all"):
+        reports.append(replay_native(headers))
+    if args.method in ("device", "both", "all"):
         reports.append(replay_device(headers))
         reports.append(replay_device(headers))  # warm (compile amortized)
     ok = all(r.valid for r in reports)
@@ -470,14 +512,23 @@ def cmd_tx(args) -> int:
 
     try:
         from p1_tpu.core.genesis import genesis_hash
+        from p1_tpu.node.client import get_account
 
         key = Keypair.load(args.key)
+        seq = args.seq
+        if seq is None:
+            # Wallet convenience: consensus wants the exact next nonce, so
+            # ask the node (chain nonce advanced past its pending pool).
+            state = asyncio.run(
+                get_account(args.host, args.port, key.account, args.difficulty)
+            )
+            seq = state.next_seq
         tx = Transaction.transfer(
             key,
             args.recipient,
             args.amount,
             args.fee,
-            args.seq,
+            seq,
             chain=genesis_hash(args.difficulty),
         )
         height = asyncio.run(
@@ -498,7 +549,47 @@ def cmd_tx(args) -> int:
                 "config": "tx",
                 "txid": tx.txid().hex(),
                 "sender": tx.sender,
+                "seq": seq,
                 "peer_height": height,
+            }
+        )
+    )
+    return 0
+
+
+# -- account -------------------------------------------------------------
+
+
+def cmd_account(args) -> int:
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.node.client import get_account
+
+    if (args.account is None) == (args.key is None):
+        print("pass exactly one of --account / --key", file=sys.stderr)
+        return 2
+    try:
+        account = args.account or Keypair.load(args.key).account
+        state = asyncio.run(
+            get_account(args.host, args.port, account, args.difficulty)
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"account query failed: {e}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(
+            {
+                "config": "account",
+                "account": state.account,
+                "balance": state.balance,
+                "nonce": state.nonce,
+                "next_seq": state.next_seq,
+                "height": state.tip_height,
             }
         )
     )
@@ -843,12 +934,72 @@ def cmd_compact(args) -> int:
 # -- net -----------------------------------------------------------------
 
 
+def _net_inject_txs(ports, keys, difficulty, deadline, rate) -> tuple[int, int]:
+    """Drive a live economy during a `p1 net` run: ~``rate`` transfers/sec,
+    each one a real wallet round — GETACCOUNT for the sender's next seq at
+    its own node, sign chain-bound, push via the tx client.  Best-effort:
+    a busy node (GIL-bound mining) or an unaffordable pick just skips a
+    beat; the audit invariant is conservation, not delivery."""
+    import random
+
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.node.client import get_account, send_tx
+
+    tag = genesis_hash(difficulty)
+    submitted = failed = 0
+
+    async def run() -> None:
+        nonlocal submitted, failed
+        rng = random.Random(0xD1CE)
+        period = 1.0 / rate
+        while time.time() < deadline - 1.0:
+            i = rng.randrange(len(keys))
+            recipient = keys[rng.randrange(len(keys))].account
+            try:
+                state = await get_account(
+                    "127.0.0.1", ports[i], keys[i].account, difficulty, timeout=5
+                )
+                amount = rng.randint(1, 5)
+                if state.balance >= amount + 1:
+                    tx = Transaction.transfer(
+                        keys[i], recipient, amount, 1, state.next_seq, chain=tag
+                    )
+                    await send_tx(
+                        "127.0.0.1", ports[i], tx, difficulty, timeout=5
+                    )
+                    submitted += 1
+            except (
+                ConnectionError,
+                OSError,
+                ValueError,
+                asyncio.TimeoutError,
+                asyncio.IncompleteReadError,
+            ):
+                failed += 1
+            await asyncio.sleep(period)
+
+    asyncio.run(run())
+    return submitted, failed
+
+
 def cmd_net(args) -> int:
     """Spawn N `p1_tpu node` subprocesses in a full mesh and check they
-    converge on one tip (benchmark config 4, BASELINE.json:10)."""
+    converge on one tip (benchmark config 4, BASELINE.json:10).  With
+    ``--tx-rate`` the run carries a live signed-transfer economy between
+    the miners' accounts, and the summary audits every node's ledger for
+    exact conservation — the whole consensus stack (signatures, nonces,
+    overdraw rejection, reorg undo) exercised under real concurrent
+    forks."""
     import subprocess
 
+    from p1_tpu.core.keys import Keypair
+
     ports = [args.base_port + i for i in range(args.nodes)]
+    keys = [
+        Keypair.from_seed_text(f"p1-net-{args.base_port}-{i}")
+        for i in range(args.nodes)
+    ]
     procs = []
     for i, port in enumerate(ports):
         cmd = [
@@ -865,7 +1016,7 @@ def cmd_net(args) -> int:
             "--deadline",
             "stdin",
             "--miner-id",
-            f"node{i}",
+            keys[i].account if args.tx_rate > 0 else f"node{i}",
         ]
         if args.chunk:
             cmd += ["--chunk", str(args.chunk)]
@@ -892,6 +1043,11 @@ def cmd_net(args) -> int:
         for proc in procs:
             proc.stdin.write(f"{deadline!r}\n")
             proc.stdin.flush()  # leave stdin open: communicate() closes it
+        txs_submitted = txs_failed = 0
+        if args.tx_rate > 0:
+            txs_submitted, txs_failed = _net_inject_txs(
+                ports, keys, args.difficulty, deadline, args.tx_rate
+            )
         for proc in procs:
             out, _ = proc.communicate(timeout=args.duration + 120)
             lines = (out or "").strip().splitlines()
@@ -927,6 +1083,23 @@ def cmd_net(args) -> int:
         },
         "statuses": statuses,
     }
+    if args.tx_rate > 0:
+        from p1_tpu.core.tx import BLOCK_REWARD
+
+        # Conservation: every block carries a coinbase and fees credit the
+        # miner, so each node's ledger must sum to exactly reward x its
+        # height — across hundreds of reorgs and a live spend stream.
+        conserved = all(
+            s["ledger_sum"] == BLOCK_REWARD * s["height"] for s in statuses
+        )
+        result["economy"] = {
+            "txs_submitted": txs_submitted,
+            "txs_failed": txs_failed,
+            "txs_accepted_total": sum(s["txs_accepted"] for s in statuses),
+            "ledger_conserved": conserved,
+        }
+        if not conserved:
+            result["converged"] = False  # fail loudly: consensus bug
     print(json.dumps(result))
     return 0 if result["converged"] else 1
 
@@ -960,6 +1133,7 @@ def main(argv=None) -> int:
         "node": cmd_node,
         "tx": cmd_tx,
         "keygen": cmd_keygen,
+        "account": cmd_account,
         "balances": cmd_balances,
         "compact": cmd_compact,
         "pod": cmd_pod,

@@ -37,7 +37,37 @@ def _load():
     lib.p1_has_shani.restype = ctypes.c_int
     lib.p1_force_scalar.argtypes = [ctypes.c_int]
     lib.p1_force_scalar.restype = None
+    lib.p1_verify_chain.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.c_int,
+    ]
+    lib.p1_verify_chain.restype = ctypes.c_longlong
     return lib
+
+
+def verify_header_chain(
+    raw: bytes, n: int, difficulty: int, genesis_exempt: bool = True
+) -> int | None:
+    """Native engine for chain replay (config 3): verify ``n`` contiguous
+    80-byte headers in one C call — PoW, difficulty field, prev-hash
+    linkage, exactly ``chain.replay.replay_host``'s rules.  Returns the
+    first invalid index, or None when the whole chain is valid."""
+    if len(raw) != 80 * n:
+        raise ValueError(f"expected {80 * n} header bytes, got {len(raw)}")
+    idx = _lib().p1_verify_chain(raw, n, difficulty, int(genesis_exempt))
+    return None if idx < 0 else int(idx)
+
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        _LIB = _load()
+    return _LIB
 
 
 @register("native")
@@ -45,7 +75,7 @@ class NativeBackend(HashBackend):
     """C++ SHA-256d search (SHA-NI when the CPU has it)."""
 
     def __init__(self):
-        self._lib = _load()
+        self._lib = _lib()
         self.has_shani = bool(self._lib.p1_has_shani())
 
     def force_scalar(self, enable: bool) -> None:

@@ -197,6 +197,18 @@ class Mempool:
             while len(self._confirmed_slots) > CONFIRMED_SLOT_WINDOW:
                 self._confirmed_slots.popitem(last=False)
 
+    def pending_next_seq(self, sender: str, floor: int) -> int:
+        """The seq a NEW transfer from ``sender`` should carry: ``floor``
+        (the chain's confirmed nonce) advanced through the CONTIGUOUS run
+        of pending slots.  Contiguous, not max+1: a stray gapped pending
+        tx (someone pinned --seq far ahead) can never mine, and jumping
+        past it would poison every auto-seq wallet tx after it — the
+        contiguous walk hands out the seq that actually fills the gap."""
+        seq = floor
+        while (sender, seq) in self._by_slot:
+            seq += 1
+        return seq
+
     def sync_page(
         self, cursor: tuple[int, bytes] | None, max_txs: int
     ) -> tuple[list[Transaction], bool]:

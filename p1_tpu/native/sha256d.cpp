@@ -257,6 +257,54 @@ void p1_sha256d(const uint8_t* data, uint64_t len, uint8_t out[32]) {
   sha256(first, 32, out);
 }
 
+// Verify a header chain laid out as n contiguous 80-byte headers
+// (layout: version[0..4) prev_hash[4..36) merkle[36..68) timestamp[68..72)
+// difficulty[72..76) nonce[76..80), all big-endian — core/header.py's
+// _PACK).  Per header: SHA-256d meets >= `difficulty` leading zero bits
+// (header 0 exempt when genesis_exempt — it anchors by identity), the
+// difficulty field equals `difficulty`, and prev_hash equals the previous
+// header's digest (header 0 links to 32 zero bytes).  Exactly
+// chain/replay.py::replay_host's rules — this is its native engine
+// (benchmark config 3).  Returns the first invalid index, or -1.
+long long p1_verify_chain(const uint8_t* headers, uint64_t n,
+                          uint32_t difficulty, int genesis_exempt) {
+  // 80-byte message templates: chunk 2 = bytes 64..80 + pad + bitlen 640;
+  // second pass = 32-byte digest + pad + bitlen 256.
+  uint8_t block2[64];
+  std::memset(block2, 0, sizeof(block2));
+  block2[16] = 0x80;
+  block2[62] = 0x02;
+  block2[63] = 0x80;
+  uint8_t block3[64];
+  std::memset(block3, 0, sizeof(block3));
+  block3[32] = 0x80;
+  block3[62] = 0x01;
+  block3[63] = 0x00;
+
+  uint8_t prev[32];
+  std::memset(prev, 0, sizeof(prev));
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t* h = headers + 80 * i;
+    uint32_t st[8];
+    std::memcpy(st, IV, sizeof(st));
+    g_compress(st, h);
+    std::memcpy(block2, h + 64, 16);
+    g_compress(st, block2);
+    for (int j = 0; j < 8; ++j) put_be32(block3 + 4 * j, st[j]);
+    uint32_t st2[8];
+    std::memcpy(st2, IV, sizeof(st2));
+    g_compress(st2, block3);
+
+    bool pow_ok = (genesis_exempt && i == 0) ||
+                  leading_zero_bits_ge(st2, difficulty);
+    bool diff_ok = be32(h + 72) == difficulty;
+    bool link_ok = std::memcmp(h + 4, prev, 32) == 0;
+    if (!(pow_ok && diff_ok && link_ok)) return (long long)i;
+    for (int j = 0; j < 8; ++j) put_be32(prev + 4 * j, st2[j]);
+  }
+  return -1;
+}
+
 // Earliest nonce in [nonce_start, nonce_start+count) whose header SHA-256d
 // has >= difficulty leading zero bits, or -1.  prefix is the fixed 76-byte
 // header head; the first 64 bytes compress once (midstate).

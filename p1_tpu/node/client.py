@@ -1,22 +1,51 @@
-"""Thin p2p client: inject a transaction into a running node.
+"""Thin p2p client: one-shot wallet/tooling rounds against a running node.
 
 Capability parity: a usable mempool needs an entry point for transactions
 from outside the node process (BASELINE.json:5 names the mempool; without
 this, only miners' own processes could ever create payload for blocks).
-The client speaks one round of the ordinary peer protocol — HELLO exchange
-(validating genesis, i.e. that both sides run the same chain parameters),
-then a single TX frame — and disconnects; the receiving node gossips the
-transaction onward like any other.
+Each client call speaks one round of the ordinary peer protocol — HELLO
+exchange (validating genesis, i.e. that both sides run the same chain
+parameters), then its one request — and disconnects; the node treats the
+client like any short-lived peer.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.core.tx import Transaction
 from p1_tpu.node import protocol
 from p1_tpu.node.protocol import Hello, MsgType
+
+
+@contextlib.asynccontextmanager
+async def _session(host: str, port: int, difficulty: int):
+    """Connect + HELLO-validate against the ``difficulty`` chain; yields
+    (reader, writer, peer_hello).  The ONE copy of the handshake both
+    clients share — a protocol change lands here once."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        genesis_hash = make_genesis(difficulty).block_hash()
+        await protocol.write_frame(
+            writer, protocol.encode_hello(Hello(genesis_hash, 0, 0))
+        )
+        mtype, hello = protocol.decode(await protocol.read_frame(reader))
+        if mtype is not MsgType.HELLO:
+            raise ValueError("node did not HELLO")
+        if hello.genesis_hash != genesis_hash:
+            raise ValueError(
+                "genesis mismatch: node runs a different chain "
+                "(check --difficulty)"
+            )
+        yield reader, writer, hello
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
 
 
 async def send_tx(
@@ -30,27 +59,27 @@ async def send_tx(
     """
 
     async def _run() -> int:
-        reader, writer = await asyncio.open_connection(host, port)
-        try:
-            genesis_hash = make_genesis(difficulty).block_hash()
-            await protocol.write_frame(
-                writer, protocol.encode_hello(Hello(genesis_hash, 0, 0))
-            )
-            mtype, hello = protocol.decode(await protocol.read_frame(reader))
-            if mtype is not MsgType.HELLO:
-                raise ValueError("node did not HELLO")
-            if hello.genesis_hash != genesis_hash:
-                raise ValueError(
-                    "genesis mismatch: node runs a different chain "
-                    "(check --difficulty)"
-                )
+        async with _session(host, port, difficulty) as (reader, writer, hello):
             await protocol.write_frame(writer, protocol.encode_tx(tx))
             return hello.tip_height
-        finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
+async def get_account(
+    host: str, port: int, account: str, difficulty: int, timeout: float = 10.0
+) -> protocol.AccountState:
+    """Query ``account``'s consensus state (balance, nonce, next usable
+    seq) from the node at host:port — what a wallet needs before signing.
+    Skips unrelated frames the node pushes at handshake (e.g. its
+    GETMEMPOOL request) until the ACCOUNT reply arrives."""
+
+    async def _run() -> protocol.AccountState:
+        async with _session(host, port, difficulty) as (reader, writer, _):
+            await protocol.write_frame(writer, protocol.encode_getaccount(account))
+            while True:
+                mtype, body = protocol.decode(await protocol.read_frame(reader))
+                if mtype is MsgType.ACCOUNT:
+                    return body
 
     return await asyncio.wait_for(_run(), timeout)

@@ -433,6 +433,24 @@ class Node:
                     await self._send_guarded(
                         peer, protocol.encode_getmempool(cursor)
                     )
+        elif mtype is MsgType.GETACCOUNT:
+            # Wallet/CLI query: consensus state at OUR tip plus the next
+            # usable seq net of our pending pool (p1 tx auto-seq).
+            nonce = self.chain.nonce(body)
+            await self._send_guarded(
+                peer,
+                protocol.encode_account(
+                    protocol.AccountState(
+                        body,
+                        self.chain.balance(body),
+                        nonce,
+                        self.mempool.pending_next_seq(body, nonce),
+                        self.chain.height,
+                    )
+                ),
+            )
+        elif mtype is MsgType.ACCOUNT:
+            pass  # reply frame: meaningful to querying clients only
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
 
@@ -627,5 +645,11 @@ class Node:
             "blocks_mined": self.metrics.blocks_mined,
             "blocks_accepted": self.metrics.blocks_accepted,
             "reorgs": self.metrics.reorgs,
+            "txs_accepted": self.metrics.txs_accepted,
             "propagation": self.metrics.propagation_summary(),
+            # Conservation probe: with a coinbase in every block (ours) and
+            # fees credited to miners, the ledger must sum to exactly
+            # BLOCK_REWARD x height — any double-spend or bad reorg undo
+            # breaks this, so `p1 net` audits it across all nodes.
+            "ledger_sum": sum(self.chain.balances_snapshot().values()),
         }

@@ -22,6 +22,13 @@ Messages:
              stable cursor of the last transaction already received; the
              reply covers fee-descending (txid-ascending) keys strictly
              after it.
+- GETACCOUNT: u8 len + account id bytes — query one account's consensus
+             state at the peer's tip (balance, nonce) plus the next
+             usable seq net of the peer's own pending pool (what a wallet
+             should sign next).  Serves `p1 account` and `p1 tx`'s
+             auto-seq.
+- ACCOUNT:   u8 len + account + u64 balance + u64 nonce + u64 next_seq +
+             u32 tip height (the reply's reference point).
 - MEMPOOL:   u8 more + u16 count + count * (u16 len + serialized tx).
              Late joiners learn in-flight transactions this way
              (blocks-only sync would leave their pools empty); pools
@@ -61,6 +68,17 @@ class MsgType(enum.IntEnum):
     BLOCKS = 5
     GETMEMPOOL = 6
     MEMPOOL = 7
+    GETACCOUNT = 8
+    ACCOUNT = 9
+
+
+@dataclasses.dataclass(frozen=True)
+class AccountState:
+    account: str
+    balance: int
+    nonce: int  # confirmed transfers at the tip (consensus nonce)
+    next_seq: int  # nonce + the peer's own pending spends (what to sign next)
+    tip_height: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +122,25 @@ def encode_blocks(blocks: list[Block]) -> bytes:
         parts.append(_LEN.pack(len(raw)))
         parts.append(raw)
     return b"".join(parts)
+
+
+def encode_getaccount(account: str) -> bytes:
+    raw = account.encode("utf-8")
+    if not 0 < len(raw) <= 255:
+        raise ValueError("account id must encode to 1..255 bytes")
+    return bytes([MsgType.GETACCOUNT]) + struct.pack(">B", len(raw)) + raw
+
+
+def encode_account(state: AccountState) -> bytes:
+    raw = state.account.encode("utf-8")
+    return (
+        bytes([MsgType.ACCOUNT])
+        + struct.pack(">B", len(raw))
+        + raw
+        + struct.pack(
+            ">QQQI", state.balance, state.nonce, state.next_seq, state.tip_height
+        )
+    )
 
 
 def encode_getmempool(cursor: tuple[int, bytes] | None = None) -> bytes:
@@ -181,6 +218,21 @@ def decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in BLOCKS")
         return mtype, blocks
+    if mtype is MsgType.GETACCOUNT:
+        if len(body) < 1 or len(body) != 1 + body[0] or body[0] == 0:
+            raise ValueError("bad GETACCOUNT")
+        return mtype, body[1:].decode("utf-8")
+    if mtype is MsgType.ACCOUNT:
+        if len(body) < 1:
+            raise ValueError("bad ACCOUNT")
+        alen = body[0]
+        if len(body) != 1 + alen + 28 or alen == 0:
+            raise ValueError("bad ACCOUNT size")
+        account = body[1 : 1 + alen].decode("utf-8")
+        balance, nonce, next_seq, height = struct.unpack(
+            ">QQQI", body[1 + alen :]
+        )
+        return mtype, AccountState(account, balance, nonce, next_seq, height)
     if mtype is MsgType.GETMEMPOOL:
         if not body:
             return mtype, None

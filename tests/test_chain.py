@@ -388,6 +388,29 @@ class TestReplay:
         report = replay_device(headers, segment=16)
         assert report.valid, f"first invalid: {report.first_invalid}"
 
+    def test_native_replay_matches_host(self, headers):
+        # The C++ engine (config 3's native tier) agrees with the hashlib
+        # oracle on a valid chain AND on the exact first-invalid index.
+        from p1_tpu.chain import replay_native
+
+        report = replay_native(headers)
+        assert report.valid and report.first_invalid is None
+        bad = list(headers)
+        bad[37] = bad[37].with_nonce(bad[37].nonce ^ 1)
+        host, native = replay_host(bad), replay_native(bad)
+        assert not native.valid
+        assert native.first_invalid == host.first_invalid == 37
+        # Wrong difficulty field mid-chain is also caught (host parity).
+        import dataclasses as dc
+
+        bad2 = list(headers)
+        bad2[30] = dc.replace(bad2[30], difficulty=DIFF + 1)
+        assert (
+            replay_native(bad2).first_invalid
+            == replay_host(bad2).first_invalid
+            == 30
+        )
+
     def test_device_matches_host_on_corruption(self, headers):
         # Corrupt one nonce mid-chain: both paths must flag that index
         # (PoW breaks there, and linkage breaks at the next header).

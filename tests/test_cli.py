@@ -125,10 +125,35 @@ class TestCli:
                     capture_output=True, text=True, timeout=30, cwd="/root/repo",
                 )
                 if proc.returncode == 0:
-                    height = json.loads(proc.stdout)["peer_height"]
-                    sent = height >= 1  # alice funded from height 1 on
+                    out = json.loads(proc.stdout)
+                    sent = out["peer_height"] >= 1  # alice funded from h1 on
                 time.sleep(0.3)
             assert sent, "node never became reachable with a funded miner"
+            assert out["seq"] == 0  # auto-seq: fresh account starts at 0
+            # Second spend, no --seq either: GETACCOUNT must hand back the
+            # next usable nonce (1), whether the first tx is still pending
+            # or already mined.
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "tx",
+                    "--difficulty", "12", "--port", port,
+                    "--key", alice_key, "--recipient", bob,
+                    "--amount", "5", "--fee", "1",
+                ],
+                capture_output=True, text=True, timeout=30, cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-1000:]
+            assert json.loads(proc.stdout)["seq"] == 1
+            # Live account query while the node still runs.
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "account",
+                    "--difficulty", "12", "--port", port, "--account", bob,
+                ],
+                capture_output=True, text=True, timeout=30, cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-1000:]
+            assert json.loads(proc.stdout)["account"] == bob
         finally:
             # Generous: on a loaded 1-vCPU box the quiesce window and the
             # interpreter startups above stretch well past the nominal 12s.
@@ -138,10 +163,25 @@ class TestCli:
             "balances", "--store", store, "--difficulty", "12",
             "--account", bob,
         )
-        assert out["balance"] == 7, out
+        assert out["balance"] == 7 + 5, out
         full = _run("balances", "--store", store, "--difficulty", "12")
         assert all(v >= 0 for v in full["balances"].values())
-        assert full["balances"][alice] >= 50 - 8
+        assert full["balances"][alice] >= 50 - 14
+
+    def test_net_with_tx_economy(self):
+        """Config 4 carrying a live signed-transfer economy: the net must
+        still converge AND every node's ledger must conserve exactly
+        (reward x height) — signatures, nonces, overdraw rejection and
+        reorg undo all exercised under real concurrent forks."""
+        out = _run(
+            "net", "--nodes", "2", "--difficulty", "12", "--duration", "5",
+            "--chunk", "16384", "--base-port", "29944", "--tx-rate", "3",
+            timeout=200,
+        )
+        assert out["converged"], out
+        assert out["economy"]["ledger_conserved"], out["economy"]
+        # The audit is vacuous unless transfers actually flowed.
+        assert out["economy"]["txs_submitted"] > 0, out["economy"]
 
     def test_unknown_backend_fails_cleanly(self):
         proc = subprocess.run(

@@ -219,6 +219,46 @@ class TestTxClient:
         run(scenario())
 
 
+class TestAccountQuery:
+    def test_get_account_reflects_chain_and_pool(self):
+        from p1_tpu.node.client import get_account
+
+        async def scenario():
+            a = Node(_config())
+            await a.start()
+            try:
+                await fund(a, "alice")
+                funded = a.chain.balance(account("alice"))
+                state = await get_account(
+                    "127.0.0.1", a.port, account("alice"), DIFF
+                )
+                assert state.balance == funded
+                assert state.nonce == 0 and state.next_seq == 0
+                # A pending spend advances next_seq but not the nonce.
+                await a.submit_tx(stx("alice", "bob", 5, 1, 0, difficulty=DIFF))
+                state = await get_account(
+                    "127.0.0.1", a.port, account("alice"), DIFF
+                )
+                assert state.nonce == 0 and state.next_seq == 1
+                # Unknown accounts answer zeros, not errors.
+                state = await get_account("127.0.0.1", a.port, "nobody", DIFF)
+                assert state.balance == 0 and state.next_seq == 0
+                # A stray GAPPED pending tx (pinned far-future seq) must
+                # not poison auto-seq: next_seq advances contiguously, so
+                # the next wallet tx fills the gap instead of extending it.
+                await a.submit_tx(
+                    stx("alice", "bob", 1, 1, 9, difficulty=DIFF)
+                )
+                state = await get_account(
+                    "127.0.0.1", a.port, account("alice"), DIFF
+                )
+                assert state.next_seq == 1  # not 10
+            finally:
+                await a.stop()
+
+        run(scenario())
+
+
 class TestPeerCap:
     def test_inbound_refused_past_limit(self, monkeypatch):
         from p1_tpu.node import node as node_mod

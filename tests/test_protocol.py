@@ -72,6 +72,13 @@ class TestRoundTrips:
         mtype, got = protocol.decode(protocol.encode_getmempool(cursor))
         assert mtype is MsgType.GETMEMPOOL and got == cursor
 
+    def test_account_query_round_trip(self):
+        mtype, got = protocol.decode(protocol.encode_getaccount("p1deadbeef"))
+        assert mtype is MsgType.GETACCOUNT and got == "p1deadbeef"
+        state = protocol.AccountState("p1deadbeef", 123, 4, 7, 99)
+        mtype, got = protocol.decode(protocol.encode_account(state))
+        assert mtype is MsgType.ACCOUNT and got == state
+
     def test_mempool(self):
         txs = [Transaction("a", "b", 1, f, f) for f in range(3)]
         payload = protocol.encode_mempool([t.serialize() for t in txs], more=True)
@@ -96,6 +103,10 @@ class TestMalformed:
             bytes([MsgType.BLOCKS]) + b"\x00",  # short count
             bytes([MsgType.BLOCKS]) + b"\x00\x01\x00\x00\x00\x05ab",  # truncated
             bytes([MsgType.GETMEMPOOL]) + b"\x00" * 3,  # wrong cursor size
+            bytes([MsgType.GETACCOUNT]),  # no length
+            bytes([MsgType.GETACCOUNT]) + b"\x05ab",  # length lies
+            bytes([MsgType.GETACCOUNT]) + b"\x00",  # empty account
+            bytes([MsgType.ACCOUNT]) + b"\x02ab" + b"\x00" * 10,  # short state
             bytes([MsgType.MEMPOOL]) + b"\x00",  # short header
             bytes([MsgType.MEMPOOL]) + b"\x00\x00\x00\x00\x00\x01",  # count lies
         ],
