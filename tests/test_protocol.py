@@ -135,6 +135,10 @@ class TestMalformed:
             ),
             protocol.encode_getblocks([b"\x02" * 32]),
             protocol.encode_getmempool((9, b"\x03" * 32)),
+            protocol.encode_getaccount("p1deadbeefdeadbeef"),
+            protocol.encode_account(
+                protocol.AccountState("p1deadbeefdeadbeef", 50, 1, 2, 7)
+            ),
         ]
         for seed in seeds:
             for _ in range(200):
