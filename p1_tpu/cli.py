@@ -847,16 +847,33 @@ def cmd_balances(args) -> int:
             )
         )
         return 0
+    # Offline audit: the store loads through full consensus validation, so
+    # the view must agree with the incremental ledger, hold nothing
+    # negative, and conserve exactly — total = coinbase minted minus the
+    # fees burned by the rare coinbase-less blocks.  A False here means a
+    # corrupted store or a consensus bug — surface it in the exit code.
+    minted = burned = 0
+    for b in chain.main_chain():
+        if b.txs and b.txs[0].is_coinbase:
+            minted += b.txs[0].amount
+        else:
+            burned += sum(t.fee for t in b.txs)
+    conserved = (
+        sum(ledger.values()) == minted - burned
+        and all(v >= 0 for v in ledger.values())
+        and {a: v for a, v in ledger.items() if v} == chain.balances_snapshot()
+    )
     print(
         json.dumps(
             {
                 "config": "balances",
                 "height": chain.height,
+                "conserved": conserved,
                 "balances": dict(sorted(ledger.items())),
             }
         )
     )
-    return 0
+    return 0 if conserved else 1
 
 
 # -- compact -------------------------------------------------------------

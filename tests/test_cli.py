@@ -165,6 +165,7 @@ class TestCli:
         )
         assert out["balance"] == 7 + 5, out
         full = _run("balances", "--store", store, "--difficulty", "12")
+        assert full["conserved"]  # offline audit: view==ledger, exact sum
         assert all(v >= 0 for v in full["balances"].values())
         assert full["balances"][alice] >= 50 - 14
 
