@@ -1,5 +1,6 @@
 from p1_tpu.chain.chain import AddResult, AddStatus, Chain
 from p1_tpu.chain.ledger import balances
+from p1_tpu.chain.proof import SPVError, TxProof, verify_tx_proof
 from p1_tpu.chain.replay import (
     ReplayReport,
     generate_headers,
@@ -16,7 +17,10 @@ __all__ = [
     "Chain",
     "ChainStore",
     "ReplayReport",
+    "SPVError",
+    "TxProof",
     "ValidationError",
+    "verify_tx_proof",
     "balances",
     "check_block",
     "generate_headers",

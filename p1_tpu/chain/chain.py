@@ -42,9 +42,10 @@ import dataclasses
 import enum
 from typing import Iterator
 
-from p1_tpu.core.block import Block
+from p1_tpu.core.block import Block, merkle_branch
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.chain.ledger import Ledger, LedgerError
+from p1_tpu.chain.proof import TxProof
 from p1_tpu.chain.validate import ValidationError, check_block
 
 
@@ -118,6 +119,13 @@ class Chain:
         self._invalid: dict[bytes, str] = {}
         #: parent hash -> child hashes, for invalidating indexed subtrees.
         self._children: dict[bytes, list[bytes]] = {}
+        #: txid -> containing main-chain block hash, maintained with every
+        #: tip move (like the ledger) so SPV proof serving is O(block), not
+        #: O(chain).  Main chain only: side-branch confirmations are not
+        #: facts a node should attest to.
+        self._tx_index: dict[bytes, bytes] = {
+            tx.txid(): ghash for tx in self.genesis.txs
+        }
 
     # -- queries ---------------------------------------------------------
 
@@ -159,6 +167,25 @@ class Chain:
         """The seq ``account``'s next transfer must carry (strict account
         nonce — see ledger.py's replay rule)."""
         return self._ledger.nonce(account)
+
+    def tx_proof(self, txid: bytes) -> TxProof | None:
+        """SPV inclusion proof for a main-chain-confirmed transaction, or
+        ``None`` if ``txid`` is not confirmed at the current tip.  Served
+        from the txid index: O(containing block) per query."""
+        bhash = self._tx_index.get(txid)
+        if bhash is None:
+            return None
+        entry = self._index[bhash]
+        txids = [tx.txid() for tx in entry.block.txs]
+        index = txids.index(txid)
+        return TxProof(
+            tx=entry.block.txs[index],
+            header=entry.block.header,
+            height=entry.height,
+            tip_height=self.height,
+            index=index,
+            branch=merkle_branch(txids, index),
+        )
 
     def main_chain(self) -> Iterator[Block]:
         """Genesis-first iteration of the current best chain."""
@@ -236,6 +263,16 @@ class Chain:
         if removed:
             del self._main_hashes[len(self._main_hashes) - len(removed) :]
         self._main_hashes.extend(b.block_hash() for b in added)
+        # Keep the txid index in lockstep with the main chain (pop the
+        # abandoned branch first: a tx confirmed on both branches must end
+        # up pointing at its new block).
+        for b in removed:
+            for tx in b.txs:
+                self._tx_index.pop(tx.txid(), None)
+        for b in added:
+            bh = b.block_hash()
+            for tx in b.txs:
+                self._tx_index[tx.txid()] = bh
         bhash = block.block_hash()
         if bhash in self._invalid:
             # Indexed but contextually invalid (its transfers overdraw

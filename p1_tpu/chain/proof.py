@@ -1,0 +1,114 @@
+"""SPV transaction-inclusion proofs (light-client verification).
+
+Capability parity: a "Bitcoin-like toy cryptocurrency" (BASELINE.json:5)
+whose wallets already query balance/nonce over the wire (GETACCOUNT) also
+owes them the other classic light-client primitive: *prove that my
+transaction is confirmed* without downloading blocks.  A ``TxProof`` is the
+standard SPV bundle — the transaction, its block header, and the merkle
+sibling path — verified client-side with three checks that need no chain
+state at all:
+
+1. the header carries real proof-of-work at the chain's difficulty,
+2. the merkle branch links the txid to that header's commitment, and
+3. the transaction itself is well-formed for this chain (Ed25519 ownership
+   proof, chain-bound signature, coinbase subsidy rules).
+
+Honesty about the trust model (documented, not hidden): this is
+*one-header* SPV.  The proof pins the transaction to **a** valid
+proof-of-work block, but whether that block is on the current best chain is
+attested only by the serving peer (``tip_height`` → ``confirmations`` is
+the peer's claim).  Lying costs the attacker a real block's worth of work —
+the same bar Bitcoin SPV sets per header — and a client that wants more can
+cross-check several peers or replay the full header chain with
+``p1_tpu.chain.replay`` (the header-chain verifier a full light client
+would run).  The serving side computes proofs from a txid index maintained
+at the tip (``Chain.tx_proof``), so queries are O(block size), not
+O(chain).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from p1_tpu.core.block import verify_merkle_branch
+from p1_tpu.core.header import BlockHeader, meets_target
+from p1_tpu.core.tx import BLOCK_REWARD, Transaction
+
+
+class SPVError(Exception):
+    """A transaction-inclusion proof failed verification."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TxProof:
+    """Everything a light client needs to check one confirmed transaction."""
+
+    tx: Transaction
+    header: BlockHeader  # the block that confirmed it
+    height: int  # that block's main-chain height (server's view)
+    tip_height: int  # server's tip height when the proof was cut
+    index: int  # tx position in the block
+    branch: tuple[bytes, ...]  # merkle sibling path, leaf-to-root
+
+    @property
+    def confirmations(self) -> int:
+        return self.tip_height - self.height + 1
+
+
+def verify_tx_proof(
+    proof: TxProof,
+    difficulty: int,
+    chain_tag: bytes,
+    txid: bytes | None = None,
+) -> None:
+    """Raise ``SPVError`` unless ``proof`` checks out for the chain whose
+    required difficulty and genesis hash (``chain_tag``) are given.
+
+    Pure function of its arguments — this is the *client* side, run by
+    wallets that hold no chain.  ``txid`` pins the proof to the transaction
+    the caller asked about (a peer answering with a different, valid proof
+    must not pass).
+    """
+    header = proof.header
+    have_txid = proof.tx.txid()
+    if txid is not None and have_txid != txid:
+        raise SPVError("proof is for a different transaction")
+    if proof.tip_height < proof.height:
+        # Both are peer-claimed u32s; a tip below the confirming height is
+        # internally inconsistent evidence (and would print negative
+        # confirmations to wallet scripts).
+        raise SPVError(
+            f"tip height {proof.tip_height} below confirming height "
+            f"{proof.height}"
+        )
+    if header.difficulty != difficulty:
+        raise SPVError(
+            f"header difficulty {header.difficulty} != chain difficulty "
+            f"{difficulty}"
+        )
+    if proof.height == 0:
+        # Genesis anchors by identity, not work (core/genesis.py) — the
+        # only height-0 header a client accepts is the chain tag itself.
+        if header.block_hash() != chain_tag:
+            raise SPVError("height-0 header is not this chain's genesis")
+    elif not meets_target(header.block_hash(), header.difficulty):
+        raise SPVError("header does not meet proof-of-work target")
+    if not verify_merkle_branch(
+        have_txid, proof.index, proof.branch, header.merkle_root
+    ):
+        raise SPVError("merkle branch does not link txid to header")
+    tx = proof.tx
+    if tx.is_coinbase:
+        # Mirror consensus' stateless coinbase rules (chain/validate.py):
+        # first position, exact subsidy, unsigned.
+        if proof.index != 0:
+            raise SPVError("coinbase proven at index > 0")
+        if tx.amount != BLOCK_REWARD:
+            raise SPVError(f"coinbase mints {tx.amount}, subsidy is {BLOCK_REWARD}")
+        if not tx.verify_signature():
+            raise SPVError("coinbase must be unsigned")
+    else:
+        if tx.chain != chain_tag:
+            raise SPVError("transaction signed for a different chain")
+        if not tx.verify_signature():
+            raise SPVError("bad transaction signature")

@@ -148,6 +148,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     p = sub.add_parser(
+        "proof",
+        help="fetch + SPV-verify a transaction inclusion proof from a node",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--txid", required=True, help="hex txid (printed by `p1 tx`)"
+    )
+
+    p = sub.add_parser(
         "keygen", help="create an Ed25519 spending key (account = fingerprint)"
     )
     p.add_argument("--out", required=True, help="key file to write (0600)")
@@ -590,6 +601,66 @@ def cmd_account(args) -> int:
                 "nonce": state.nonce,
                 "next_seq": state.next_seq,
                 "height": state.tip_height,
+            }
+        )
+    )
+    return 0
+
+
+# -- proof ---------------------------------------------------------------
+
+
+def cmd_proof(args) -> int:
+    """Fetch an SPV inclusion proof and verify it CLIENT-SIDE.
+
+    Exit codes: 0 = confirmed and proof verifies; 1 = query failed;
+    3 = not confirmed on the peer's main chain; 4 = the peer served a
+    proof that FAILS verification (a lying or broken peer — loud exit).
+    """
+    from p1_tpu.chain.proof import SPVError, verify_tx_proof
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.node.client import get_proof
+
+    try:
+        txid = bytes.fromhex(args.txid)
+        if len(txid) != 32:
+            raise ValueError("txid must be 32 hex-encoded bytes")
+        proof = asyncio.run(
+            get_proof(args.host, args.port, txid, args.difficulty)
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"proof query failed: {e}", file=sys.stderr)
+        return 1
+    if proof is None:
+        print(json.dumps({"config": "proof", "confirmed": False}))
+        return 3
+    try:
+        verify_tx_proof(
+            proof, args.difficulty, genesis_hash(args.difficulty), txid=txid
+        )
+    except SPVError as e:
+        print(f"peer served an INVALID proof: {e}", file=sys.stderr)
+        return 4
+    print(
+        json.dumps(
+            {
+                "config": "proof",
+                "confirmed": True,
+                "verified": True,
+                "txid": args.txid,
+                "height": proof.height,
+                "confirmations": proof.confirmations,
+                "block": proof.header.block_hash().hex(),
+                "index": proof.index,
+                "branch_len": len(proof.branch),
+                "amount": proof.tx.amount,
+                "recipient": proof.tx.recipient,
             }
         )
     )
@@ -1151,6 +1222,7 @@ def main(argv=None) -> int:
         "tx": cmd_tx,
         "keygen": cmd_keygen,
         "account": cmd_account,
+        "proof": cmd_proof,
         "balances": cmd_balances,
         "compact": cmd_compact,
         "pod": cmd_pod,

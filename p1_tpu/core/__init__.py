@@ -7,7 +7,12 @@ from p1_tpu.core.header import (
     meets_target,
 )
 from p1_tpu.core.tx import Transaction
-from p1_tpu.core.block import Block, merkle_root
+from p1_tpu.core.block import (
+    Block,
+    merkle_branch,
+    merkle_root,
+    verify_merkle_branch,
+)
 from p1_tpu.core.genesis import GENESIS_TIMESTAMP, make_genesis
 
 __all__ = [
@@ -19,7 +24,9 @@ __all__ = [
     "meets_target",
     "Transaction",
     "Block",
+    "merkle_branch",
     "merkle_root",
+    "verify_merkle_branch",
     "GENESIS_TIMESTAMP",
     "make_genesis",
 ]

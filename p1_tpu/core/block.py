@@ -38,6 +38,57 @@ def merkle_root(txids: list[bytes]) -> bytes:
     return level[0]
 
 
+def merkle_branch(txids: list[bytes], index: int) -> tuple[bytes, ...]:
+    """The sibling path proving ``txids[index]`` is under ``merkle_root(txids)``.
+
+    One 32-byte sibling per tree level, leaf-to-root order — the compact
+    inclusion proof an SPV client checks with ``verify_merkle_branch``
+    without seeing the other transactions.  Mirrors ``merkle_root``'s
+    construction exactly (including the odd-tail duplication), so the two
+    functions agree for every (txids, index).
+    """
+    if not 0 <= index < len(txids):
+        raise ValueError(f"index {index} out of range for {len(txids)} txids")
+    from p1_tpu.core.hashutil import sha256d
+
+    branch: list[bytes] = []
+    level = list(txids)
+    i = index
+    while len(level) > 1:
+        if len(level) % 2:
+            level.append(level[-1])
+        branch.append(level[i ^ 1])
+        level = [
+            sha256d(level[j] + level[j + 1]) for j in range(0, len(level), 2)
+        ]
+        i //= 2
+    return tuple(branch)
+
+
+def verify_merkle_branch(
+    txid: bytes, index: int, branch: tuple[bytes, ...], root: bytes
+) -> bool:
+    """Does ``branch`` prove that leaf ``txid`` sits at ``index`` under
+    ``root``?  Pure recombination — the verifier needs nothing but these
+    arguments.  Soundness note: with the duplicate-odd-leaf construction a
+    root does not uniquely determine the leaf *list* (CVE-2012-2459), but
+    consensus rejects duplicate txids per block, so for valid blocks a
+    verified (txid, index, root) triple pins a real on-chain transaction.
+    """
+    if index < 0:
+        return False
+    from p1_tpu.core.hashutil import sha256d
+
+    cur = txid
+    i = index
+    for sib in branch:
+        cur = sha256d(cur + sib) if i % 2 == 0 else sha256d(sib + cur)
+        i //= 2
+    # i must be exhausted: an index >= 2**depth cannot name a leaf of this
+    # tree, and accepting one would let a prover relocate the transaction.
+    return i == 0 and cur == root
+
+
 @dataclasses.dataclass(frozen=True)
 class Block:
     header: BlockHeader

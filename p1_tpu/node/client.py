@@ -66,6 +66,25 @@ async def send_tx(
     return await asyncio.wait_for(_run(), timeout)
 
 
+async def get_proof(
+    host: str, port: int, txid: bytes, difficulty: int, timeout: float = 10.0
+):
+    """Fetch the SPV inclusion proof for ``txid`` from the node at
+    host:port.  Returns a ``TxProof`` or ``None`` (not confirmed on the
+    node's main chain).  The caller verifies the proof itself with
+    ``p1_tpu.chain.verify_tx_proof`` — never trust, always check."""
+
+    async def _run():
+        async with _session(host, port, difficulty) as (reader, writer, _):
+            await protocol.write_frame(writer, protocol.encode_getproof(txid))
+            while True:
+                mtype, body = protocol.decode(await protocol.read_frame(reader))
+                if mtype is MsgType.PROOF:
+                    return body
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def get_account(
     host: str, port: int, account: str, difficulty: int, timeout: float = 10.0
 ) -> protocol.AccountState:

@@ -449,8 +449,15 @@ class Node:
                     )
                 ),
             )
-        elif mtype is MsgType.ACCOUNT:
-            pass  # reply frame: meaningful to querying clients only
+        elif mtype is MsgType.GETPROOF:
+            # SPV query: serve the inclusion proof (or not-found) from the
+            # chain's txid index; the client verifies it, we just attest
+            # our main-chain view.
+            await self._send_guarded(
+                peer, protocol.encode_proof(self.chain.tx_proof(body))
+            )
+        elif mtype in (MsgType.ACCOUNT, MsgType.PROOF):
+            pass  # reply frames: meaningful to querying clients only
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
 

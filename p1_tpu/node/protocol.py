@@ -36,6 +36,14 @@ Messages:
              cursor, not a positional one: pool churn between pages can't
              skip entries, and the requester enforces strictly-advancing
              cursors so a hostile responder can't loop it.
+- GETPROOF:  32-byte txid — request an SPV inclusion proof for a
+             main-chain-confirmed transaction (`p1 proof`).
+- PROOF:     u8 found; if found: u32 height + u32 tip height + u32 tx
+             index + 80-byte header + u16 branch count + count * 32-byte
+             merkle siblings + u16 tx len + serialized tx.  The client
+             verifies PoW + merkle branch + tx validity itself
+             (p1_tpu/chain/proof.py) — the reply is evidence, not an
+             assertion to trust.
 """
 
 from __future__ import annotations
@@ -45,18 +53,23 @@ import dataclasses
 import enum
 import struct
 
+from p1_tpu.chain.proof import TxProof
 from p1_tpu.core.block import Block
+from p1_tpu.core.header import HEADER_SIZE, BlockHeader
 from p1_tpu.core.tx import Transaction
 
 MAX_FRAME = 32 << 20  # hard cap against hostile length prefixes
 _LEN = struct.Struct(">I")
-#: Wire protocol version, carried in HELLO.  Bump when any message layout
-#: changes incompatibly (round 4 did: BLOCK gained the f64 telemetry
-#: timestamp and transactions gained chain/pubkey/sig fields) so skewed
-#: peers fail the handshake with a clear error instead of mis-parsing the
-#: first gossip frame into a disconnect/reconnect loop.  Round 3 spoke an
-#: unversioned HELLO; its frames fail here as "bad HELLO size".
-PROTOCOL_VERSION = 2
+#: Wire protocol version, carried in HELLO.  Bump when the message surface
+#: changes incompatibly — layout changes (v2: BLOCK gained the f64
+#: telemetry timestamp, transactions gained chain/pubkey/sig fields) but
+#: also pure additions (v3: GETPROOF/PROOF): HELLO enforces strict version
+#: equality, so bumping on additions means a mixed-version pair fails the
+#: handshake with a clear error instead of dying mid-session the first
+#: time the newer side queries a message the older one calls a protocol
+#: violation.  Round 3 spoke an unversioned HELLO; its frames fail here as
+#: "bad HELLO size".
+PROTOCOL_VERSION = 3
 _HELLO = struct.Struct(">B32sIH")
 
 
@@ -70,6 +83,8 @@ class MsgType(enum.IntEnum):
     MEMPOOL = 7
     GETACCOUNT = 8
     ACCOUNT = 9
+    GETPROOF = 10
+    PROOF = 11
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,6 +154,30 @@ def encode_account(state: AccountState) -> bytes:
         + raw
         + struct.pack(
             ">QQQI", state.balance, state.nonce, state.next_seq, state.tip_height
+        )
+    )
+
+
+def encode_getproof(txid: bytes) -> bytes:
+    if len(txid) != 32:
+        raise ValueError("txid must be 32 bytes")
+    return bytes([MsgType.GETPROOF]) + txid
+
+
+def encode_proof(proof: TxProof | None) -> bytes:
+    """``None`` encodes the not-found reply."""
+    if proof is None:
+        return bytes([MsgType.PROOF, 0])
+    raw_tx = proof.tx.serialize()
+    return b"".join(
+        (
+            bytes([MsgType.PROOF, 1]),
+            struct.pack(">III", proof.height, proof.tip_height, proof.index),
+            proof.header.serialize(),
+            struct.pack(">H", len(proof.branch)),
+            *proof.branch,
+            struct.pack(">H", len(raw_tx)),
+            raw_tx,
         )
     )
 
@@ -233,6 +272,40 @@ def decode(payload: bytes):
             ">QQQI", body[1 + alen :]
         )
         return mtype, AccountState(account, balance, nonce, next_seq, height)
+    if mtype is MsgType.GETPROOF:
+        if len(body) != 32:
+            raise ValueError("bad GETPROOF")
+        return mtype, body
+    if mtype is MsgType.PROOF:
+        if len(body) < 1:
+            raise ValueError("bad PROOF")
+        if body[0] == 0:
+            if len(body) != 1:
+                raise ValueError("trailing bytes in PROOF")
+            return mtype, None
+        if body[0] != 1:
+            raise ValueError("bad PROOF found flag")
+        off = 1
+        if len(body) < off + 12 + HEADER_SIZE + 2:
+            raise ValueError("truncated PROOF")
+        height, tip_height, index = struct.unpack_from(">III", body, off)
+        off += 12
+        header = BlockHeader.deserialize(body[off : off + HEADER_SIZE])
+        off += HEADER_SIZE
+        (nbranch,) = struct.unpack_from(">H", body, off)
+        off += 2
+        if len(body) < off + 32 * nbranch + 2:
+            raise ValueError("truncated PROOF branch")
+        branch = tuple(
+            body[off + 32 * i : off + 32 * (i + 1)] for i in range(nbranch)
+        )
+        off += 32 * nbranch
+        (txlen,) = struct.unpack_from(">H", body, off)
+        off += 2
+        if len(body) != off + txlen:
+            raise ValueError("bad PROOF tx size")
+        tx = Transaction.deserialize(body[off:])
+        return mtype, TxProof(tx, header, height, tip_height, index, branch)
     if mtype is MsgType.GETMEMPOOL:
         if not body:
             return mtype, None

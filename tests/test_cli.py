@@ -102,7 +102,7 @@ class TestCli:
                 sys.executable, "-m", "p1_tpu", "node",
                 "--difficulty", "12", "--backend", "cpu", "--chunk", "16384",
                 "--port", port, "--miner-id", alice, "--store", store,
-                "--duration", "12",
+                "--duration", "15",
             ],
             stdout=node_log,
             stderr=node_log,
@@ -130,6 +130,26 @@ class TestCli:
                 time.sleep(0.3)
             assert sent, "node never became reachable with a funded miner"
             assert out["seq"] == 0  # auto-seq: fresh account starts at 0
+            # SPV round: once that spend confirms, `p1 proof` must fetch an
+            # inclusion proof AND verify it client-side (exit 3 = not yet
+            # mined; block times are ms, so this resolves in a beat).
+            txid = out["txid"]
+            proved = None
+            while proved is None and time.monotonic() < deadline:
+                proc = subprocess.run(
+                    [
+                        sys.executable, "-m", "p1_tpu", "proof",
+                        "--difficulty", "12", "--port", port, "--txid", txid,
+                    ],
+                    capture_output=True, text=True, timeout=30, cwd="/root/repo",
+                )
+                if proc.returncode == 0:
+                    proved = json.loads(proc.stdout)
+                else:
+                    assert proc.returncode == 3, proc.stderr[-1000:]
+                    time.sleep(0.3)  # not mined yet — keep polling
+            assert proved is not None, "spend never confirmed with a proof"
+            assert proved["verified"] and proved["amount"] == 7
             # Second spend, no --seq either: GETACCOUNT must hand back the
             # next usable nonce (1), whether the first tx is still pending
             # or already mined.

@@ -1,0 +1,271 @@
+"""SPV transaction-inclusion proofs: merkle branches, the chain's txid
+index, client-side verification, and the GETPROOF/PROOF wire round.
+
+The adversarial cases matter most: a lying peer must not be able to serve
+a proof that verifies for a transaction the chain never confirmed, for a
+relocated index, or for a tampered transaction.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from txutil import account, stx
+
+from test_consensus import DIFF, _funded_chain, _mine_child
+
+from p1_tpu.chain import AddStatus, Chain, SPVError, TxProof, verify_tx_proof
+from p1_tpu.core import (
+    Transaction,
+    make_genesis,
+    merkle_branch,
+    merkle_root,
+    verify_merkle_branch,
+)
+from p1_tpu.core.genesis import genesis_hash
+from p1_tpu.core.hashutil import sha256d
+from p1_tpu.node import protocol
+from p1_tpu.node.protocol import MsgType
+
+
+def _txids(n: int, rng: random.Random) -> list[bytes]:
+    return [rng.randbytes(32) for _ in range(n)]
+
+
+class TestMerkleBranch:
+    def test_every_index_round_trips(self):
+        rng = random.Random(42)
+        for n in range(1, 26):
+            txids = _txids(n, rng)
+            root = merkle_root(txids)
+            for i in range(n):
+                branch = merkle_branch(txids, i)
+                assert verify_merkle_branch(txids[i], i, branch, root), (n, i)
+
+    def test_single_tx_has_empty_branch(self):
+        txid = b"\x07" * 32
+        assert merkle_branch([txid], 0) == ()
+        assert verify_merkle_branch(txid, 0, (), merkle_root([txid]))
+
+    def test_wrong_anything_fails(self):
+        rng = random.Random(7)
+        txids = _txids(5, rng)
+        root = merkle_root(txids)
+        branch = merkle_branch(txids, 2)
+        assert not verify_merkle_branch(txids[3], 2, branch, root)  # wrong leaf
+        assert not verify_merkle_branch(txids[2], 3, branch, root)  # wrong index
+        assert not verify_merkle_branch(txids[2], 2, branch, b"\x00" * 32)
+        bad = (branch[0], sha256d(b"evil"), *branch[2:])  # tampered sibling
+        assert not verify_merkle_branch(txids[2], 2, bad, root)
+        assert not verify_merkle_branch(txids[2], 2, branch[:-1], root)
+
+    def test_index_beyond_tree_depth_rejected(self):
+        # An index >= 2**len(branch) cannot name a leaf: a prover must not
+        # be able to "relocate" a transaction by inflating the index.
+        txids = _txids(4, random.Random(1))
+        root = merkle_root(txids)
+        branch = merkle_branch(txids, 1)
+        assert not verify_merkle_branch(txids[1], 1 + 4, branch, root)
+        assert not verify_merkle_branch(txids[1], -1, branch, root)
+
+    def test_out_of_range_branch_request(self):
+        with pytest.raises(ValueError):
+            merkle_branch([b"\x01" * 32], 1)
+        with pytest.raises(ValueError):
+            merkle_branch([], 0)
+
+
+class TestChainTxProof:
+    def test_confirmed_tx_proves_and_verifies(self):
+        chain, b1 = _funded_chain("alice")
+        spend = stx("alice", account("bob"), 10, 1, 0)
+        b2 = _mine_child(
+            b1, txs=(Transaction.coinbase("m", 2), spend)
+        )
+        assert chain.add_block(b2).status is AddStatus.ACCEPTED
+        proof = chain.tx_proof(spend.txid())
+        assert proof is not None
+        assert proof.height == 2 and proof.index == 1
+        assert proof.confirmations == 1
+        verify_tx_proof(proof, DIFF, genesis_hash(DIFF), txid=spend.txid())
+        # The coinbase is provable too.
+        cb_proof = chain.tx_proof(b2.txs[0].txid())
+        assert cb_proof is not None and cb_proof.index == 0
+        verify_tx_proof(cb_proof, DIFF, genesis_hash(DIFF))
+
+    def test_unknown_txid_returns_none(self):
+        chain, _ = _funded_chain("alice")
+        assert chain.tx_proof(b"\x99" * 32) is None
+
+    def test_reorg_repoints_or_evicts_the_index(self):
+        # A tx confirmed on the losing branch must stop being provable;
+        # one confirmed on both branches must point at the WINNING block.
+        chain, b1 = _funded_chain("alice")
+        spend = stx("alice", account("bob"), 10, 1, 0)
+        only_a = stx("alice", account("carol"), 5, 1, 1)
+        a2 = _mine_child(b1, txs=(Transaction.coinbase("ma", 2), spend, only_a))
+        assert chain.add_block(a2).status is AddStatus.ACCEPTED
+        assert chain.tx_proof(only_a.txid()) is not None
+        # Competing branch from b1 confirms `spend` only, and grows heavier.
+        b2 = _mine_child(b1, txs=(Transaction.coinbase("mb", 2), spend), ts_offset=2)
+        b3 = _mine_child(b2, txs=(Transaction.coinbase("mb", 3),))
+        chain.add_block(b2)
+        res = chain.add_block(b3)
+        assert res.status is AddStatus.ACCEPTED and res.removed
+        assert chain.tx_proof(only_a.txid()) is None  # evicted with branch A
+        proof = chain.tx_proof(spend.txid())
+        assert proof is not None
+        assert proof.header.block_hash() == b2.block_hash()  # repointed
+        verify_tx_proof(proof, DIFF, genesis_hash(DIFF), txid=spend.txid())
+
+    def test_lying_peer_cannot_forge(self):
+        import dataclasses
+
+        chain, b1 = _funded_chain("alice")
+        spend = stx("alice", account("bob"), 10, 1, 0)
+        b2 = _mine_child(b1, txs=(Transaction.coinbase("m", 2), spend))
+        assert chain.add_block(b2).status is AddStatus.ACCEPTED
+        proof = chain.tx_proof(spend.txid())
+        # A proof for a different txid than asked.
+        with pytest.raises(SPVError, match="different transaction"):
+            verify_tx_proof(proof, DIFF, genesis_hash(DIFF), txid=b"\x01" * 32)
+        # Tampered transaction (amount inflated): merkle check must fail.
+        fake_tx = dataclasses.replace(proof.tx, amount=10_000)
+        with pytest.raises(SPVError):
+            verify_tx_proof(
+                dataclasses.replace(proof, tx=fake_tx),
+                DIFF,
+                genesis_hash(DIFF),
+            )
+        # Relocated index.
+        with pytest.raises(SPVError, match="merkle"):
+            verify_tx_proof(
+                dataclasses.replace(proof, index=0), DIFF, genesis_hash(DIFF)
+            )
+        # Header without the claimed work (wrong difficulty claim).
+        with pytest.raises(SPVError, match="difficulty"):
+            verify_tx_proof(proof, DIFF + 1, genesis_hash(DIFF + 1))
+        # A fabricated height-0 header that is not this chain's genesis.
+        with pytest.raises(SPVError, match="genesis"):
+            verify_tx_proof(
+                dataclasses.replace(proof, height=0), DIFF, genesis_hash(DIFF)
+            )
+        # Internally inconsistent peer claims: tip below confirming height
+        # would hand wallet scripts negative confirmations.
+        with pytest.raises(SPVError, match="tip height"):
+            verify_tx_proof(
+                dataclasses.replace(proof, tip_height=proof.height - 1),
+                DIFF,
+                genesis_hash(DIFF),
+            )
+
+    def test_headerless_work_fails(self):
+        # A header that never met the target cannot anchor a proof even if
+        # the merkle branch is internally consistent.
+        genesis = make_genesis(DIFF)
+        chain = Chain(DIFF, genesis=genesis)
+        cb = Transaction.coinbase("m", 1)
+        from p1_tpu.core import BlockHeader
+
+        header = BlockHeader(
+            version=1,
+            prev_hash=genesis.block_hash(),
+            merkle_root=merkle_root([cb.txid()]),
+            timestamp=genesis.header.timestamp + 1,
+            difficulty=DIFF,
+            nonce=0,
+        )
+        # Find a nonce that does NOT meet the target (almost any does).
+        from p1_tpu.core.header import meets_target
+
+        nonce = 0
+        while meets_target(header.with_nonce(nonce).block_hash(), DIFF):
+            nonce += 1
+        bad = TxProof(cb, header.with_nonce(nonce), 1, 1, 0, ())
+        with pytest.raises(SPVError, match="proof-of-work"):
+            verify_tx_proof(bad, DIFF, genesis_hash(DIFF))
+
+
+class TestProofWire:
+    def test_getproof_round_trip(self):
+        txid = b"\xab" * 32
+        mtype, got = protocol.decode(protocol.encode_getproof(txid))
+        assert mtype is MsgType.GETPROOF and got == txid
+
+    def test_proof_round_trip(self):
+        chain, b1 = _funded_chain("alice")
+        spend = stx("alice", account("bob"), 10, 1, 0)
+        b2 = _mine_child(b1, txs=(Transaction.coinbase("m", 2), spend))
+        chain.add_block(b2)
+        proof = chain.tx_proof(spend.txid())
+        mtype, got = protocol.decode(protocol.encode_proof(proof))
+        assert mtype is MsgType.PROOF and got == proof
+        mtype, got = protocol.decode(protocol.encode_proof(None))
+        assert mtype is MsgType.PROOF and got is None
+
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            bytes([MsgType.GETPROOF]),  # no txid
+            bytes([MsgType.GETPROOF]) + b"\x00" * 31,  # short txid
+            bytes([MsgType.GETPROOF]) + b"\x00" * 33,  # long txid
+            bytes([MsgType.PROOF]),  # no flag
+            bytes([MsgType.PROOF, 0, 0]),  # trailing after not-found
+            bytes([MsgType.PROOF, 2]),  # bad flag
+            bytes([MsgType.PROOF, 1]) + b"\x00" * 10,  # truncated body
+            bytes([MsgType.PROOF, 1]) + b"\x00" * 94 + b"\x00\x05",  # branch lies
+        ],
+    )
+    def test_malformed_rejected(self, payload):
+        with pytest.raises(ValueError):
+            protocol.decode(payload)
+
+
+class TestProofOverWire:
+    def test_node_serves_verifiable_proof(self):
+        from test_node import _config, wait_until
+
+        from p1_tpu.node import Node
+        from p1_tpu.node.client import get_proof, send_tx
+
+        NODE_DIFF = 12
+
+        async def scenario():
+            node = Node(_config(difficulty=NODE_DIFF))
+            await node.start()
+            try:
+                # Earn a balance, then confirm a spend of it.
+                from test_node import fund
+
+                await fund(node, "alice", blocks=1)
+                spend = stx(
+                    "alice", account("bob"), 10, 1, 0, difficulty=NODE_DIFF
+                )
+                await send_tx("127.0.0.1", node.port, spend, NODE_DIFF)
+                await wait_until(lambda: len(node.mempool) == 1)
+                start = node.chain.height
+                node.start_mining()
+                assert await wait_until(
+                    lambda: node.chain.tx_proof(spend.txid()) is not None
+                )
+                await node.stop_mining()
+                proof = await get_proof(
+                    "127.0.0.1", node.port, spend.txid(), NODE_DIFF
+                )
+                assert proof is not None
+                verify_tx_proof(
+                    proof,
+                    NODE_DIFF,
+                    genesis_hash(NODE_DIFF),
+                    txid=spend.txid(),
+                )
+                # Unconfirmed txid: clean not-found.
+                missing = await get_proof(
+                    "127.0.0.1", node.port, b"\x42" * 32, NODE_DIFF
+                )
+                assert missing is None
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))

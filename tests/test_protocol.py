@@ -9,6 +9,7 @@ import random
 
 import pytest
 
+from p1_tpu.chain.proof import TxProof
 from p1_tpu.core import Block, BlockHeader, Transaction, make_genesis
 from p1_tpu.node import protocol
 from p1_tpu.node.protocol import Hello, MsgType
@@ -138,6 +139,18 @@ class TestMalformed:
             protocol.encode_getaccount("p1deadbeefdeadbeef"),
             protocol.encode_account(
                 protocol.AccountState("p1deadbeefdeadbeef", 50, 1, 2, 7)
+            ),
+            protocol.encode_getproof(b"\x04" * 32),
+            protocol.encode_proof(None),
+            protocol.encode_proof(
+                TxProof(
+                    Transaction("a", "b", 1, 1, 0),
+                    _block().header,
+                    3,
+                    9,
+                    1,
+                    (b"\x05" * 32, b"\x06" * 32),
+                )
             ),
         ]
         for seed in seeds:
