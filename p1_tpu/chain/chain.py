@@ -23,6 +23,14 @@ paths" and "longest-chain" resolution on the gossip network
 - ``add_block`` reports what happened — including the reorg's removed/added
   block lists so the mempool can resurrect transactions from abandoned
   blocks and the miner knows to abort a stale search.
+- **Difficulty is contextual when a ``RetargetRule`` is active** (opt-in,
+  core/retarget.py): the required difficulty of a block is a pure function
+  of its ancestor chain (parent's difficulty, adjusted at window
+  boundaries from observed timestamps), checked at connect time; fixed
+  difficulty — every benchmark config — is the ``retarget=None`` default
+  and behaves exactly as before.  Cumulative work already weighs each
+  block by ``2**difficulty``, so fork choice across mixed-difficulty
+  branches needs no change.
 - **Contextual (ledger) validity is enforced at connect time**, Bitcoin
   style: stateless checks (PoW, merkle, signatures, subsidy) gate indexing,
   but whether a transfer overdraws its sender depends on the block's whole
@@ -44,6 +52,7 @@ from typing import Iterator
 
 from p1_tpu.core.block import Block, merkle_branch
 from p1_tpu.core.genesis import make_genesis
+from p1_tpu.core.retarget import RetargetRule
 from p1_tpu.chain.ledger import Ledger, LedgerError
 from p1_tpu.chain.proof import TxProof
 from p1_tpu.chain.validate import ValidationError, check_block
@@ -92,9 +101,20 @@ class _Entry:
 class Chain:
     """Block index + fork choice for one chain configuration."""
 
-    def __init__(self, difficulty: int, genesis: Block | None = None):
+    def __init__(
+        self,
+        difficulty: int,
+        genesis: Block | None = None,
+        retarget: RetargetRule | None = None,
+    ):
+        #: Base (genesis) difficulty.  With ``retarget`` set, per-block
+        #: required difficulty is contextual (``_expected_difficulty``) and
+        #: this stays the anchor the rule evolves from.
         self.difficulty = difficulty
-        self.genesis = genesis if genesis is not None else make_genesis(difficulty)
+        self.retarget = retarget
+        self.genesis = (
+            genesis if genesis is not None else make_genesis(difficulty, retarget)
+        )
         ghash = self.genesis.block_hash()
         self._index: dict[bytes, _Entry] = {
             ghash: _Entry(self.genesis, 0, 1 << difficulty)
@@ -167,6 +187,31 @@ class Chain:
         """The seq ``account``'s next transfer must carry (strict account
         nonce — see ledger.py's replay rule)."""
         return self._ledger.nonce(account)
+
+    def next_difficulty(self) -> int:
+        """The difficulty consensus requires of the next block on the tip
+        — what a miner must put in the header it assembles.  Equal to the
+        chain difficulty unless a ``RetargetRule`` is active."""
+        return self._expected_difficulty(self._index[self._tip_hash])
+
+    def _expected_difficulty(self, prev: _Entry) -> int:
+        """Required difficulty for a child of ``prev`` — a pure function
+        of the ancestor chain, so every node computes the same value for
+        the same parent (side branches included)."""
+        rule = self.retarget
+        if rule is None:
+            return self.difficulty
+        height = prev.height + 1
+        if height % rule.window != 0:
+            return prev.block.header.difficulty
+        # Window boundary: observe the span of the closing window (its
+        # first block is `window-1` parents above `prev`; the walk is
+        # O(window) once per window, amortized O(1)/block).
+        anchor = prev
+        for _ in range(rule.window - 1):
+            anchor = self._index[anchor.block.header.prev_hash]
+        span = prev.block.header.timestamp - anchor.block.header.timestamp
+        return rule.adjusted(prev.block.header.difficulty, span)
 
     def tx_proof(self, txid: bytes) -> TxProof | None:
         """SPV inclusion proof for a main-chain-confirmed transaction, or
@@ -363,11 +408,26 @@ class Chain:
         prev = self._index.get(block.header.prev_hash)
         if prev is None:
             return self._park_orphan(block, bhash)
+        # Contextual header rules — they need the parent, so they run here
+        # even for prevalidated orphans (parking could only check the
+        # block's internal consistency).
+        expected = self._expected_difficulty(prev)
+        if block.header.difficulty != expected:
+            return AddStatus.REJECTED, (
+                f"difficulty {block.header.difficulty} != required {expected}"
+            )
+        if (
+            self.retarget is not None
+            and block.header.timestamp <= prev.block.header.timestamp
+        ):
+            # Strictly increasing timestamps make the retarget span
+            # positive and time-freezing unprofitable (core/retarget.py).
+            return AddStatus.REJECTED, "timestamp does not increase over parent"
         if not prevalidated:
             try:
                 check_block(
                     block,
-                    self.difficulty,
+                    expected,
                     chain_tag=self.genesis.block_hash(),
                 )
             except ValidationError as e:
@@ -397,13 +457,28 @@ class Chain:
         The block must carry its own valid PoW (full stateless validation)
         before it costs us memory, and the pool is FIFO-capped: unconnectable
         junk from a hostile peer evicts, it does not accumulate.
+
+        On a retargeting chain the parent-dependent required difficulty is
+        unknowable here, so parking checks PoW at the block's *claimed*
+        difficulty and ``_insert`` re-checks the claim against the parent
+        when the orphan connects.  A flood of cheap low-difficulty orphans
+        is still bounded by the FIFO cap — it can churn the pool, never
+        grow it — and a genuine gap is backfilled by locator sync anyway.
         """
         if bhash in self._orphan_hashes:
             return AddStatus.ORPHAN, "already parked"
+        claimed = (
+            block.header.difficulty
+            if self.retarget is not None
+            else self.difficulty
+        )
+        if claimed < 1:
+            # Difficulty 0 passes every PoW check vacuously — a literally
+            # free frame must not be able to evict orphans that cost real
+            # work (same floor as proof.py's SPV check).
+            return AddStatus.REJECTED, "difficulty-0 block carries no work"
         try:
-            check_block(
-                block, self.difficulty, chain_tag=self.genesis.block_hash()
-            )
+            check_block(block, claimed, chain_tag=self.genesis.block_hash())
         except ValidationError as e:
             return AddStatus.REJECTED, str(e)
         self._orphans.setdefault(block.header.prev_hash, []).append(block)

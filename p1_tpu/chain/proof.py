@@ -21,7 +21,9 @@ the peer's claim).  Lying costs the attacker a real block's worth of work —
 the same bar Bitcoin SPV sets per header — and a client that wants more can
 cross-check several peers or replay the full header chain with
 ``p1_tpu.chain.replay`` (the header-chain verifier a full light client
-would run).  The serving side computes proofs from a txid index maintained
+would run; ``replay_host`` takes the chain's ``RetargetRule`` and
+recomputes the contextual difficulty schedule, so it works on retargeting
+chains too — ``p1 replay --method host``).  The serving side computes proofs from a txid index maintained
 at the tip (``Chain.tx_proof``), so queries are O(block size), not
 O(chain).
 """
@@ -60,14 +62,26 @@ def verify_tx_proof(
     difficulty: int,
     chain_tag: bytes,
     txid: bytes | None = None,
+    retarget=None,
 ) -> None:
     """Raise ``SPVError`` unless ``proof`` checks out for the chain whose
-    required difficulty and genesis hash (``chain_tag``) are given.
+    base difficulty, genesis hash (``chain_tag``) and optional
+    ``RetargetRule`` are given.
 
     Pure function of its arguments — this is the *client* side, run by
     wallets that hold no chain.  ``txid`` pins the proof to the transaction
     the caller asked about (a peer answering with a different, valid proof
     must not pass).
+
+    Work-bar honesty on retargeting chains: the difficulty consensus
+    required at the proof's height is contextual (a function of the whole
+    ancestor chain — chain/chain.py), which a stateless verifier cannot
+    recompute.  So with ``retarget`` set, the check is proof-of-work *at
+    the header's claimed difficulty*: forging the proof costs
+    ``2^claimed`` hashes, and the claimed figure is surfaced by
+    ``p1 proof`` so the caller sees exactly what bar the evidence meets.
+    Fixed-difficulty chains (every benchmark config) keep the strict
+    equality check.
     """
     header = proof.header
     have_txid = proof.tx.txid()
@@ -81,11 +95,15 @@ def verify_tx_proof(
             f"tip height {proof.tip_height} below confirming height "
             f"{proof.height}"
         )
-    if header.difficulty != difficulty:
-        raise SPVError(
-            f"header difficulty {header.difficulty} != chain difficulty "
-            f"{difficulty}"
-        )
+    if retarget is None:
+        if header.difficulty != difficulty:
+            raise SPVError(
+                f"header difficulty {header.difficulty} != chain "
+                f"difficulty {difficulty}"
+            )
+    elif header.difficulty < 1:
+        # Difficulty 0 makes every hash "valid" — zero-work evidence.
+        raise SPVError("difficulty-0 header proves nothing")
     if proof.height == 0:
         # Genesis anchors by identity, not work (core/genesis.py) — the
         # only height-0 header a client accepts is the chain tag itself.

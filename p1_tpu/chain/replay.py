@@ -21,27 +21,43 @@ from p1_tpu.core.header import BlockHeader, meets_target
 from p1_tpu.core.genesis import make_genesis
 
 
+def _expected_difficulty_at(
+    headers: list[BlockHeader], i: int, retarget
+) -> int:
+    """Required difficulty of ``headers[i]`` given its predecessors — the
+    linear-chain form of ``Chain._expected_difficulty`` (same boundary,
+    same window-1-interval span, same rule)."""
+    if retarget is None or i == 0:
+        return headers[0].difficulty if headers else 0
+    if i % retarget.window != 0:
+        return headers[i - 1].difficulty
+    span = headers[i - 1].timestamp - headers[i - retarget.window].timestamp
+    return retarget.adjusted(headers[i - 1].difficulty, span)
+
+
 def generate_headers(
-    n: int, difficulty: int, backend=None, progress=None
+    n: int, difficulty: int, backend=None, progress=None, retarget=None
 ) -> list[BlockHeader]:
     """Mine an ``n``-header chain (genesis first) at ``difficulty``.
 
     Header-only mining: empty merkle root, timestamps stepping one second.
     ``backend`` is any HashBackend (default cpu); low difficulties make
-    10k-header generation cheap enough for a test fixture.
+    10k-header generation cheap enough for a test fixture.  With a
+    ``RetargetRule`` the chain follows the rule's difficulty schedule
+    (and its genesis commits to the rule).
     """
     from p1_tpu.hashx import get_backend
     from p1_tpu.miner import Miner
 
     miner = Miner(backend=backend if backend is not None else get_backend("cpu"))
-    headers = [make_genesis(difficulty).header]
+    headers = [make_genesis(difficulty, retarget).header]
     for height in range(1, n):
         draft = BlockHeader(
             version=1,
             prev_hash=headers[-1].block_hash(),
             merkle_root=bytes(32),
             timestamp=headers[-1].timestamp + 1,
-            difficulty=difficulty,
+            difficulty=_expected_difficulty_at(headers, height, retarget),
             nonce=0,
         )
         sealed = miner.search_nonce(draft)
@@ -71,17 +87,40 @@ class ReplayReport:
         return self.n_headers / self.elapsed_s if self.elapsed_s > 0 else 0.0
 
 
-def replay_host(headers: list[BlockHeader]) -> ReplayReport:
-    """Sequential hashlib verification: PoW + prev-hash linkage."""
+def replay_host(headers: list[BlockHeader], retarget=None) -> ReplayReport:
+    """Sequential hashlib verification: PoW + prev-hash linkage.
+
+    With a ``RetargetRule`` this is the full light-client header check for
+    retargeting chains: the required difficulty is recomputed per header
+    from the sequence itself (it is a pure function of the headers), and
+    timestamps must strictly increase — exactly the rules ``Chain``
+    enforces at connect time.  This is the engine the SPV docs point
+    wallet operators at when a one-header proof's work bar is not enough
+    (chain/proof.py).  The native/device engines stay fixed-difficulty
+    (the benchmark-config form); the host oracle is the retarget path.
+
+    Trust note: ``headers[0]`` self-attests the base difficulty — the
+    CALLER must pin it to the chain it cares about
+    (``headers[0].block_hash() == genesis_hash(difficulty, rule)``), or a
+    forged file claiming a trivial base difficulty "verifies" cheaply.
+    ``p1 replay --verify`` performs exactly that check.
+    """
     t0 = time.perf_counter()
     prev_digest = bytes(32)
     first_invalid = None
-    difficulty = headers[0].difficulty if headers else 0
+    expected = headers[0].difficulty if headers else 0
     for i, header in enumerate(headers):
         digest = sha256d(header.serialize())
-        pow_ok = i == 0 or meets_target(digest, difficulty)
-        diff_ok = header.difficulty == difficulty
-        if not (pow_ok and diff_ok and header.prev_hash == prev_digest):
+        if retarget is not None and i >= 1:
+            expected = _expected_difficulty_at(headers, i, retarget)
+        pow_ok = i == 0 or meets_target(digest, expected)
+        diff_ok = header.difficulty == expected
+        ts_ok = (
+            retarget is None
+            or i == 0
+            or header.timestamp > headers[i - 1].timestamp
+        )
+        if not (pow_ok and diff_ok and ts_ok and header.prev_hash == prev_digest):
             first_invalid = i
             break
         prev_digest = digest

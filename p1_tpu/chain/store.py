@@ -151,18 +151,38 @@ class ChainStore:
         return out
 
     def load_chain(
-        self, difficulty: int, blocks: list[Block] | None = None
+        self,
+        difficulty: int,
+        blocks: list[Block] | None = None,
+        retarget=None,
     ) -> Chain:
         """Rebuild a validated chain from the log (skipping the genesis
         record, which the Chain constructor provides).  Pass ``blocks``
         when the caller already ran ``load_blocks`` (avoids a second full
-        read+parse of the log)."""
-        chain = Chain(difficulty)
+        read+parse of the log), and the store's ``RetargetRule`` if the
+        chain was mined with one (the rule is part of chain identity).
+
+        Raises ValueError when records exist but NONE connect — that is a
+        store from a chain with different parameters (wrong difficulty /
+        retarget flags), and proceeding would be catastrophic for some
+        callers (``p1 compact`` would rewrite the store as a genesis-only
+        snapshot of the wrong chain).  The guard lives here, once, so no
+        call site can forget it; a partially-connecting store (corrupt
+        tail) still loads what it can."""
+        chain = Chain(difficulty, retarget=retarget)
         ghash = chain.genesis.block_hash()
+        saw_record = False
         for block in self.load_blocks() if blocks is None else blocks:
             if block.block_hash() == ghash:
                 continue
+            saw_record = True
             chain.add_block(block)
+        if saw_record and not chain.height:
+            raise ValueError(
+                f"{self.path}: records do not connect to this chain's "
+                "genesis — wrong --difficulty or "
+                "--retarget-window/--target-spacing for this store?"
+            )
         return chain
 
 

@@ -37,6 +37,43 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--chunk", type=int, default=None, help="miner abort granularity")
 
 
+def _add_retarget(p: argparse.ArgumentParser) -> None:
+    """Chain-identity flags for opt-in difficulty retargeting.  They ride
+    every command that selects a chain (node/net and the wallet tools):
+    the rule is committed into genesis, so a client that omits them cannot
+    even handshake with a retargeting node."""
+    p.add_argument(
+        "--retarget-window",
+        type=int,
+        default=0,
+        help="adjust difficulty every N blocks (0 = fixed difficulty; "
+        "all chain participants must agree — the rule is part of the "
+        "chain's genesis identity)",
+    )
+    p.add_argument(
+        "--target-spacing",
+        type=int,
+        default=0,
+        help="target seconds per block for retargeting (set together "
+        "with --retarget-window)",
+    )
+
+
+def _retarget_rule(args):
+    """The ``RetargetRule`` selected by the flags, or None (fixed) — flag
+    validation lives in ``RetargetRule.from_params``; here only the
+    ValueError→SystemExit mapping."""
+    from p1_tpu.core.retarget import RetargetRule
+
+    try:
+        return RetargetRule.from_params(
+            getattr(args, "retarget_window", 0),
+            getattr(args, "target_spacing", 0),
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="p1_tpu", description="TPU-native proof-of-work blockchain node"
@@ -79,6 +116,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--out", default=None, help="write generated headers here")
     p.add_argument("--verify", default=None, help="verify this header file instead")
+    _add_retarget(p)
 
     p = sub.add_parser("node", help="run one p2p node")
     _add_common(p)
@@ -110,6 +148,7 @@ def build_parser() -> argparse.ArgumentParser:
         "can cost many seconds, so parent-computed wall times are unsafe)",
     )
     p.add_argument("--status-interval", type=float, default=10.0)
+    _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
     p.add_argument("--difficulty", type=int, default=16, help="chain selector")
@@ -132,6 +171,7 @@ def build_parser() -> argparse.ArgumentParser:
         "exact next nonce; default: query the node via GETACCOUNT and "
         "use its next usable seq)",
     )
+    _add_retarget(p)
 
     p = sub.add_parser(
         "account",
@@ -146,6 +186,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--key", default=None, help="key file; queries its fingerprint account"
     )
+    _add_retarget(p)
 
     p = sub.add_parser(
         "proof",
@@ -157,6 +198,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--txid", required=True, help="hex txid (printed by `p1 tx`)"
     )
+    _add_retarget(p)
 
     p = sub.add_parser(
         "keygen", help="create an Ed25519 spending key (account = fingerprint)"
@@ -188,6 +230,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--account", default=None, help="print one account instead of all"
     )
+    _add_retarget(p)
 
     p = sub.add_parser(
         "pod",
@@ -231,6 +274,7 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write here instead of replacing the store in place",
     )
+    _add_retarget(p)
 
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
@@ -246,6 +290,7 @@ def build_parser() -> argparse.ArgumentParser:
         "then audits ledger conservation (sum == reward x height) on "
         "every node",
     )
+    _add_retarget(p)
 
     sub.add_parser("bench", help="headline benchmark (one JSON line)")
     return parser
@@ -386,6 +431,18 @@ def cmd_replay(args) -> int:
     from p1_tpu.core.header import HEADER_SIZE, BlockHeader
     from p1_tpu.hashx import get_backend
 
+    rule = _retarget_rule(args)
+    if rule is not None and args.method != "host":
+        # The host oracle is the retarget-aware engine (chain/replay.py);
+        # the native/device tiers implement the benchmark-config form
+        # (fixed difficulty) and would mis-report an honest retargeting
+        # chain as invalid at the first adjustment.
+        print(
+            "retargeting chains verify with --method host (the native/"
+            "device engines are fixed-difficulty)",
+            file=sys.stderr,
+        )
+        return 2
     if args.verify:
         raw = open(args.verify, "rb").read()
         if len(raw) % HEADER_SIZE:
@@ -395,11 +452,30 @@ def cmd_replay(args) -> int:
             BlockHeader.deserialize(raw[i : i + HEADER_SIZE])
             for i in range(0, len(raw), HEADER_SIZE)
         ]
+        # Pin the file to the chain the operator selected: header[0] is
+        # otherwise SELF-attested, and a forged file whose genesis claims
+        # difficulty 1 would "verify" with no meaningful work behind it —
+        # fatal for the light-client escalation path this command serves.
+        from p1_tpu.core.genesis import make_genesis
+
+        if (
+            not headers
+            or headers[0].block_hash()
+            != make_genesis(args.difficulty, rule).block_hash()
+        ):
+            print(
+                f"{args.verify}: does not start at this chain's genesis "
+                "(check --difficulty / retarget flags)",
+                file=sys.stderr,
+            )
+            return 2
     else:
         kwargs = {"batch": args.batch} if args.batch else {}
         backend = get_backend(args.backend, **kwargs)
         t0 = time.perf_counter()
-        headers = generate_headers(args.n, args.difficulty, backend=backend)
+        headers = generate_headers(
+            args.n, args.difficulty, backend=backend, retarget=rule
+        )
         logging.info("generated %d headers in %.1fs", args.n, time.perf_counter() - t0)
         if args.out:
             with open(args.out, "wb") as fh:
@@ -408,7 +484,7 @@ def cmd_replay(args) -> int:
 
     reports = []
     if args.method in ("host", "both", "all"):
-        reports.append(replay_host(headers))
+        reports.append(replay_host(headers, retarget=rule))
     if args.method in ("native", "all"):
         reports.append(replay_native(headers))
     if args.method in ("device", "both", "all"):
@@ -456,6 +532,11 @@ async def _run_node(args, miner=None) -> int:
         batch=args.batch,
         chunk=args.chunk,
         miner_id=args.miner_id,
+        # getattr: `p1 pod` reuses this runner with its own arg namespace,
+        # which has no retarget flags (pod mining is fixed-difficulty —
+        # config 5's shape).
+        retarget_window=getattr(args, "retarget_window", 0),
+        target_spacing=getattr(args, "target_spacing", 0),
     )
     node = Node(config, miner=miner)
     await node.start()
@@ -503,6 +584,7 @@ async def _run_node(args, miner=None) -> int:
 
 
 def cmd_node(args) -> int:
+    _retarget_rule(args)  # flag-pair validation: clean error, no traceback
     if getattr(args, "platform", None):
         import jax
 
@@ -526,12 +608,19 @@ def cmd_tx(args) -> int:
         from p1_tpu.node.client import get_account
 
         key = Keypair.load(args.key)
+        rule = _retarget_rule(args)
         seq = args.seq
         if seq is None:
             # Wallet convenience: consensus wants the exact next nonce, so
             # ask the node (chain nonce advanced past its pending pool).
             state = asyncio.run(
-                get_account(args.host, args.port, key.account, args.difficulty)
+                get_account(
+                    args.host,
+                    args.port,
+                    key.account,
+                    args.difficulty,
+                    retarget=rule,
+                )
             )
             seq = state.next_seq
         tx = Transaction.transfer(
@@ -540,10 +629,10 @@ def cmd_tx(args) -> int:
             args.amount,
             args.fee,
             seq,
-            chain=genesis_hash(args.difficulty),
+            chain=genesis_hash(args.difficulty, rule),
         )
         height = asyncio.run(
-            send_tx(args.host, args.port, tx, args.difficulty)
+            send_tx(args.host, args.port, tx, args.difficulty, retarget=rule)
         )
     except (
         ConnectionError,
@@ -581,7 +670,13 @@ def cmd_account(args) -> int:
     try:
         account = args.account or Keypair.load(args.key).account
         state = asyncio.run(
-            get_account(args.host, args.port, account, args.difficulty)
+            get_account(
+                args.host,
+                args.port,
+                account,
+                args.difficulty,
+                retarget=_retarget_rule(args),
+            )
         )
     except (
         ConnectionError,
@@ -622,11 +717,14 @@ def cmd_proof(args) -> int:
     from p1_tpu.node.client import get_proof
 
     try:
+        rule = _retarget_rule(args)
         txid = bytes.fromhex(args.txid)
         if len(txid) != 32:
             raise ValueError("txid must be 32 hex-encoded bytes")
         proof = asyncio.run(
-            get_proof(args.host, args.port, txid, args.difficulty)
+            get_proof(
+                args.host, args.port, txid, args.difficulty, retarget=rule
+            )
         )
     except (
         ConnectionError,
@@ -642,7 +740,11 @@ def cmd_proof(args) -> int:
         return 3
     try:
         verify_tx_proof(
-            proof, args.difficulty, genesis_hash(args.difficulty), txid=txid
+            proof,
+            args.difficulty,
+            genesis_hash(args.difficulty, rule),
+            txid=txid,
+            retarget=rule,
         )
     except SPVError as e:
         print(f"peer served an INVALID proof: {e}", file=sys.stderr)
@@ -657,6 +759,9 @@ def cmd_proof(args) -> int:
                 "height": proof.height,
                 "confirmations": proof.confirmations,
                 "block": proof.header.block_hash().hex(),
+                # The work bar this evidence meets (== chain difficulty on
+                # fixed chains; the header's claim on retargeting chains).
+                "difficulty": proof.header.difficulty,
                 "index": proof.index,
                 "branch_len": len(proof.branch),
                 "amount": proof.tx.amount,
@@ -872,12 +977,15 @@ def cmd_pod(args) -> int:
 # -- balances ------------------------------------------------------------
 
 
-def _load_store(path: str, expected_difficulty: int | None = None):
+def _load_store(
+    path: str, expected_difficulty: int | None = None, retarget=None
+):
     """(blocks, chain) from a persisted store, difficulty inferred from the
     records (every block declares the chain difficulty — validation
-    enforces it — so the store is self-describing).  Raises SystemExit 2
-    for an empty/missing store or an ``expected_difficulty`` mismatch —
-    both checked BEFORE the (potentially expensive) validated replay."""
+    enforces it — so the store is self-describing; the retarget rule is
+    NOT, so retarget chains need their flags).  Raises SystemExit 2 for an
+    empty/missing store, an ``expected_difficulty`` mismatch, or records
+    that do not connect to the selected genesis (wrong retarget flags)."""
     from p1_tpu.chain import ChainStore
 
     store = ChainStore(path)
@@ -897,14 +1005,20 @@ def _load_store(path: str, expected_difficulty: int | None = None):
             file=sys.stderr,
         )
         raise SystemExit(2)
-    chain = store.load_chain(stored, blocks)
+    try:
+        chain = store.load_chain(stored, blocks, retarget=retarget)
+    except ValueError as e:  # none-connected guard (store.py)
+        print(str(e), file=sys.stderr)
+        raise SystemExit(2)
     return blocks, chain
 
 
 def cmd_balances(args) -> int:
     from p1_tpu.chain import balances
 
-    blocks, chain = _load_store(args.store, args.difficulty)
+    blocks, chain = _load_store(
+        args.store, args.difficulty, retarget=_retarget_rule(args)
+    )
     ledger = balances(chain.main_chain())
     if args.account is not None:
         print(
@@ -976,7 +1090,18 @@ def cmd_compact(args) -> int:
         if not blocks:
             print(f"{args.store}: empty chain store", file=sys.stderr)
             return 2
-        chain = src.load_chain(blocks[0].header.difficulty, blocks)
+        try:
+            chain = src.load_chain(
+                blocks[0].header.difficulty,
+                blocks,
+                retarget=_retarget_rule(args),
+            )
+        except ValueError as e:
+            # Without this, compacting a retarget store with forgotten
+            # flags would REPLACE it with a genesis-only snapshot of the
+            # wrong chain — the one unrecoverable failure mode here.
+            print(str(e), file=sys.stderr)
+            return 2
         before = os.path.getsize(args.store)
         out = args.out or args.store
         dst = None
@@ -1022,7 +1147,9 @@ def cmd_compact(args) -> int:
 # -- net -----------------------------------------------------------------
 
 
-def _net_inject_txs(ports, keys, difficulty, deadline, rate) -> tuple[int, int]:
+def _net_inject_txs(
+    ports, keys, difficulty, deadline, rate, retarget=None
+) -> tuple[int, int]:
     """Drive a live economy during a `p1 net` run: ~``rate`` transfers/sec,
     each one a real wallet round — GETACCOUNT for the sender's next seq at
     its own node, sign chain-bound, push via the tx client.  Best-effort:
@@ -1034,7 +1161,7 @@ def _net_inject_txs(ports, keys, difficulty, deadline, rate) -> tuple[int, int]:
     from p1_tpu.core.tx import Transaction
     from p1_tpu.node.client import get_account, send_tx
 
-    tag = genesis_hash(difficulty)
+    tag = genesis_hash(difficulty, retarget)
     submitted = failed = 0
 
     async def run() -> None:
@@ -1046,7 +1173,12 @@ def _net_inject_txs(ports, keys, difficulty, deadline, rate) -> tuple[int, int]:
             recipient = keys[rng.randrange(len(keys))].account
             try:
                 state = await get_account(
-                    "127.0.0.1", ports[i], keys[i].account, difficulty, timeout=5
+                    "127.0.0.1",
+                    ports[i],
+                    keys[i].account,
+                    difficulty,
+                    timeout=5,
+                    retarget=retarget,
                 )
                 amount = rng.randint(1, 5)
                 if state.balance >= amount + 1:
@@ -1054,7 +1186,12 @@ def _net_inject_txs(ports, keys, difficulty, deadline, rate) -> tuple[int, int]:
                         keys[i], recipient, amount, 1, state.next_seq, chain=tag
                     )
                     await send_tx(
-                        "127.0.0.1", ports[i], tx, difficulty, timeout=5
+                        "127.0.0.1",
+                        ports[i],
+                        tx,
+                        difficulty,
+                        timeout=5,
+                        retarget=retarget,
                     )
                     submitted += 1
             except (
@@ -1083,6 +1220,10 @@ def cmd_net(args) -> int:
 
     from p1_tpu.core.keys import Keypair
 
+    # Validate the retarget flag pair up front: a bad pair must be ONE
+    # clean CLI error here, not N child-node tracebacks (or — for a lone
+    # --target-spacing — a silently fixed-difficulty run).
+    net_rule = _retarget_rule(args)
     ports = [args.base_port + i for i in range(args.nodes)]
     keys = [
         Keypair.from_seed_text(f"p1-net-{args.base_port}-{i}")
@@ -1110,6 +1251,11 @@ def cmd_net(args) -> int:
             cmd += ["--chunk", str(args.chunk)]
         if args.batch:
             cmd += ["--batch", str(args.batch)]
+        if net_rule is not None:
+            cmd += [
+                "--retarget-window", str(net_rule.window),
+                "--target-spacing", str(net_rule.spacing),
+            ]
         peers = [f"127.0.0.1:{p}" for p in ports[:i]]
         if peers:
             cmd += ["--peers", *peers]
@@ -1134,7 +1280,12 @@ def cmd_net(args) -> int:
         txs_submitted = txs_failed = 0
         if args.tx_rate > 0:
             txs_submitted, txs_failed = _net_inject_txs(
-                ports, keys, args.difficulty, deadline, args.tx_rate
+                ports,
+                keys,
+                args.difficulty,
+                deadline,
+                args.tx_rate,
+                retarget=net_rule,
             )
         for proc in procs:
             out, _ = proc.communicate(timeout=args.duration + 120)

@@ -25,6 +25,19 @@ class NodeConfig:
     #: Coinbase recipient id.  None = a random per-process id, which is what
     #: makes two independent miners produce *different* candidate blocks.
     miner_id: str | None = None
+    #: Opt-in difficulty retargeting (core/retarget.py).  0 = fixed
+    #: difficulty (every benchmark config).  Both must be set together;
+    #: the pair is part of chain identity (committed into genesis).
+    retarget_window: int = 0
+    target_spacing: int = 0
+
+    def retarget_rule(self):
+        """The chain's ``RetargetRule``, or None for fixed difficulty."""
+        from p1_tpu.core.retarget import RetargetRule
+
+        return RetargetRule.from_params(
+            self.retarget_window, self.target_spacing
+        )
 
     def peer_addrs(self) -> list[tuple[str, int]]:
         # A bare "host:port" string would otherwise iterate character-wise.

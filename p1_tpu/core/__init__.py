@@ -14,6 +14,7 @@ from p1_tpu.core.block import (
     verify_merkle_branch,
 )
 from p1_tpu.core.genesis import GENESIS_TIMESTAMP, make_genesis
+from p1_tpu.core.retarget import RetargetRule
 
 __all__ = [
     "HEADER_SIZE",
@@ -29,4 +30,5 @@ __all__ = [
     "verify_merkle_branch",
     "GENESIS_TIMESTAMP",
     "make_genesis",
+    "RetargetRule",
 ]

@@ -1,28 +1,55 @@
 """Deterministic genesis block.
 
 Capability parity: "genesis block, difficulty=16" (BASELINE.json:7).  The
-genesis block is fixed per (difficulty,) chain configuration: zero prev-hash,
-no transactions, a fixed timestamp, nonce 0.  Genesis is exempt from the PoW
+genesis block is fixed per chain configuration: zero prev-hash, no
+transactions, a fixed timestamp, nonce 0.  Genesis is exempt from the PoW
 check (it anchors the chain by identity, not by work) — validation in
 ``p1_tpu.chain`` special-cases height 0.
+
+Chain identity = genesis hash.  A fixed-difficulty chain's genesis is a
+pure function of the difficulty; a retargeting chain (core/retarget.py)
+additionally **commits the rule's parameters into the genesis merkle
+field**, so two nodes that disagree on (window, spacing, max_adjust) are
+simply on different chains — the HELLO handshake refuses the connection
+and chain-bound signatures refuse the replay, with no extra protocol
+surface.  (The genesis block has no transactions, so its merkle field is
+free to carry the commitment; height-0 blocks are validated by identity,
+never by ``check_block``'s merkle recomputation.)
 """
 
 from __future__ import annotations
 
 import functools
+import struct
 
 from p1_tpu.core.block import EMPTY_MERKLE_ROOT, Block
 from p1_tpu.core.header import BlockHeader
+from p1_tpu.core.retarget import RetargetRule
 
 GENESIS_VERSION = 1
 GENESIS_TIMESTAMP = 1735689600  # 2025-01-01T00:00:00Z, fixed forever
+_RETARGET_TAG = b"p1-retarget-v1"
 
 
-def make_genesis(difficulty: int) -> Block:
+@functools.lru_cache(maxsize=256)
+def make_genesis(
+    difficulty: int, retarget: RetargetRule | None = None
+) -> Block:
+    if retarget is None:
+        merkle = EMPTY_MERKLE_ROOT
+    else:
+        from p1_tpu.core.hashutil import sha256d
+
+        merkle = sha256d(
+            _RETARGET_TAG
+            + struct.pack(
+                ">III", retarget.window, retarget.spacing, retarget.max_adjust
+            )
+        )
     header = BlockHeader(
         version=GENESIS_VERSION,
         prev_hash=bytes(32),
-        merkle_root=EMPTY_MERKLE_ROOT,
+        merkle_root=merkle,
         timestamp=GENESIS_TIMESTAMP,
         difficulty=difficulty,
         nonce=0,
@@ -31,7 +58,10 @@ def make_genesis(difficulty: int) -> Block:
 
 
 @functools.lru_cache(maxsize=256)
-def genesis_hash(difficulty: int) -> bytes:
-    """The chain id: genesis block hash for a difficulty (memoized — it is
-    the signing-domain tag of every transfer, checked per tx)."""
-    return make_genesis(difficulty).block_hash()
+def genesis_hash(
+    difficulty: int, retarget: RetargetRule | None = None
+) -> bytes:
+    """The chain id: genesis block hash for a chain configuration
+    (memoized — it is the signing-domain tag of every transfer, checked
+    per tx)."""
+    return make_genesis(difficulty, retarget).block_hash()

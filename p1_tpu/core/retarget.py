@@ -1,0 +1,82 @@
+"""Difficulty retargeting: the consensus rule that keeps block spacing.
+
+Capability parity: BASELINE.json's configs pin difficulty per run (16..28),
+so fixed difficulty stays the default everywhere — but a "Bitcoin-like toy
+cryptocurrency" (BASELINE.json:5) whose difficulty can never move is only
+half a consensus engine, so retargeting ships as an **opt-in chain
+parameter**.  Design (Bitcoin's shape, bit-granular):
+
+- Every ``window`` blocks, compare the observed span of the last window
+  against ``spacing * (window - 1)`` (window blocks bound window-1
+  intervals — honoring, not repeating, Bitcoin's famous 2015/2016
+  off-by-one) and move the difficulty by whole bits: one bit per 2x
+  deviation, clamped to ``max_adjust`` bits per retarget (Bitcoin clamps
+  the timespan 4x = our default 2 bits).  Difficulty here is "required
+  leading zero bits" (core/header.py), so ±1 bit is exactly ±2x work —
+  integer comparisons only, no floats anywhere near consensus.
+- The rule's parameters are **committed into the genesis block**
+  (core/genesis.py): two chains with different rules have different chain
+  ids, so the HELLO handshake and chain-bound transaction signatures
+  enforce rule agreement with no extra protocol surface.
+- Timestamps must strictly increase on retargeting chains (enforced at
+  connect time in chain/chain.py) so the observed span is positive and a
+  miner cannot freeze time to farm easy blocks.  There is deliberately no
+  wall-clock future bound: consensus stays a pure function of the block
+  DAG (SURVEY §5 determinism), and backdating is already unprofitable —
+  claiming a shorter span only *raises* the difficulty.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RetargetRule:
+    """Opt-in difficulty adjustment parameters (a chain-identity field)."""
+
+    window: int  # blocks per retarget period
+    spacing: int  # target seconds between blocks
+    max_adjust: int = 2  # max bits moved per retarget (2 bits = Bitcoin's 4x)
+
+    def __post_init__(self) -> None:
+        if self.window < 2:
+            raise ValueError("retarget window must be >= 2 blocks")
+        if self.spacing < 1:
+            raise ValueError("target spacing must be >= 1 second")
+        if not 1 <= self.max_adjust <= 8:
+            raise ValueError("max_adjust must be in 1..8 bits")
+
+    @classmethod
+    def from_params(
+        cls, window: int, spacing: int
+    ) -> "RetargetRule | None":
+        """The ONE home of flag/config-pair validation: both must be set
+        together; (0, 0) selects fixed difficulty (None).  CLI and
+        NodeConfig both delegate here so the wallet and node paths can
+        never diverge on what chain a flag pair names."""
+        if bool(window) != bool(spacing):
+            raise ValueError(
+                "--retarget-window and --target-spacing must be set together"
+            )
+        return cls(window, spacing) if window else None
+
+    @property
+    def expected_span(self) -> int:
+        """Target seconds for one whole window (window-1 intervals)."""
+        return self.spacing * (self.window - 1)
+
+    def adjusted(self, parent_difficulty: int, span: int) -> int:
+        """The difficulty for the block that opens a new window, given the
+        observed ``span`` of the window just closed.  Integer-only: one
+        bit harder per halving of the expected span, one bit easier per
+        doubling, clamped to ``max_adjust`` and to the 1..255 range the
+        header can express (difficulty 0 would make every hash valid)."""
+        span = max(1, span)
+        adj = 0
+        while adj < self.max_adjust and span * (2 << adj) <= self.expected_span:
+            adj += 1
+        if adj == 0:
+            while adj > -self.max_adjust and span >= (2 << (-adj)) * self.expected_span:
+                adj -= 1
+        return min(255, max(1, parent_difficulty + adj))

@@ -21,13 +21,14 @@ from p1_tpu.node.protocol import Hello, MsgType
 
 
 @contextlib.asynccontextmanager
-async def _session(host: str, port: int, difficulty: int):
-    """Connect + HELLO-validate against the ``difficulty`` chain; yields
-    (reader, writer, peer_hello).  The ONE copy of the handshake both
-    clients share — a protocol change lands here once."""
+async def _session(host: str, port: int, difficulty: int, retarget=None):
+    """Connect + HELLO-validate against the chain selected by
+    ``difficulty`` (+ optional ``RetargetRule`` — part of chain identity);
+    yields (reader, writer, peer_hello).  The ONE copy of the handshake
+    all clients share — a protocol change lands here once."""
     reader, writer = await asyncio.open_connection(host, port)
     try:
-        genesis_hash = make_genesis(difficulty).block_hash()
+        genesis_hash = make_genesis(difficulty, retarget).block_hash()
         await protocol.write_frame(
             writer, protocol.encode_hello(Hello(genesis_hash, 0, 0))
         )
@@ -37,7 +38,7 @@ async def _session(host: str, port: int, difficulty: int):
         if hello.genesis_hash != genesis_hash:
             raise ValueError(
                 "genesis mismatch: node runs a different chain "
-                "(check --difficulty)"
+                "(check --difficulty / retarget flags)"
             )
         yield reader, writer, hello
     finally:
@@ -49,7 +50,12 @@ async def _session(host: str, port: int, difficulty: int):
 
 
 async def send_tx(
-    host: str, port: int, tx: Transaction, difficulty: int, timeout: float = 10.0
+    host: str,
+    port: int,
+    tx: Transaction,
+    difficulty: int,
+    timeout: float = 10.0,
+    retarget=None,
 ) -> int:
     """Push ``tx`` to the node at host:port; return the node's tip height.
 
@@ -59,7 +65,11 @@ async def send_tx(
     """
 
     async def _run() -> int:
-        async with _session(host, port, difficulty) as (reader, writer, hello):
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            hello,
+        ):
             await protocol.write_frame(writer, protocol.encode_tx(tx))
             return hello.tip_height
 
@@ -67,7 +77,12 @@ async def send_tx(
 
 
 async def get_proof(
-    host: str, port: int, txid: bytes, difficulty: int, timeout: float = 10.0
+    host: str,
+    port: int,
+    txid: bytes,
+    difficulty: int,
+    timeout: float = 10.0,
+    retarget=None,
 ):
     """Fetch the SPV inclusion proof for ``txid`` from the node at
     host:port.  Returns a ``TxProof`` or ``None`` (not confirmed on the
@@ -75,7 +90,11 @@ async def get_proof(
     ``p1_tpu.chain.verify_tx_proof`` — never trust, always check."""
 
     async def _run():
-        async with _session(host, port, difficulty) as (reader, writer, _):
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
             await protocol.write_frame(writer, protocol.encode_getproof(txid))
             while True:
                 mtype, body = protocol.decode(await protocol.read_frame(reader))
@@ -86,7 +105,12 @@ async def get_proof(
 
 
 async def get_account(
-    host: str, port: int, account: str, difficulty: int, timeout: float = 10.0
+    host: str,
+    port: int,
+    account: str,
+    difficulty: int,
+    timeout: float = 10.0,
+    retarget=None,
 ) -> protocol.AccountState:
     """Query ``account``'s consensus state (balance, nonce, next usable
     seq) from the node at host:port — what a wallet needs before signing.
@@ -94,7 +118,11 @@ async def get_account(
     GETMEMPOOL request) until the ACCOUNT reply arrives."""
 
     async def _run() -> protocol.AccountState:
-        async with _session(host, port, difficulty) as (reader, writer, _):
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
             await protocol.write_frame(writer, protocol.encode_getaccount(account))
             while True:
                 mtype, body = protocol.decode(await protocol.read_frame(reader))

@@ -126,12 +126,13 @@ class Node:
         #: concurrent miners assemble *different* candidate blocks and the
         #: fork-choice machinery is actually exercised at network level.
         self.miner_id = config.miner_id or f"m-{secrets.token_hex(4)}"
-        self.chain = Chain(config.difficulty)
+        self.chain = Chain(config.difficulty, retarget=config.retarget_rule())
         # balance_of is a bound-late lambda (not a bound method) so the
         # store-resume path in start(), which REPLACES self.chain, keeps
         # the pool pointed at the live chain's ledger.  The chain tag is
-        # safe to bind eagerly: it is a pure function of the difficulty,
-        # which a resume cannot change (start() refuses mismatched stores).
+        # safe to bind eagerly: it is a pure function of the chain
+        # parameters (difficulty + retarget rule), which a resume cannot
+        # change (start() refuses mismatched stores).
         self.mempool = Mempool(
             balance_of=lambda acct: self.chain.balance(acct),
             nonce_of=lambda acct: self.chain.nonce(acct),
@@ -183,7 +184,19 @@ class Node:
             # load_chain already routes every record through full add_block
             # validation, and keeps persisted side branches alive (store.py)
             # — adopt it wholesale instead of re-validating main_chain only.
-            self.chain = self.store.load_chain(self.config.difficulty, blocks)
+            # Its none-connected guard (a store from a chain with different
+            # parameters) surfaces as ValueError; close the store before
+            # re-raising so a corrected in-process retry doesn't find its
+            # own stale flock.
+            try:
+                self.chain = self.store.load_chain(
+                    self.config.difficulty,
+                    blocks,
+                    retarget=self.config.retarget_rule(),
+                )
+            except ValueError as e:
+                self.store.close()
+                raise RuntimeError(str(e)) from e
             if self.chain.height:
                 log.info(
                     "resumed chain height=%d tip=%s",
@@ -576,7 +589,9 @@ class Node:
             prev_hash=tip.block_hash(),
             merkle_root=merkle_root([tx.txid() for tx in txs]),
             timestamp=max(tip.header.timestamp + 1, int(time.time())),
-            difficulty=self.config.difficulty,
+            # What consensus requires of the next block — equals the
+            # configured difficulty unless a retarget rule has moved it.
+            difficulty=self.chain.next_difficulty(),
             nonce=0,
         )
         return Block(header, txs)

@@ -204,6 +204,38 @@ class TestCli:
         # The audit is vacuous unless transfers actually flowed.
         assert out["economy"]["txs_submitted"] > 0, out["economy"]
 
+    def test_replay_verify_pins_genesis(self, tmp_path):
+        # A header file is self-attested evidence; --verify must refuse
+        # one that does not start at the selected chain's genesis (a
+        # forged trivial-difficulty file would otherwise "verify").
+        hdrs = str(tmp_path / "h.bin")
+        out = _run(
+            "replay", "--n", "8", "--difficulty", "8", "--method", "host",
+            "--out", hdrs,
+        )
+        assert out["valid"]
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "replay",
+                "--verify", hdrs, "--difficulty", "9", "--method", "host",
+            ],
+            capture_output=True, text=True, timeout=110, cwd="/root/repo",
+        )
+        assert proc.returncode == 2
+        assert "genesis" in proc.stderr
+
+    def test_node_bad_retarget_pair_fails_cleanly(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "node",
+                "--retarget-window", "144", "--port", "0",
+            ],
+            capture_output=True, text=True, timeout=110, cwd="/root/repo",
+        )
+        assert proc.returncode != 0
+        assert "set together" in proc.stderr
+        assert "Traceback" not in proc.stderr
+
     def test_unknown_backend_fails_cleanly(self):
         proc = subprocess.run(
             [sys.executable, "-m", "p1_tpu", "mine", "--backend", "nope"],

@@ -1,0 +1,365 @@
+"""Difficulty retargeting: the rule math, genesis commitment, contextual
+chain enforcement, persistence, and a live retargeting node.
+
+Fixed difficulty (retarget=None) is the default everywhere and its
+behavior is pinned by the rest of the suite; these tests cover the opt-in
+rule — including that a chain with a different rule is a *different
+chain* (distinct genesis), which is what keeps mixed networks impossible
+rather than merely erroring late.
+"""
+
+import asyncio
+
+import pytest
+
+from p1_tpu.chain import AddStatus, Chain, ChainStore
+from p1_tpu.core import (
+    Block,
+    BlockHeader,
+    RetargetRule,
+    Transaction,
+    make_genesis,
+    merkle_root,
+)
+from p1_tpu.hashx import get_backend
+from p1_tpu.miner import Miner
+
+DIFF = 8
+RULE = RetargetRule(window=4, spacing=100)
+_MINER = Miner(backend=get_backend("cpu"))
+
+
+def _child(parent: Block, difficulty: int, ts: int, txs=()) -> Block:
+    header = BlockHeader(
+        version=1,
+        prev_hash=parent.block_hash(),
+        merkle_root=merkle_root([tx.txid() for tx in txs]),
+        timestamp=ts,
+        difficulty=difficulty,
+        nonce=0,
+    )
+    sealed = _MINER.search_nonce(header)
+    assert sealed is not None
+    return Block(sealed, tuple(txs))
+
+
+def _extend(chain: Chain, n: int, dt: int) -> None:
+    """Mine ``n`` blocks on the tip with ``dt`` seconds between blocks,
+    always at the difficulty consensus asks for."""
+    for _ in range(n):
+        tip = chain.tip
+        block = _child(
+            tip, chain.next_difficulty(), tip.header.timestamp + dt
+        )
+        res = chain.add_block(block)
+        assert res.status is AddStatus.ACCEPTED, res.reason
+
+
+class TestRuleMath:
+    def test_in_band_span_keeps_difficulty(self):
+        # expected span = 100 * 3 = 300
+        assert RULE.adjusted(10, 300) == 10
+        assert RULE.adjusted(10, 151) == 10  # just above half
+        assert RULE.adjusted(10, 599) == 10  # just below double
+
+    def test_fast_blocks_raise_difficulty_bitwise(self):
+        assert RULE.adjusted(10, 150) == 11  # span <= expected/2
+        assert RULE.adjusted(10, 75) == 12  # span <= expected/4
+        assert RULE.adjusted(10, 1) == 12  # clamped at max_adjust=2
+
+    def test_slow_blocks_lower_difficulty_bitwise(self):
+        assert RULE.adjusted(10, 600) == 9  # span >= 2x
+        assert RULE.adjusted(10, 1200) == 8  # span >= 4x
+        assert RULE.adjusted(10, 10_000_000) == 8  # clamped
+
+    def test_range_clamps(self):
+        assert RULE.adjusted(1, 10_000_000) == 1  # never below 1
+        assert RULE.adjusted(255, 1) == 255  # never above 255
+        assert RULE.adjusted(2, 10_000_000) == 1
+
+    def test_parameter_validation(self):
+        with pytest.raises(ValueError):
+            RetargetRule(window=1, spacing=10)
+        with pytest.raises(ValueError):
+            RetargetRule(window=4, spacing=0)
+        with pytest.raises(ValueError):
+            RetargetRule(window=4, spacing=10, max_adjust=0)
+
+
+class TestGenesisCommitment:
+    def test_rule_changes_chain_identity(self):
+        plain = make_genesis(DIFF)
+        ruled = make_genesis(DIFF, RULE)
+        other = make_genesis(DIFF, RetargetRule(window=8, spacing=100))
+        assert plain.block_hash() != ruled.block_hash()
+        assert ruled.block_hash() != other.block_hash()
+        # Same parameters -> same chain, deterministically.
+        assert ruled.block_hash() == make_genesis(DIFF, RULE).block_hash()
+
+    def test_fixed_difficulty_genesis_unchanged(self):
+        # retarget=None must keep every existing chain id stable.
+        from p1_tpu.core.block import EMPTY_MERKLE_ROOT
+
+        assert make_genesis(DIFF).header.merkle_root == EMPTY_MERKLE_ROOT
+
+
+class TestChainEnforcement:
+    def test_difficulty_steps_up_at_boundary(self):
+        chain = Chain(DIFF, retarget=RULE)
+        # Blocks 1..3 at base difficulty; block 4 opens a window.  One
+        # second between blocks => span 3 vs expected 300 => +2 bits.
+        _extend(chain, 3, dt=1)
+        assert chain.next_difficulty() == DIFF + 2
+        _extend(chain, 1, dt=1)
+        assert chain.tip.header.difficulty == DIFF + 2
+        # Mid-window: difficulty sticks to the parent's.
+        assert chain.next_difficulty() == DIFF + 2
+
+    def test_difficulty_steps_down_when_slow(self):
+        chain = Chain(DIFF + 2, retarget=RULE)
+        _extend(chain, 3, dt=1000)  # span 3000 >= 4x expected
+        assert chain.next_difficulty() == DIFF
+
+    def test_wrong_difficulty_rejected_contextually(self):
+        chain = Chain(DIFF, retarget=RULE)
+        _extend(chain, 3, dt=1)
+        # Height 4 must carry DIFF+2; a miner claiming DIFF is rejected
+        # even though DIFF is the chain's base difficulty.
+        tip = chain.tip
+        lazy = _child(tip, DIFF, tip.header.timestamp + 1)
+        res = chain.add_block(lazy)
+        assert res.status is AddStatus.REJECTED
+        assert "required" in res.reason
+
+    def test_non_monotonic_timestamp_rejected(self):
+        chain = Chain(DIFF, retarget=RULE)
+        _extend(chain, 1, dt=5)
+        tip = chain.tip
+        stale = _child(tip, chain.next_difficulty(), tip.header.timestamp)
+        res = chain.add_block(stale)
+        assert res.status is AddStatus.REJECTED
+        assert "timestamp" in res.reason
+        # Fixed-difficulty chains keep their historical tolerance.
+        fixed = Chain(DIFF)
+        b = _child(fixed.tip, DIFF, fixed.tip.header.timestamp)
+        assert fixed.add_block(b).status is AddStatus.ACCEPTED
+
+    def test_work_weighted_fork_choice_across_difficulties(self):
+        # After a retarget to DIFF+2, one new-window block (4x work)
+        # outweighs two more blocks mined on a stale pre-boundary parent.
+        chain = Chain(DIFF, retarget=RULE)
+        _extend(chain, 3, dt=1)
+        fork_parent = chain.tip  # height 3
+        heavy = _child(
+            fork_parent, chain.next_difficulty(), fork_parent.header.timestamp + 1
+        )
+        # Stale branch: same parent, still mid-window difficulties...
+        # there is no such thing — height 4 REQUIRES DIFF+2 on every
+        # branch (pure function of ancestors).  So build the competing
+        # branch from height 2 instead: its height-3 block is mid-window.
+        h2 = chain.get(fork_parent.header.prev_hash)
+        side3 = _child(h2, DIFF, h2.header.timestamp + 2)
+        assert chain.add_block(side3).status is AddStatus.ACCEPTED
+        side4 = _child(side3, DIFF + 2, side3.header.timestamp + 1)
+        assert chain.add_block(side4).status is AddStatus.ACCEPTED
+        res = chain.add_block(heavy)
+        assert res.status is AddStatus.ACCEPTED
+        # heavy (via fork_parent) and side4 tie on work; the hash
+        # tie-break decides — what matters is that BOTH height-4 blocks
+        # were forced to DIFF+2 and the index weighed them equally.
+        assert chain.height == 4
+        assert chain.tip.header.difficulty == DIFF + 2
+
+    def test_difficulty_zero_orphan_rejected(self):
+        # On a retargeting chain orphan parking checks PoW at the CLAIMED
+        # difficulty; difficulty 0 passes that check vacuously, so it must
+        # be refused outright — a free frame must not churn the pool.
+        chain = Chain(DIFF, retarget=RULE)
+        free = _child(
+            make_genesis(99), 0, 1_800_000_000
+        )  # unknown parent, d=0
+        res = chain.add_block(free)
+        assert res.status is AddStatus.REJECTED
+        assert "no work" in res.reason
+
+    def test_replay_host_verifies_retargeting_chains(self):
+        import dataclasses
+
+        from p1_tpu.chain import generate_headers, replay_host
+
+        fast = RetargetRule(window=4, spacing=100)
+        headers = generate_headers(13, DIFF, retarget=fast)
+        # +1s spacing vs 100s target: +2 bits at heights 4, 8, 12.
+        assert headers[12].difficulty == DIFF + 6
+        assert replay_host(headers, retarget=fast).valid
+        # The fixed-difficulty check would (wrongly for this chain) fail —
+        # which is why `p1 replay` refuses non-host engines with a rule.
+        assert replay_host(headers).first_invalid == 4
+        # A header claiming the wrong difficulty is caught at its index...
+        forged = list(headers)
+        forged[9] = dataclasses.replace(headers[9], difficulty=DIFF)
+        assert replay_host(forged, retarget=fast).first_invalid == 9
+        # ...and so is a non-increasing timestamp.
+        stale = list(headers)
+        stale[9] = dataclasses.replace(
+            headers[9], timestamp=headers[8].timestamp
+        )
+        assert replay_host(stale, retarget=fast).first_invalid == 9
+
+    def test_store_round_trip_preserves_rule_chain(self):
+        import tempfile
+        from pathlib import Path
+
+        chain = Chain(DIFF, retarget=RULE)
+        _extend(chain, 6, dt=1)  # crosses one boundary
+        assert chain.tip.header.difficulty == DIFF + 2
+        with tempfile.TemporaryDirectory() as d:
+            path = Path(d) / "chain.dat"
+            store = ChainStore(path)
+            for block in list(chain.main_chain())[1:]:
+                store.append(block)
+            store.close()
+            loaded = ChainStore(path).load_chain(DIFF, retarget=RULE)
+            assert loaded.tip_hash == chain.tip_hash
+            assert loaded.next_difficulty() == chain.next_difficulty()
+            # Without the rule the records are another chain's: nothing
+            # connects, and load_chain refuses rather than silently
+            # yielding an empty chain (the guard `p1 compact` relies on —
+            # it would otherwise rewrite the store as a genesis-only
+            # snapshot of the wrong chain).
+            with pytest.raises(ValueError, match="do not connect"):
+                ChainStore(path).load_chain(DIFF)
+
+
+class TestRetargetingNode:
+    def test_live_node_climbs_difficulty_and_serves_wallet(self):
+        from test_node import _config, wait_until
+
+        from p1_tpu.node import Node
+        from p1_tpu.node.client import get_account
+
+        # ms blocks but 50 s/block target => +2 bits every 5-block window.
+        rule_kw = dict(retarget_window=5, target_spacing=50)
+
+        async def scenario():
+            node = Node(_config(difficulty=10, mine=True, **rule_kw))
+            await node.start()
+            try:
+                assert await wait_until(lambda: node.chain.height >= 12)
+                blocks = list(node.chain.main_chain())
+                # Window 1 (heights 1-4) mines at the base difficulty; its
+                # observed span includes the fixed-2025 genesis timestamp
+                # vs. wall clock — enormous — so height 5 deterministically
+                # retargets DOWN by the clamp (10 -> 8).  Exactly Bitcoin's
+                # first-retarget-after-genesis behavior.
+                assert [b.header.difficulty for b in blocks[1:6]] == [
+                    10, 10, 10, 10, 8,
+                ]
+                # Every later block carries precisely what the rule asks
+                # of its parent (re-derived from scratch here).
+                probe = Chain(10, retarget=RetargetRule(window=5, spacing=50))
+                for b in blocks[1:]:
+                    assert b.header.difficulty == probe.next_difficulty()
+                    assert probe.add_block(b).status is AddStatus.ACCEPTED
+                # The wallet path agrees on the chain identity.
+                state = await get_account(
+                    "127.0.0.1",
+                    node.port,
+                    node.miner_id,
+                    10,
+                    retarget=RetargetRule(window=5, spacing=50),
+                )
+                assert state.balance > 0
+                # ...and a fixed-difficulty client is refused outright.
+                with pytest.raises(ValueError, match="genesis mismatch"):
+                    await get_account(
+                        "127.0.0.1", node.port, node.miner_id, 10
+                    )
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_two_retargeting_nodes_converge(self):
+        from test_node import _config, stop_all, wait_until
+
+        from p1_tpu.node import Node
+
+        rule_kw = dict(retarget_window=5, target_spacing=50)
+
+        async def scenario():
+            a = Node(_config(difficulty=10, mine=True, **rule_kw))
+            await a.start()
+            b = Node(
+                _config(
+                    difficulty=10,
+                    mine=True,
+                    peers=(f"127.0.0.1:{a.port}",),
+                    **rule_kw,
+                )
+            )
+            await b.start()
+            try:
+                assert await wait_until(
+                    lambda: a.chain.height >= 11 and b.chain.height >= 11
+                )
+                for node in (a, b):
+                    await node.stop_mining()
+                await a.request_sync()
+                await b.request_sync()
+                assert await wait_until(
+                    lambda: a.chain.tip_hash == b.chain.tip_hash
+                )
+                blocks = list(a.chain.main_chain())
+                # Both nodes enforced the genesis-gap retarget at height 5
+                # (see the single-node test) while converging under it.
+                assert blocks[5].header.difficulty == 8
+            finally:
+                await stop_all((a, b))
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_spv_proof_across_a_retarget_boundary(self):
+        # A tx confirmed AFTER a difficulty move must still SPV-verify
+        # when the verifier knows the chain retargets (the work bar is
+        # the header's claimed difficulty), and must fail the strict
+        # fixed-difficulty check — never the other way around.
+        from p1_tpu.chain import SPVError, verify_tx_proof
+
+        chain = Chain(DIFF, retarget=RULE)
+        cb = None
+        for _ in range(5):
+            tip = chain.tip
+            cb = Transaction.coinbase("miner", chain.height + 1)
+            block = _child(
+                tip,
+                chain.next_difficulty(),
+                tip.header.timestamp + 1,
+                txs=(cb,),
+            )
+            assert chain.add_block(block).status is AddStatus.ACCEPTED
+        assert chain.tip.header.difficulty == DIFF + 2  # boundary crossed
+        proof = chain.tx_proof(cb.txid())
+        assert proof is not None
+        tag = chain.genesis.block_hash()
+        verify_tx_proof(proof, DIFF, tag, retarget=RULE)
+        with pytest.raises(SPVError, match="difficulty"):
+            verify_tx_proof(proof, DIFF, tag)  # fixed-chain strictness
+
+    def test_coinbase_txs_survive_retarget_boundaries(self):
+        # The ledger/conservation machinery must be unaffected by moving
+        # difficulty: coinbases at several difficulties, exact sum.
+        from p1_tpu.core.tx import BLOCK_REWARD
+
+        chain = Chain(DIFF, retarget=RULE)
+        for i in range(9):
+            tip = chain.tip
+            cb = Transaction.coinbase("miner", chain.height + 1)
+            block = _child(
+                tip,
+                chain.next_difficulty(),
+                tip.header.timestamp + 1,
+                txs=(cb,),
+            )
+            assert chain.add_block(block).status is AddStatus.ACCEPTED
+        assert sum(chain.balances_snapshot().values()) == 9 * BLOCK_REWARD
