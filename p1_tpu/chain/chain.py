@@ -194,6 +194,14 @@ class Chain:
         chain difficulty unless a ``RetargetRule`` is active."""
         return self._expected_difficulty(self._index[self._tip_hash])
 
+    def required_difficulty(self, prev_hash: bytes) -> int | None:
+        """The difficulty consensus requires of a child of ``prev_hash``,
+        or None when the parent is unknown.  Lets gossip handlers price a
+        pushed header at its EXACT contextual work bar before spending any
+        state or round trips on it (node.py's compact-block gate)."""
+        entry = self._index.get(prev_hash)
+        return None if entry is None else self._expected_difficulty(entry)
+
     def _expected_difficulty(self, prev: _Entry) -> int:
         """Required difficulty for a child of ``prev`` — a pure function
         of the ancestor chain, so every node computes the same value for

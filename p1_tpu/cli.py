@@ -148,6 +148,12 @@ def build_parser() -> argparse.ArgumentParser:
         "can cost many seconds, so parent-computed wall times are unsafe)",
     )
     p.add_argument("--status-interval", type=float, default=10.0)
+    p.add_argument(
+        "--no-compact-gossip",
+        action="store_true",
+        help="push full BLOCK frames instead of compact blocks (local "
+        "preference; compact and full nodes interoperate)",
+    )
     _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
@@ -289,6 +295,11 @@ def build_parser() -> argparse.ArgumentParser:
         "during the run (each node mines to a keyed account); the summary "
         "then audits ledger conservation (sum == reward x height) on "
         "every node",
+    )
+    p.add_argument(
+        "--no-compact-gossip",
+        action="store_true",
+        help="children push full BLOCK frames instead of compact blocks",
     )
     _add_retarget(p)
 
@@ -533,10 +544,11 @@ async def _run_node(args, miner=None) -> int:
         chunk=args.chunk,
         miner_id=args.miner_id,
         # getattr: `p1 pod` reuses this runner with its own arg namespace,
-        # which has no retarget flags (pod mining is fixed-difficulty —
-        # config 5's shape).
+        # which has no retarget or compact-gossip flags (pod mining is
+        # fixed-difficulty — config 5's shape).
         retarget_window=getattr(args, "retarget_window", 0),
         target_spacing=getattr(args, "target_spacing", 0),
+        compact_gossip=not getattr(args, "no_compact_gossip", False),
     )
     node = Node(config, miner=miner)
     await node.start()
@@ -1256,6 +1268,8 @@ def cmd_net(args) -> int:
                 "--retarget-window", str(net_rule.window),
                 "--target-spacing", str(net_rule.spacing),
             ]
+        if args.no_compact_gossip:
+            cmd += ["--no-compact-gossip"]
         peers = [f"127.0.0.1:{p}" for p in ports[:i]]
         if peers:
             cmd += ["--peers", *peers]
@@ -1306,6 +1320,16 @@ def cmd_net(args) -> int:
         "height": max(s["height"] for s in statuses),
         "blocks_mined_total": sum(s["blocks_mined"] for s in statuses),
         "reorgs_total": sum(s["reorgs"] for s in statuses),
+        # Gossip bandwidth elided by compact block relay, net-wide.
+        "compact_bytes_saved_total": sum(
+            s["compact"]["bytes_saved"] for s in statuses
+        ),
+        "compact_tx_hit_total": sum(
+            s["compact"]["tx_hits"] for s in statuses
+        ),
+        "compact_tx_fetched_total": sum(
+            s["compact"]["tx_fetched"] for s in statuses
+        ),
         # Network-level propagation delay (gossip send -> accept), the
         # worst node's view: median of per-node medians would hide a slow
         # peer, so report the max median and the max p95 across nodes.

@@ -30,6 +30,11 @@ class NodeConfig:
     #: the pair is part of chain identity (committed into genesis).
     retarget_window: int = 0
     target_spacing: int = 0
+    #: Gossip blocks carrying transactions as compact blocks (header +
+    #: txids, ~32 B/tx) instead of full serializations; receivers
+    #: reconstruct from their mempool and fetch only what they lack.
+    #: Local preference, not a chain parameter — mixed nets interoperate.
+    compact_gossip: bool = True
 
     def retarget_rule(self):
         """The chain's ``RetargetRule``, or None for fixed difficulty."""

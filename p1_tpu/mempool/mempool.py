@@ -105,6 +105,12 @@ class Mempool:
     def __contains__(self, txid: bytes) -> bool:
         return txid in self._txs
 
+    def get(self, txid: bytes) -> Transaction | None:
+        """The pending transaction with this txid, if any — compact-block
+        reconstruction's lookup (txid = SHA-256d of the exact wire bytes,
+        so a hit IS the block's transaction)."""
+        return self._txs.get(txid)
+
     def add(self, tx: Transaction) -> bool:
         """Admit ``tx``; False if coinbase, already known, outbid, or full.
 

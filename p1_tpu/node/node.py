@@ -38,6 +38,11 @@ from p1_tpu.node.protocol import Hello, MsgType
 log = logging.getLogger("p1_tpu.node")
 
 SYNC_BATCH = 500
+#: Pending compact-block reconstructions awaiting a BLOCKTXN reply.  Small
+#: and FIFO-capped: entries exist only for the one GETBLOCKTXN round trip;
+#: anything stranded (peer died mid-answer) is evicted by newer blocks and
+#: the chain heals through ordinary locator sync.
+MAX_PENDING_CBLOCKS = 64
 #: Connected-peer cap: the last unbounded per-peer resource (sessions +
 #: writer buffers).  Gossip needs a handful of peers; a dialer flood past
 #: the cap is refused at handshake time.
@@ -64,6 +69,14 @@ class NodeMetrics:
     hashes_done: int = 0
     mine_elapsed_s: float = 0.0
     last_block_time_s: float = 0.0
+    #: Compact block relay (BIP152-style): pushes sent/received compactly,
+    #: mempool reconstruction hits vs. transactions that needed a
+    #: GETBLOCKTXN round trip, and gossip bytes elided vs. full BLOCKs.
+    cblocks_sent: int = 0
+    cblocks_received: int = 0
+    cblock_tx_hits: int = 0
+    cblock_tx_fetched: int = 0
+    cblock_bytes_saved: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -85,6 +98,16 @@ class NodeMetrics:
             "p95_ms": round(1e3 * delays[min(len(delays) - 1, int(0.95 * len(delays)))], 3),
             "samples": len(delays),
         }
+
+
+@dataclasses.dataclass
+class _PendingCompact:
+    """A compact block whose missing transactions are in flight."""
+
+    header: "BlockHeader"
+    txs: list  # block-order slots; None where a tx is still missing
+    want: dict  # index -> advertised txid (what GETBLOCKTXN asked for)
+    sent_ts: float  # original sender's timestamp (propagation telemetry)
 
 
 class _Peer:
@@ -150,6 +173,15 @@ class Node:
                 backend=get_backend(config.backend, **kwargs), chunk=config.chunk
             )
         self._peers: dict[asyncio.StreamWriter, _Peer] = {}
+        #: (block hash, announcing peer) -> partially reconstructed compact
+        #: block (see ``_handle_cblock``); FIFO-capped.  Keyed per PEER so
+        #: a front-runner pushing a tampered txid list for a real block
+        #: cannot squat the hash — an honest peer's announcement of the
+        #: same block reconstructs independently — and so a BLOCKTXN reply
+        #: only ever resolves the request sent to that same peer.
+        self._pending_cblocks: collections.OrderedDict[
+            tuple[bytes, _Peer], _PendingCompact
+        ] = collections.OrderedDict()
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
@@ -462,6 +494,22 @@ class Node:
                     )
                 ),
             )
+        elif mtype is MsgType.CBLOCK:
+            await self._handle_cblock(body, peer)
+        elif mtype is MsgType.GETBLOCKTXN:
+            bhash, indices = body
+            block = self.chain.get(bhash)
+            if block is not None and indices[-1] < len(block.txs):
+                await self._send_guarded(
+                    peer,
+                    protocol.encode_blocktxn(
+                        bhash, [block.txs[i].serialize() for i in indices]
+                    ),
+                )
+            # Unknown block / out-of-range indices: ignore — the requester
+            # falls back to locator sync, and answering garbage helps no one.
+        elif mtype is MsgType.BLOCKTXN:
+            await self._handle_blocktxn(body, peer)
         elif mtype is MsgType.GETPROOF:
             # SPV query: serve the inclusion proof (or not-found) from the
             # chain's txid index; the client verifies it, we just attest
@@ -504,6 +552,107 @@ class Node:
 
     # -- chain/mempool handlers -----------------------------------------
 
+    def _block_gossip_payload(self, block: Block) -> bytes:
+        """Choose the push encoding: compact when there are transactions
+        worth eliding (the receiver's mempool should hold them), full
+        BLOCK otherwise (an empty/coinbase-only block has nothing to
+        elide, and the full form needs no round trip ever)."""
+        full = protocol.encode_block(block)
+        if self.config.compact_gossip and len(block.txs) > 1:
+            compact = protocol.encode_cblock(block)
+            self.metrics.cblocks_sent += 1
+            self.metrics.cblock_bytes_saved += len(full) - len(compact)
+            return compact
+        return full
+
+    async def _handle_cblock(
+        self, cb: protocol.CompactBlock, peer: _Peer
+    ) -> None:
+        """Reconstruct a compact block from the mempool; fetch the rest.
+
+        Order of operations is the DoS story: the header must carry proof
+        of work at the EXACT difficulty consensus requires of its parent
+        (``Chain.required_difficulty`` — contextual, so this holds on
+        retargeting chains too) before any state is touched or any request
+        sent; parking a pending reconstruction or triggering a GETBLOCKTXN
+        round trip therefore costs a real block's worth of work.  A
+        compact push whose parent we don't know can't be priced — it falls
+        straight to locator sync, which an out-of-order arrival needs
+        anyway.  Txids are full SHA-256d hashes, so mempool hits are
+        byte-exact by construction and full consensus validation still
+        runs in ``_handle_block``.
+        """
+        from p1_tpu.core.header import meets_target
+
+        header = cb.header
+        bhash = header.block_hash()
+        if bhash in self.chain or (bhash, peer) in self._pending_cblocks:
+            return  # duplicate push
+        expected = self.chain.required_difficulty(header.prev_hash)
+        if expected is None:
+            await self._send_guarded(
+                peer, protocol.encode_getblocks(self.chain.locator())
+            )
+            return
+        if header.difficulty != expected or not meets_target(
+            bhash, header.difficulty
+        ):
+            self.metrics.blocks_rejected += 1
+            log.warning("rejected compact block from %s: bad work", peer.label)
+            return
+        self.metrics.cblocks_received += 1
+        txs: list = [None] * cb.ntx
+        for i, tx in cb.prefilled:
+            txs[i] = tx
+        rest = [i for i in range(cb.ntx) if txs[i] is None]
+        want: dict[int, bytes] = {}
+        for i, txid in zip(rest, cb.txids):
+            tx = self.mempool.get(txid)
+            if tx is not None:
+                txs[i] = tx
+                self.metrics.cblock_tx_hits += 1
+            else:
+                want[i] = txid
+        if not want:
+            await self._handle_block(
+                Block(header, tuple(txs)), origin=peer, sent_ts=cb.sent_ts
+            )
+            return
+        self._pending_cblocks[(bhash, peer)] = _PendingCompact(
+            header, txs, want, cb.sent_ts
+        )
+        while len(self._pending_cblocks) > MAX_PENDING_CBLOCKS:
+            self._pending_cblocks.popitem(last=False)
+        await self._send_guarded(
+            peer, protocol.encode_getblocktxn(bhash, sorted(want))
+        )
+
+    async def _handle_blocktxn(self, body, peer: _Peer) -> None:
+        bhash, txs = body
+        # Keyed by (hash, peer): an unsolicited BLOCKTXN from a peer we
+        # never asked resolves nothing and cannot destroy a reconstruction
+        # in flight with the peer we DID ask.
+        pending = self._pending_cblocks.pop((bhash, peer), None)
+        if pending is None:
+            return  # answered twice / evicted meanwhile / never asked
+        indices = sorted(pending.want)
+        if len(txs) != len(indices):
+            log.warning("BLOCKTXN wrong count from %s", peer.label)
+            return
+        for i, tx in zip(indices, txs):
+            if tx.txid() != pending.want[i]:
+                # The reply does not match the advertised block — drop the
+                # reconstruction; the chain heals via sync if it was real.
+                log.warning("BLOCKTXN txid mismatch from %s", peer.label)
+                return
+            pending.txs[i] = tx
+        self.metrics.cblock_tx_fetched += len(indices)
+        await self._handle_block(
+            Block(pending.header, tuple(pending.txs)),
+            origin=peer,
+            sent_ts=pending.sent_ts,
+        )
+
     async def _handle_block(
         self,
         block: Block,
@@ -540,7 +689,9 @@ class Node:
                     origin.label if origin else "local",
                 )
             if gossip:
-                await self._gossip(protocol.encode_block(block), skip=origin)
+                await self._gossip(
+                    self._block_gossip_payload(block), skip=origin
+                )
         elif res.status is AddStatus.ORPHAN and origin is not None:
             await self._send_guarded(
                 origin, protocol.encode_getblocks(self.chain.locator())
@@ -669,6 +820,14 @@ class Node:
             "reorgs": self.metrics.reorgs,
             "txs_accepted": self.metrics.txs_accepted,
             "propagation": self.metrics.propagation_summary(),
+            # Compact block relay effectiveness (BIP152-style gossip).
+            "compact": {
+                "sent": self.metrics.cblocks_sent,
+                "received": self.metrics.cblocks_received,
+                "tx_hits": self.metrics.cblock_tx_hits,
+                "tx_fetched": self.metrics.cblock_tx_fetched,
+                "bytes_saved": self.metrics.cblock_bytes_saved,
+            },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly
             # BLOCK_REWARD x height — any double-spend or bad reorg undo

@@ -44,6 +44,19 @@ Messages:
              verifies PoW + merkle branch + tx validity itself
              (p1_tpu/chain/proof.py) — the reply is evidence, not an
              assertion to trust.
+- CBLOCK:    compact block push (BIP152's idea, full-txid form): f64 send
+             timestamp + 80-byte header + u16 ntx + u16 n_prefilled +
+             n_prefilled * (u16 index + u32 len + raw tx) + one 32-byte
+             txid per remaining transaction, in block order.  The sender
+             prefills what receivers cannot have (the coinbase); the
+             receiver reconstructs the rest from its mempool — txids are
+             full SHA-256d hashes of the exact wire bytes, so a match IS
+             the transaction (no BIP152 short-id collision handling
+             needed) — and fetches whatever it lacks with GETBLOCKTXN.
+- GETBLOCKTXN: 32-byte block hash + u16 count + count * u16 ascending tx
+             indices the requester could not reconstruct.
+- BLOCKTXN:  32-byte block hash + u16 count + count * (u32 len + raw tx)
+             answering a GETBLOCKTXN, same index order as requested.
 """
 
 from __future__ import annotations
@@ -68,8 +81,9 @@ _LEN = struct.Struct(">I")
 #: handshake with a clear error instead of dying mid-session the first
 #: time the newer side queries a message the older one calls a protocol
 #: violation.  Round 3 spoke an unversioned HELLO; its frames fail here as
-#: "bad HELLO size".
-PROTOCOL_VERSION = 3
+#: "bad HELLO size".  v4 added compact block relay (CBLOCK/GETBLOCKTXN/
+#: BLOCKTXN).
+PROTOCOL_VERSION = 4
 _HELLO = struct.Struct(">B32sIH")
 
 
@@ -85,6 +99,9 @@ class MsgType(enum.IntEnum):
     ACCOUNT = 9
     GETPROOF = 10
     PROOF = 11
+    CBLOCK = 12
+    GETBLOCKTXN = 13
+    BLOCKTXN = 14
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +111,18 @@ class AccountState:
     nonce: int  # confirmed transfers at the tip (consensus nonce)
     next_seq: int  # nonce + the peer's own pending spends (what to sign next)
     tip_height: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactBlock:
+    """Decoded CBLOCK: everything needed to reconstruct the block from a
+    mempool — or to know exactly which transactions to fetch."""
+
+    sent_ts: float
+    header: BlockHeader
+    ntx: int
+    prefilled: tuple[tuple[int, Transaction], ...]  # (index, tx) ascending
+    txids: tuple[bytes, ...]  # remaining transactions, block order
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,6 +185,66 @@ def encode_account(state: AccountState) -> bytes:
             ">QQQI", state.balance, state.nonce, state.next_seq, state.tip_height
         )
     )
+
+
+def encode_cblock(block: Block, sent_ts: float | None = None) -> bytes:
+    """Compact form of ``block``: prefill the coinbase (receivers cannot
+    have it — it is minted by this block), elide everything else to its
+    txid.  ~32 bytes per transaction on the wire instead of the full
+    serialization."""
+    import time
+
+    ts = time.time() if sent_ts is None else sent_ts
+    prefilled = []
+    txids = []
+    for i, tx in enumerate(block.txs):
+        if i == 0 and tx.is_coinbase:
+            prefilled.append((i, tx))
+        else:
+            txids.append(tx.txid())
+    parts = [
+        bytes([MsgType.CBLOCK]),
+        struct.pack(">d", ts),
+        block.header.serialize(),
+        struct.pack(">HH", len(block.txs), len(prefilled)),
+    ]
+    for i, tx in prefilled:
+        raw = tx.serialize()
+        parts.append(struct.pack(">HI", i, len(raw)))
+        parts.append(raw)
+    parts.extend(txids)
+    return b"".join(parts)
+
+
+def encode_getblocktxn(block_hash: bytes, indices: list[int]) -> bytes:
+    if len(block_hash) != 32:
+        raise ValueError("block hash must be 32 bytes")
+    if not indices or len(indices) > 0xFFFF:
+        raise ValueError("need 1..65535 indices")
+    return (
+        bytes([MsgType.GETBLOCKTXN])
+        + block_hash
+        + struct.pack(">H", len(indices))
+        + struct.pack(f">{len(indices)}H", *indices)
+    )
+
+
+def encode_blocktxn(block_hash: bytes, raw_txs: list[bytes]) -> bytes:
+    """``raw_txs`` are pre-serialized transactions in the requested index
+    order."""
+    if len(block_hash) != 32:
+        raise ValueError("block hash must be 32 bytes")
+    if len(raw_txs) > 0xFFFF:
+        raise ValueError("too many transactions for one BLOCKTXN")
+    parts = [
+        bytes([MsgType.BLOCKTXN]),
+        block_hash,
+        struct.pack(">H", len(raw_txs)),
+    ]
+    for raw in raw_txs:
+        parts.append(struct.pack(">I", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
 
 
 def encode_getproof(txid: bytes) -> bytes:
@@ -272,6 +361,72 @@ def decode(payload: bytes):
             ">QQQI", body[1 + alen :]
         )
         return mtype, AccountState(account, balance, nonce, next_seq, height)
+    if mtype is MsgType.CBLOCK:
+        if len(body) < 8 + HEADER_SIZE + 4:
+            raise ValueError("bad CBLOCK")
+        (sent_ts,) = struct.unpack_from(">d", body)
+        off = 8
+        header = BlockHeader.deserialize(body[off : off + HEADER_SIZE])
+        off += HEADER_SIZE
+        ntx, n_prefilled = struct.unpack_from(">HH", body, off)
+        off += 4
+        if n_prefilled > ntx:
+            raise ValueError("bad CBLOCK prefill count")
+        prefilled = []
+        last_index = -1
+        for _ in range(n_prefilled):
+            if len(body) < off + 6:
+                raise ValueError("truncated CBLOCK prefill")
+            index, tlen = struct.unpack_from(">HI", body, off)
+            off += 6
+            if index <= last_index or index >= ntx:
+                raise ValueError("bad CBLOCK prefill index")
+            last_index = index
+            if len(body) < off + tlen:
+                raise ValueError("truncated CBLOCK prefill tx")
+            prefilled.append(
+                (index, Transaction.deserialize(body[off : off + tlen]))
+            )
+            off += tlen
+        n_ids = ntx - n_prefilled
+        if len(body) != off + 32 * n_ids:
+            raise ValueError("bad CBLOCK txid section")
+        txids = tuple(
+            body[off + 32 * i : off + 32 * (i + 1)] for i in range(n_ids)
+        )
+        return mtype, CompactBlock(
+            sent_ts, header, ntx, tuple(prefilled), txids
+        )
+    if mtype is MsgType.GETBLOCKTXN:
+        if len(body) < 34:
+            raise ValueError("bad GETBLOCKTXN")
+        bhash = body[:32]
+        (n,) = struct.unpack_from(">H", body, 32)
+        if n == 0 or len(body) != 34 + 2 * n:
+            raise ValueError("bad GETBLOCKTXN size")
+        indices = list(struct.unpack_from(f">{n}H", body, 34))
+        if any(b <= a for a, b in zip(indices, indices[1:])):
+            raise ValueError("GETBLOCKTXN indices must ascend")
+        return mtype, (bhash, indices)
+    if mtype is MsgType.BLOCKTXN:
+        if len(body) < 34:
+            raise ValueError("bad BLOCKTXN")
+        bhash = body[:32]
+        (n,) = struct.unpack_from(">H", body, 32)
+        off = 34
+        txs = []
+        for _ in range(n):
+            if len(body) < off + 4:
+                raise ValueError("truncated BLOCKTXN")
+            (tlen,) = struct.unpack_from(">I", body, off)
+            off += 4
+            if len(body) < off + tlen:
+                raise ValueError("truncated BLOCKTXN entry")
+            txs.append(Transaction.deserialize(body[off : off + tlen]))
+            off += tlen
+        if off != len(body):
+            raise ValueError("trailing bytes in BLOCKTXN")
+        return mtype, (bhash, txs)
     if mtype is MsgType.GETPROOF:
         if len(body) != 32:
             raise ValueError("bad GETPROOF")

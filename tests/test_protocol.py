@@ -141,6 +141,12 @@ class TestMalformed:
                 protocol.AccountState("p1deadbeefdeadbeef", 50, 1, 2, 7)
             ),
             protocol.encode_getproof(b"\x04" * 32),
+            protocol.encode_cblock(_block(3)),
+            protocol.encode_getblocktxn(b"\x07" * 32, [1, 2, 5]),
+            protocol.encode_blocktxn(
+                b"\x08" * 32,
+                [Transaction("a", "b", 1, f, f).serialize() for f in range(2)],
+            ),
             protocol.encode_proof(None),
             protocol.encode_proof(
                 TxProof(
