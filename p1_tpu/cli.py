@@ -204,6 +204,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--txid", required=True, help="hex txid (printed by `p1 tx`)"
     )
+    p.add_argument(
+        "--headers",
+        default=None,
+        metavar="FILE",
+        help="anchor the proof against a locally verified header chain "
+        "(from `p1 headers --out FILE`) instead of trusting the peer's "
+        "tip claim — full light-client confirmation",
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
+        "headers",
+        help="light client: fetch + locally verify a node's header chain",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--out", default=None, help="write the verified headers here "
+        "(80 bytes each; feeds `p1 replay --verify` and `p1 proof --headers`)"
+    )
     _add_retarget(p)
 
     p = sub.add_parser(
@@ -761,6 +782,26 @@ def cmd_proof(args) -> int:
     except SPVError as e:
         print(f"peer served an INVALID proof: {e}", file=sys.stderr)
         return 4
+    confirmations = proof.confirmations  # the peer's claim...
+    anchored = False
+    if args.headers:
+        # ...unless anchored: the proof's block must sit at its claimed
+        # height on a LOCALLY verified header chain, and confirmations are
+        # then computed from that chain — no peer claims left anywhere.
+        headers = _load_header_file(args.headers, args.difficulty, rule)
+        if (
+            proof.height >= len(headers)
+            or headers[proof.height].block_hash()
+            != proof.header.block_hash()
+        ):
+            print(
+                "proof's block is not on the locally verified header "
+                "chain (stale, side-branch, or forged)",
+                file=sys.stderr,
+            )
+            return 4
+        confirmations = len(headers) - proof.height
+        anchored = True
     print(
         json.dumps(
             {
@@ -769,7 +810,8 @@ def cmd_proof(args) -> int:
                 "verified": True,
                 "txid": args.txid,
                 "height": proof.height,
-                "confirmations": proof.confirmations,
+                "confirmations": confirmations,
+                "anchored": anchored,
                 "block": proof.header.block_hash().hex(),
                 # The work bar this evidence meets (== chain difficulty on
                 # fixed chains; the header's claim on retargeting chains).
@@ -782,6 +824,89 @@ def cmd_proof(args) -> int:
         )
     )
     return 0
+
+
+# -- headers -------------------------------------------------------------
+
+
+def _load_header_file(path: str, difficulty: int, rule):
+    """Read + fully verify a header file as this chain's header chain.
+    Returns the genesis-first header list; raises SystemExit on any
+    failure (wrong chain, bad PoW/linkage/schedule) — a light client must
+    never proceed on unverified headers."""
+    from p1_tpu.chain import replay_host
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.core.header import HEADER_SIZE, BlockHeader
+
+    raw = open(path, "rb").read()
+    if not raw or len(raw) % HEADER_SIZE:
+        print(f"{path}: not a header file", file=sys.stderr)
+        raise SystemExit(2)
+    headers = [
+        BlockHeader.deserialize(raw[i : i + HEADER_SIZE])
+        for i in range(0, len(raw), HEADER_SIZE)
+    ]
+    if headers[0].block_hash() != make_genesis(difficulty, rule).block_hash():
+        print(
+            f"{path}: does not start at this chain's genesis "
+            "(check --difficulty / retarget flags)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    report = replay_host(headers, retarget=rule)
+    if not report.valid:
+        print(
+            f"{path}: header chain INVALID at index {report.first_invalid}",
+            file=sys.stderr,
+        )
+        raise SystemExit(4)
+    return headers
+
+
+def cmd_headers(args) -> int:
+    """Light-client sync: fetch the peer's header chain (~80 B/block) and
+    verify it locally — PoW, linkage, and (with the retarget flags) the
+    full contextual difficulty schedule.  Trusts nothing but work."""
+    from p1_tpu.chain import replay_host
+    from p1_tpu.node.client import get_headers
+
+    rule = _retarget_rule(args)
+    try:
+        headers = asyncio.run(
+            get_headers(
+                args.host, args.port, args.difficulty, retarget=rule
+            )
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"header sync failed: {e}", file=sys.stderr)
+        return 1
+    report = replay_host(headers, retarget=rule)
+    if report.valid and args.out:
+        with open(args.out, "wb") as fh:
+            for h in headers:
+                fh.write(h.serialize())
+    print(
+        json.dumps(
+            {
+                "config": "headers",
+                "height": len(headers) - 1,
+                "tip": headers[-1].block_hash().hex(),
+                "tip_difficulty": headers[-1].difficulty,
+                "valid": report.valid,
+                "first_invalid": report.first_invalid,
+                "verify_headers_per_sec": round(report.headers_per_sec),
+                "out": args.out if report.valid else None,
+            }
+        )
+    )
+    # A peer serving an invalid chain is loud (4), like a lying proof.
+    return 0 if report.valid else 4
 
 
 # -- keygen --------------------------------------------------------------
@@ -1398,6 +1523,7 @@ def main(argv=None) -> int:
         "keygen": cmd_keygen,
         "account": cmd_account,
         "proof": cmd_proof,
+        "headers": cmd_headers,
         "balances": cmd_balances,
         "compact": cmd_compact,
         "pod": cmd_pod,

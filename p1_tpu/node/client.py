@@ -104,6 +104,91 @@ async def get_proof(
     return await asyncio.wait_for(_run(), timeout)
 
 
+def _locator_from(hashes: list[bytes]) -> list[bytes]:
+    """Tip-first locator over a genesis-first hash list — the same dense-
+    then-exponential shape ``Chain.locator`` serves (one copy per side:
+    the chain's is height-indexed, this one walks a plain list)."""
+    out = []
+    height = len(hashes) - 1
+    step = 1
+    while True:
+        out.append(hashes[height])
+        if height == 0:
+            return out
+        if len(out) >= 10:
+            step *= 2
+        height = max(0, height - step)
+
+
+async def get_headers(
+    host: str,
+    port: int,
+    difficulty: int,
+    timeout: float = 60.0,
+    retarget=None,
+    max_headers: int = 1_000_000,
+):
+    """Headers-first light-client sync: the node's full main-chain header
+    list, genesis-first, ~80 B per block.  Fetches until a reply adds
+    nothing new; the CALLER must then verify the chain itself with
+    ``p1_tpu.chain.replay_host`` (PoW, linkage, difficulty schedule) —
+    this function moves bytes, it does not bless them.  ``max_headers``
+    bounds memory against a responder that streams garbage forever."""
+
+    async def _run():
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+            genesis = make_genesis(difficulty, retarget)
+            headers = [genesis.header]
+            hashes = [genesis.block_hash()]
+            pos = {hashes[0]: 0}
+            while True:
+                await protocol.write_frame(
+                    writer, protocol.encode_getheaders(_locator_from(hashes))
+                )
+                while True:
+                    mtype, body = protocol.decode(
+                        await protocol.read_frame(reader)
+                    )
+                    if mtype is MsgType.HEADERS:
+                        break
+                new = [h for h in body if h.block_hash() not in pos]
+                if not new:
+                    return headers
+                # A live peer can reorg between batches: the next reply
+                # then restarts below our tip.  Each batch must link to a
+                # header we hold — truncate back to that link point (the
+                # stale branch tail is no longer the peer's main chain)
+                # and extend contiguously; anything that links nowhere is
+                # a protocol violation, not something to append and let
+                # verification blame on an honest peer later.
+                at = pos.get(new[0].prev_hash)
+                if at is None:
+                    raise ValueError(
+                        "HEADERS reply does not link to the known chain"
+                    )
+                if at != len(headers) - 1:
+                    for h in hashes[at + 1 :]:
+                        del pos[h]
+                    del headers[at + 1 :]
+                    del hashes[at + 1 :]
+                for h in new:
+                    if h.prev_hash != hashes[-1]:
+                        raise ValueError("HEADERS batch is not contiguous")
+                    headers.append(h)
+                    hashes.append(h.block_hash())
+                    pos[hashes[-1]] = len(hashes) - 1
+                if len(headers) > max_headers:
+                    raise ValueError(
+                        f"peer served more than {max_headers} headers"
+                    )
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def get_account(
     host: str,
     port: int,

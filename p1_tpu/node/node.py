@@ -38,6 +38,8 @@ from p1_tpu.node.protocol import Hello, MsgType
 log = logging.getLogger("p1_tpu.node")
 
 SYNC_BATCH = 500
+#: Headers per GETHEADERS reply (80 B each — 2000 is a 160 KB frame).
+HEADERS_BATCH = 2000
 #: Pending compact-block reconstructions awaiting a BLOCKTXN reply.  Small
 #: and FIFO-capped: entries exist only for the one GETBLOCKTXN round trip;
 #: anything stranded (peer died mid-answer) is evicted by newer blocks and
@@ -510,6 +512,15 @@ class Node:
             # falls back to locator sync, and answering garbage helps no one.
         elif mtype is MsgType.BLOCKTXN:
             await self._handle_blocktxn(body, peer)
+        elif mtype is MsgType.GETHEADERS:
+            # Headers-first sync for light clients: same locator
+            # semantics as GETBLOCKS, 80 B/block on the wire.
+            blocks = self.chain.blocks_after(body, limit=HEADERS_BATCH)
+            await self._send_guarded(
+                peer, protocol.encode_headers([b.header for b in blocks])
+            )
+        elif mtype is MsgType.HEADERS:
+            pass  # reply frame: meaningful to light clients only
         elif mtype is MsgType.GETPROOF:
             # SPV query: serve the inclusion proof (or not-found) from the
             # chain's txid index; the client verifies it, we just attest
@@ -541,29 +552,32 @@ class Node:
         except (ConnectionError, OSError, asyncio.TimeoutError):
             peer.writer.close()  # reader loop will reap it
 
-    async def _gossip(self, payload: bytes, skip: _Peer | None = None) -> None:
+    async def _gossip(self, payload: bytes, skip: _Peer | None = None) -> int:
         """Send to all peers concurrently; a stalled peer times out and is
-        dropped instead of blocking propagation (and the mining loop)."""
+        dropped instead of blocking propagation (and the mining loop).
+        Returns the number of peers targeted (metrics accounting)."""
         targets = [p for p in self._peers.values() if p is not skip]
         if targets:
             await asyncio.gather(
                 *(self._send_guarded(p, payload) for p in targets)
             )
+        return len(targets)
 
     # -- chain/mempool handlers -----------------------------------------
 
-    def _block_gossip_payload(self, block: Block) -> bytes:
+    def _block_gossip_payload(self, block: Block) -> tuple[bytes, int]:
         """Choose the push encoding: compact when there are transactions
         worth eliding (the receiver's mempool should hold them), full
         BLOCK otherwise (an empty/coinbase-only block has nothing to
-        elide, and the full form needs no round trip ever)."""
+        elide, and the full form needs no round trip ever; a >u16-tx
+        block exceeds the compact form's counts).  Returns (payload,
+        bytes saved per delivered peer) — the CALLER accounts metrics
+        once it knows how many peers actually received it."""
         full = protocol.encode_block(block)
-        if self.config.compact_gossip and len(block.txs) > 1:
+        if self.config.compact_gossip and 1 < len(block.txs) <= 0xFFFF:
             compact = protocol.encode_cblock(block)
-            self.metrics.cblocks_sent += 1
-            self.metrics.cblock_bytes_saved += len(full) - len(compact)
-            return compact
-        return full
+            return compact, len(full) - len(compact)
+        return full, 0
 
     async def _handle_cblock(
         self, cb: protocol.CompactBlock, peer: _Peer
@@ -689,9 +703,13 @@ class Node:
                     origin.label if origin else "local",
                 )
             if gossip:
-                await self._gossip(
-                    self._block_gossip_payload(block), skip=origin
-                )
+                payload, saved_per_peer = self._block_gossip_payload(block)
+                n = await self._gossip(payload, skip=origin)
+                if saved_per_peer and n:
+                    # Per delivered peer: each would otherwise have
+                    # received the full BLOCK frame.
+                    self.metrics.cblocks_sent += n
+                    self.metrics.cblock_bytes_saved += saved_per_peer * n
         elif res.status is AddStatus.ORPHAN and origin is not None:
             await self._send_guarded(
                 origin, protocol.encode_getblocks(self.chain.locator())

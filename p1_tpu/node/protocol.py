@@ -57,6 +57,15 @@ Messages:
              indices the requester could not reconstruct.
 - BLOCKTXN:  32-byte block hash + u16 count + count * (u32 len + raw tx)
              answering a GETBLOCKTXN, same index order as requested.
+- GETHEADERS: u16 count + count * 32-byte locator hashes — headers-first
+             sync for light clients (`p1 headers`): same locator
+             semantics as GETBLOCKS, but the reply carries bare headers.
+- HEADERS:   u16 count + count * 80-byte serialized headers, main chain
+             ascending from the first recognized locator hash.  A light
+             client iterates GETHEADERS until the reply is empty, then
+             verifies the whole chain itself (replay_host — PoW, linkage,
+             and the retarget difficulty schedule), needing ~80 B/block
+             instead of full blocks and trusting nothing but work.
 """
 
 from __future__ import annotations
@@ -82,8 +91,8 @@ _LEN = struct.Struct(">I")
 #: time the newer side queries a message the older one calls a protocol
 #: violation.  Round 3 spoke an unversioned HELLO; its frames fail here as
 #: "bad HELLO size".  v4 added compact block relay (CBLOCK/GETBLOCKTXN/
-#: BLOCKTXN).
-PROTOCOL_VERSION = 4
+#: BLOCKTXN); v5 headers-first sync (GETHEADERS/HEADERS).
+PROTOCOL_VERSION = 5
 _HELLO = struct.Struct(">B32sIH")
 
 
@@ -102,6 +111,8 @@ class MsgType(enum.IntEnum):
     CBLOCK = 12
     GETBLOCKTXN = 13
     BLOCKTXN = 14
+    GETHEADERS = 15
+    HEADERS = 16
 
 
 @dataclasses.dataclass(frozen=True)
@@ -195,6 +206,10 @@ def encode_cblock(block: Block, sent_ts: float | None = None) -> bytes:
     import time
 
     ts = time.time() if sent_ts is None else sent_ts
+    if len(block.txs) > 0xFFFF:
+        # The compact form's counts are u16; consensus blocks are u32.
+        # Callers fall back to the full BLOCK encoding (node.py does).
+        raise ValueError("too many transactions for a compact block")
     prefilled = []
     txids = []
     for i, tx in enumerate(block.txs):
@@ -245,6 +260,26 @@ def encode_blocktxn(block_hash: bytes, raw_txs: list[bytes]) -> bytes:
         parts.append(struct.pack(">I", len(raw)))
         parts.append(raw)
     return b"".join(parts)
+
+
+def encode_getheaders(locator: list[bytes]) -> bytes:
+    if len(locator) > 0xFFFF:
+        raise ValueError("locator too long")
+    return (
+        bytes([MsgType.GETHEADERS])
+        + struct.pack(">H", len(locator))
+        + b"".join(locator)
+    )
+
+
+def encode_headers(headers: list[BlockHeader]) -> bytes:
+    if len(headers) > 0xFFFF:
+        raise ValueError("too many headers for one HEADERS frame")
+    return (
+        bytes([MsgType.HEADERS])
+        + struct.pack(">H", len(headers))
+        + b"".join(h.serialize() for h in headers)
+    )
 
 
 def encode_getproof(txid: bytes) -> bytes:
@@ -427,6 +462,25 @@ def decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in BLOCKTXN")
         return mtype, (bhash, txs)
+    if mtype is MsgType.GETHEADERS:
+        if len(body) < 2:
+            raise ValueError("bad GETHEADERS")
+        (n,) = struct.unpack_from(">H", body)
+        if len(body) != 2 + 32 * n:
+            raise ValueError("bad GETHEADERS size")
+        return mtype, [body[2 + 32 * i : 2 + 32 * (i + 1)] for i in range(n)]
+    if mtype is MsgType.HEADERS:
+        if len(body) < 2:
+            raise ValueError("bad HEADERS")
+        (n,) = struct.unpack_from(">H", body)
+        if len(body) != 2 + HEADER_SIZE * n:
+            raise ValueError("bad HEADERS size")
+        return mtype, [
+            BlockHeader.deserialize(
+                body[2 + HEADER_SIZE * i : 2 + HEADER_SIZE * (i + 1)]
+            )
+            for i in range(n)
+        ]
     if mtype is MsgType.GETPROOF:
         if len(body) != 32:
             raise ValueError("bad GETPROOF")

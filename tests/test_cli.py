@@ -102,7 +102,7 @@ class TestCli:
                 sys.executable, "-m", "p1_tpu", "node",
                 "--difficulty", "12", "--backend", "cpu", "--chunk", "16384",
                 "--port", port, "--miner-id", alice, "--store", store,
-                "--duration", "15",
+                "--duration", "35",
             ],
             stdout=node_log,
             stderr=node_log,
@@ -112,7 +112,7 @@ class TestCli:
             # Submit once the node is up AND alice has earned a balance
             # (admission checks affordability, so a too-early tx is
             # refused silently — retry until the audit can succeed).
-            deadline = time.monotonic() + 30
+            deadline = time.monotonic() + 45
             sent = False
             while not sent and time.monotonic() < deadline:
                 proc = subprocess.run(
@@ -150,6 +150,31 @@ class TestCli:
                     time.sleep(0.3)  # not mined yet — keep polling
             assert proved is not None, "spend never confirmed with a proof"
             assert proved["verified"] and proved["amount"] == 7
+            # Light-client round: sync + locally verify the header chain,
+            # then re-fetch the proof anchored against it — height and
+            # confirmations now come from OUR verified chain, not the
+            # peer's claim.
+            hdrs = str(tmp_path / "headers.bin")
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "headers",
+                    "--difficulty", "12", "--port", port, "--out", hdrs,
+                ],
+                capture_output=True, text=True, timeout=30, cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-1000:]
+            assert json.loads(proc.stdout)["valid"]
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "proof",
+                    "--difficulty", "12", "--port", port, "--txid", txid,
+                    "--headers", hdrs,
+                ],
+                capture_output=True, text=True, timeout=30, cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-1000:]
+            anchored = json.loads(proc.stdout)
+            assert anchored["anchored"] and anchored["verified"]
             # Second spend, no --seq either: GETACCOUNT must hand back the
             # next usable nonce (1), whether the first tx is still pending
             # or already mined.

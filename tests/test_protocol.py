@@ -141,6 +141,8 @@ class TestMalformed:
                 protocol.AccountState("p1deadbeefdeadbeef", 50, 1, 2, 7)
             ),
             protocol.encode_getproof(b"\x04" * 32),
+            protocol.encode_getheaders([b"\x09" * 32]),
+            protocol.encode_headers([_block().header, make_genesis(12).header]),
             protocol.encode_cblock(_block(3)),
             protocol.encode_getblocktxn(b"\x07" * 32, [1, 2, 5]),
             protocol.encode_blocktxn(
