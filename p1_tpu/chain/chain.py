@@ -64,6 +64,24 @@ from p1_tpu.chain.validate import ValidationError, check_block
 MAX_ORPHANS = 256
 
 
+def locator_hashes(hashes: list[bytes], dense: int = 10) -> list[bytes]:
+    """Tip-first sync locator over a genesis-first hash list: the last
+    ``dense`` entries one by one, then exponentially spaced back to
+    genesis.  ONE definition — ``Chain.locator`` (server side) and the
+    light client's header fetch share it, so the shape both sides use to
+    find the fork point cannot drift."""
+    out = []
+    height = len(hashes) - 1
+    step = 1
+    while True:
+        out.append(hashes[height])
+        if height == 0:
+            return out
+        if len(out) >= dense:
+            step *= 2
+        height = max(0, height - step)
+
+
 class AddStatus(enum.Enum):
     ACCEPTED = "accepted"  # extends a known block (tip may or may not move)
     DUPLICATE = "duplicate"  # already indexed
@@ -248,16 +266,7 @@ class Chain:
     def locator(self, dense: int = 10) -> list[bytes]:
         """Hashes from tip back to genesis: the last ``dense`` blocks one by
         one, then exponentially spaced — the classic sync locator shape."""
-        out = []
-        height = len(self._main_hashes) - 1
-        step = 1
-        while True:
-            out.append(self._main_hashes[height])
-            if height == 0:
-                return out
-            if len(out) >= dense:
-                step *= 2
-            height = max(0, height - step)
+        return locator_hashes(self._main_hashes, dense)
 
     def blocks_after(self, locator: list[bytes], limit: int = 500) -> list[Block]:
         """Main-chain blocks after the first locator hash we recognize.

@@ -154,6 +154,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="push full BLOCK frames instead of compact blocks (local "
         "preference; compact and full nodes interoperate)",
     )
+    p.add_argument(
+        "--target-peers",
+        type=int,
+        default=0,
+        help="peer-discovery out-degree: dial addresses learned via "
+        "GETADDR/ADDR gossip until this many connections hold (0 = only "
+        "the configured --peers; one seed peer bootstraps the rest)",
+    )
     _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
@@ -570,6 +578,7 @@ async def _run_node(args, miner=None) -> int:
         retarget_window=getattr(args, "retarget_window", 0),
         target_spacing=getattr(args, "target_spacing", 0),
         compact_gossip=not getattr(args, "no_compact_gossip", False),
+        target_peers=getattr(args, "target_peers", 0),
     )
     node = Node(config, miner=miner)
     await node.start()

@@ -35,6 +35,12 @@ class NodeConfig:
     #: reconstruct from their mempool and fetch only what they lack.
     #: Local preference, not a chain parameter — mixed nets interoperate.
     compact_gossip: bool = True
+    #: Peer discovery out-degree: when > 0, the node dials addresses
+    #: learned via GETADDR/ADDR gossip until it holds this many
+    #: connections — one seed peer bootstraps the whole network.  0 (the
+    #: default) dials only the configured ``peers``; the address book and
+    #: GETADDR serving stay on either way.
+    target_peers: int = 0
 
     def retarget_rule(self):
         """The chain's ``RetargetRule``, or None for fixed difficulty."""

@@ -104,22 +104,6 @@ async def get_proof(
     return await asyncio.wait_for(_run(), timeout)
 
 
-def _locator_from(hashes: list[bytes]) -> list[bytes]:
-    """Tip-first locator over a genesis-first hash list — the same dense-
-    then-exponential shape ``Chain.locator`` serves (one copy per side:
-    the chain's is height-indexed, this one walks a plain list)."""
-    out = []
-    height = len(hashes) - 1
-    step = 1
-    while True:
-        out.append(hashes[height])
-        if height == 0:
-            return out
-        if len(out) >= 10:
-            step *= 2
-        height = max(0, height - step)
-
-
 async def get_headers(
     host: str,
     port: int,
@@ -145,9 +129,11 @@ async def get_headers(
             headers = [genesis.header]
             hashes = [genesis.block_hash()]
             pos = {hashes[0]: 0}
+            from p1_tpu.chain.chain import locator_hashes
+
             while True:
                 await protocol.write_frame(
-                    writer, protocol.encode_getheaders(_locator_from(hashes))
+                    writer, protocol.encode_getheaders(locator_hashes(hashes))
                 )
                 while True:
                     mtype, body = protocol.decode(

@@ -40,6 +40,13 @@ log = logging.getLogger("p1_tpu.node")
 SYNC_BATCH = 500
 #: Headers per GETHEADERS reply (80 B each — 2000 is a 160 KB frame).
 HEADERS_BATCH = 2000
+#: Address-book bound and per-ADDR-reply cap (peer discovery).
+MAX_KNOWN_ADDRS = 1024
+ADDR_REPLY_MAX = 64
+#: How often the discovery loop checks whether to dial a learned address.
+DISCOVERY_INTERVAL_S = 1.0
+#: Minimum spacing between repeat GETADDR broadcasts while under target.
+READDR_INTERVAL_S = 30.0
 #: Pending compact-block reconstructions awaiting a BLOCKTXN reply.  Small
 #: and FIFO-capped: entries exist only for the one GETBLOCKTXN round trip;
 #: anything stranded (peer died mid-answer) is evicted by newer blocks and
@@ -117,6 +124,11 @@ class _Peer:
         self.writer = writer
         self.label = label
         self.synced_once = False
+        #: The peer's advertised listening address (peername host + HELLO
+        #: listen port), once the handshake ran; None for non-listening
+        #: tooling clients.  Keys the discovery loop's "already connected"
+        #: check and is what GETADDR replies share.
+        self.addr: tuple[str, int] | None = None
         #: The tip height the peer advertised in its HELLO — the bar our
         #: own chain must reach before the initial mempool sync is worth
         #: requesting (see ``mempool_requested``).
@@ -147,6 +159,11 @@ class Node:
         import secrets
 
         self.config = config
+        #: Random per-process id carried in HELLO: dialing an address that
+        #: answers with OUR nonce means we dialed ourselves (an address
+        #: book can legitimately learn our own address from peers) — the
+        #: connection is dropped and the address forgotten.
+        self.instance_nonce = secrets.randbits(64) | 1  # never 0 (= client)
         #: Coinbase identity: distinct per node unless pinned by config, so
         #: concurrent miners assemble *different* candidate blocks and the
         #: fork-choice machinery is actually exercised at network level.
@@ -175,6 +192,8 @@ class Node:
                 backend=get_backend(config.backend, **kwargs), chunk=config.chunk
             )
         self._peers: dict[asyncio.StreamWriter, _Peer] = {}
+        #: Discovery dials in flight (dedup against the next tick).
+        self._dialing: set[tuple[str, int]] = set()
         #: (block hash, announcing peer) -> partially reconstructed compact
         #: block (see ``_handle_cblock``); FIFO-capped.  Keyed per PEER so
         #: a front-runner pushing a tampered txid list for a real block
@@ -184,6 +203,16 @@ class Node:
         self._pending_cblocks: collections.OrderedDict[
             tuple[bytes, _Peer], _PendingCompact
         ] = collections.OrderedDict()
+        #: Address book: (host, port) -> last-learned monotonic time.
+        #: Seeded from config, fed by peer HELLOs and ADDR gossip, FIFO-
+        #: bounded; the discovery loop (``target_peers`` > 0) dials from
+        #: it.  Never contains our own address knowingly — a self-dial is
+        #: detected by nonce and the address dropped.
+        self._known_addrs: collections.OrderedDict[
+            tuple[str, int], float
+        ] = collections.OrderedDict(
+            (addr, 0.0) for addr in config.peer_addrs()
+        )
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
@@ -245,6 +274,8 @@ class Node:
         log.info("listening on %s:%d", self.config.host, self.port)
         for host, port in self.config.peer_addrs():
             self._tasks.append(asyncio.create_task(self._dial_loop(host, port)))
+        if self.config.target_peers > 0:
+            self._tasks.append(asyncio.create_task(self._discovery_loop()))
         if self.config.mine:
             self.start_mining()
 
@@ -324,6 +355,7 @@ class Node:
                 self.chain.genesis.block_hash(),
                 self.chain.height,
                 self.port or 0,
+                self.instance_nonce,
             )
         )
 
@@ -346,13 +378,98 @@ class Node:
             except OSError:
                 await asyncio.sleep(RECONNECT_DELAY_S)
                 continue
-            await self._peer_session(reader, writer, f"out:{host}:{port}")
+            await self._peer_session(
+                reader, writer, f"out:{host}:{port}", dial_addr=(host, port)
+            )
             await asyncio.sleep(RECONNECT_DELAY_S)
 
+    async def _dial_once(self, host: str, port: int) -> None:
+        """One discovery-driven connection attempt (no retry loop: the
+        discovery loop re-evaluates the address book every tick, so a
+        failed or rejected dial is simply superseded)."""
+        try:
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), timeout=5.0
+                )
+            except (OSError, asyncio.TimeoutError):
+                # Unreachable: forget the address (a live peer's ADDR
+                # gossip will re-teach it if it comes back).
+                self._known_addrs.pop((host, port), None)
+                return
+            registered = await self._peer_session(
+                reader, writer, f"disc:{host}:{port}", dial_addr=(host, port)
+            )
+            if not registered:
+                # Accepted TCP but failed the handshake (wrong chain,
+                # version skew, peer full, ourselves): forget it, or the
+                # next tick redials the same dead end forever and starves
+                # every other candidate in the book.
+                self._known_addrs.pop((host, port), None)
+        finally:
+            self._dialing.discard((host, port))
+
+    async def _discovery_loop(self) -> None:
+        """Dial learned addresses until ``target_peers`` connections hold
+        (SURVEY §1 L5 gossip network, the discovery half: one seed peer
+        bootstraps the rest)."""
+        last_readdr = 0.0
+        while self._running:
+            await asyncio.sleep(DISCOVERY_INTERVAL_S)
+            # Count node peers only: a long-lived wallet/monitoring client
+            # (no advertised address) must not satisfy the target and
+            # suppress dialing the real network.
+            node_peers = [
+                p for p in self._peers.values() if p.addr is not None
+            ]
+            deficit = self.config.target_peers - len(node_peers)
+            if deficit <= 0:
+                continue
+            connected = {p.addr for p in node_peers}
+            started = 0
+            for addr in list(self._known_addrs):
+                if deficit <= started:
+                    break
+                if addr in connected or addr in self._dialing:
+                    continue
+                self._dialing.add(addr)
+                task = asyncio.create_task(self._dial_once(*addr))
+                self._sessions.add(task)
+                task.add_done_callback(self._sessions.discard)
+                started += 1
+            now = time.monotonic()
+            if (
+                started == 0
+                and self._peers
+                and now - last_readdr >= READDR_INTERVAL_S
+            ):
+                # Under target with nothing new to dial: periodically ask
+                # the peers we DO have for more addresses (new nodes may
+                # have joined since the handshake-time GETADDR).  Rate-
+                # limited — a node whose target exceeds the network size
+                # would otherwise chatter GETADDR every tick forever.
+                last_readdr = now
+                await self._gossip(protocol.encode_getaddr())
+
+    def _learn_addr(self, addr: tuple[str, int]) -> None:
+        """Merge one address into the bounded book (refreshes recency)."""
+        self._known_addrs.pop(addr, None)
+        self._known_addrs[addr] = time.monotonic()
+        while len(self._known_addrs) > MAX_KNOWN_ADDRS:
+            self._known_addrs.popitem(last=False)
+
     async def _peer_session(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter, label: str
-    ) -> None:
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        label: str,
+        dial_addr: tuple[str, int] | None = None,
+    ) -> bool:
+        """Run one peer session to completion.  Returns whether the peer
+        ever completed the handshake and registered — False means the
+        address is not worth redialing (discovery forgets it)."""
         peer = _Peer(writer, label)
+        registered = False
         try:
             if len(self._peers) >= MAX_PEERS:
                 raise ValueError(f"peer limit {MAX_PEERS} reached")
@@ -363,14 +480,30 @@ class Node:
                 raise ValueError("expected HELLO")
             if hello.genesis_hash != self.chain.genesis.block_hash():
                 raise ValueError("genesis mismatch")
+            if hello.nonce and hello.nonce == self.instance_nonce:
+                # We dialed our own listening address (the book can learn
+                # it from peers' ADDR gossip) — drop it for good.
+                if dial_addr is not None:
+                    self._known_addrs.pop(dial_addr, None)
+                raise ValueError("connected to self")
             if len(self._peers) >= MAX_PEERS:
                 # Re-check at registration: the pre-handshake check above
                 # races across the two awaits (a flood of simultaneous
                 # dials all pass it while _peers is still small).
                 raise ValueError(f"peer limit {MAX_PEERS} reached")
             self._peers[writer] = peer
+            registered = True
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
             peer.hello_height = hello.tip_height
+            if hello.listen_port:
+                # The peer's reachable address: its socket host + the
+                # listen port it advertised.  Feeds the book and GETADDR.
+                peername = writer.get_extra_info("peername")
+                if peername:
+                    peer.addr = (peername[0], hello.listen_port)
+                    self._learn_addr(peer.addr)
+            if hello.nonce:  # a real node (not a one-shot tooling client)
+                await peer.send(protocol.encode_getaddr())
             if hello.tip_height > self.chain.height:
                 # Blocks first, mempool after: the BLOCKS handler requests
                 # the pool once our chain reaches the advertised height,
@@ -396,6 +529,7 @@ class Node:
         finally:
             self._peers.pop(writer, None)
             writer.close()
+        return registered
 
     async def _dispatch(self, peer: _Peer, payload: bytes) -> None:
         mtype, body = protocol.decode(payload)
@@ -512,6 +646,16 @@ class Node:
             # falls back to locator sync, and answering garbage helps no one.
         elif mtype is MsgType.BLOCKTXN:
             await self._handle_blocktxn(body, peer)
+        elif mtype is MsgType.GETADDR:
+            # Share listening addresses we know, minus the asker's own
+            # (it does not need to learn itself).
+            addrs = [a for a in self._known_addrs if a != peer.addr]
+            await self._send_guarded(
+                peer, protocol.encode_addr(addrs[-ADDR_REPLY_MAX:])
+            )
+        elif mtype is MsgType.ADDR:
+            for addr in body[:ADDR_REPLY_MAX]:  # cap hostile batches
+                self._learn_addr(addr)
         elif mtype is MsgType.GETHEADERS:
             # Headers-first sync for light clients: same locator
             # semantics as GETBLOCKS, 80 B/block on the wire.
@@ -830,6 +974,7 @@ class Node:
             "height": self.chain.height,
             "tip": self.chain.tip_hash.hex(),
             "peers": self.peer_count(),
+            "known_addrs": len(self._known_addrs),
             "mempool": len(self.mempool),
             "hashes_per_sec": round(self.metrics.hashes_per_sec),
             "time_to_block_s": round(self.metrics.last_block_time_s, 3),

@@ -7,8 +7,12 @@ bytes are exactly the consensus bytes — nothing to re-canonicalize.
 
 Messages:
 
-- HELLO:     genesis hash (32) + tip height (4) + listen port (2).
-             Sent both ways on connect; genesis mismatch = disconnect.
+- HELLO:     genesis hash (32) + tip height (4) + listen port (2) + u64
+             instance nonce (random per node process — a node that
+             receives its OWN nonce back just dialed itself via a
+             gossiped address and drops the connection, Bitcoin's
+             self-connect detection).  Sent both ways on connect; genesis
+             mismatch = disconnect.
 - BLOCK:     f64 sender wall-clock send time + one serialized block (push
              gossip).  The timestamp is *telemetry only* — receivers use
              it to measure propagation delay (send -> accept), never for
@@ -57,6 +61,13 @@ Messages:
              indices the requester could not reconstruct.
 - BLOCKTXN:  32-byte block hash + u16 count + count * (u32 len + raw tx)
              answering a GETBLOCKTXN, same index order as requested.
+- GETADDR:   empty body — ask a peer for addresses of other nodes it
+             knows (peer discovery; asked once per session).
+- ADDR:      u16 count + count * (u16 port + u8 len + utf-8 host) —
+             known listening addresses.  Receivers merge them into a
+             bounded address book; with ``--target-peers N`` set a node
+             dials discovered addresses until it holds N connections, so
+             a new node bootstraps the whole network from one seed peer.
 - GETHEADERS: u16 count + count * 32-byte locator hashes — headers-first
              sync for light clients (`p1 headers`): same locator
              semantics as GETBLOCKS, but the reply carries bare headers.
@@ -91,9 +102,10 @@ _LEN = struct.Struct(">I")
 #: time the newer side queries a message the older one calls a protocol
 #: violation.  Round 3 spoke an unversioned HELLO; its frames fail here as
 #: "bad HELLO size".  v4 added compact block relay (CBLOCK/GETBLOCKTXN/
-#: BLOCKTXN); v5 headers-first sync (GETHEADERS/HEADERS).
-PROTOCOL_VERSION = 5
-_HELLO = struct.Struct(">B32sIH")
+#: BLOCKTXN); v5 headers-first sync (GETHEADERS/HEADERS); v6 peer
+#: discovery (GETADDR/ADDR + the HELLO instance nonce).
+PROTOCOL_VERSION = 6
+_HELLO = struct.Struct(">B32sIHQ")
 
 
 class MsgType(enum.IntEnum):
@@ -113,6 +125,8 @@ class MsgType(enum.IntEnum):
     BLOCKTXN = 14
     GETHEADERS = 15
     HEADERS = 16
+    GETADDR = 17
+    ADDR = 18
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,11 +155,14 @@ class Hello:
     genesis_hash: bytes
     tip_height: int
     listen_port: int
+    #: Random per-process id; lets a node recognize (and drop) a dial to
+    #: itself.  0 = one-shot tooling clients that never listen.
+    nonce: int = 0
 
 
 def encode_hello(h: Hello) -> bytes:
     return bytes([MsgType.HELLO]) + _HELLO.pack(
-        PROTOCOL_VERSION, h.genesis_hash, h.tip_height, h.listen_port
+        PROTOCOL_VERSION, h.genesis_hash, h.tip_height, h.listen_port, h.nonce
     )
 
 
@@ -258,6 +275,23 @@ def encode_blocktxn(block_hash: bytes, raw_txs: list[bytes]) -> bytes:
     ]
     for raw in raw_txs:
         parts.append(struct.pack(">I", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def encode_getaddr() -> bytes:
+    return bytes([MsgType.GETADDR])
+
+
+def encode_addr(addrs: list[tuple[str, int]]) -> bytes:
+    if len(addrs) > 0xFFFF:
+        raise ValueError("too many addresses for one ADDR frame")
+    parts = [bytes([MsgType.ADDR]), struct.pack(">H", len(addrs))]
+    for host, port in addrs:
+        raw = host.encode("utf-8")
+        if not 0 < len(raw) <= 255 or not 0 < port <= 0xFFFF:
+            raise ValueError(f"bad address {host}:{port}")
+        parts.append(struct.pack(">HB", port, len(raw)))
         parts.append(raw)
     return b"".join(parts)
 
@@ -462,6 +496,28 @@ def decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in BLOCKTXN")
         return mtype, (bhash, txs)
+    if mtype is MsgType.GETADDR:
+        if body:
+            raise ValueError("bad GETADDR")
+        return mtype, None
+    if mtype is MsgType.ADDR:
+        if len(body) < 2:
+            raise ValueError("bad ADDR")
+        (n,) = struct.unpack_from(">H", body)
+        off = 2
+        addrs = []
+        for _ in range(n):
+            if len(body) < off + 3:
+                raise ValueError("truncated ADDR")
+            port, hlen = struct.unpack_from(">HB", body, off)
+            off += 3
+            if hlen == 0 or port == 0 or len(body) < off + hlen:
+                raise ValueError("bad ADDR entry")
+            addrs.append((body[off : off + hlen].decode("utf-8"), port))
+            off += hlen
+        if off != len(body):
+            raise ValueError("trailing bytes in ADDR")
+        return mtype, addrs
     if mtype is MsgType.GETHEADERS:
         if len(body) < 2:
             raise ValueError("bad GETHEADERS")

@@ -1,0 +1,138 @@
+"""Peer discovery: GETADDR/ADDR wire, address-book bootstrap from one
+seed, and self-connect detection via the HELLO instance nonce."""
+
+import asyncio
+
+import pytest
+
+from test_node import _config, stop_all, wait_until
+
+from p1_tpu.node import Node, protocol
+from p1_tpu.node.protocol import Hello, MsgType
+
+
+class TestWire:
+    def test_round_trips(self):
+        mtype, got = protocol.decode(protocol.encode_getaddr())
+        assert mtype is MsgType.GETADDR and got is None
+        addrs = [("127.0.0.1", 9444), ("node-7.example", 19444)]
+        mtype, got = protocol.decode(protocol.encode_addr(addrs))
+        assert mtype is MsgType.ADDR and got == addrs
+        _, got = protocol.decode(protocol.encode_addr([]))
+        assert got == []
+
+    def test_hello_carries_instance_nonce(self):
+        h = Hello(b"\xab" * 32, 42, 9444, nonce=0xDEADBEEF12345678)
+        mtype, got = protocol.decode(protocol.encode_hello(h))
+        assert mtype is MsgType.HELLO and got == h
+        assert got.nonce == 0xDEADBEEF12345678
+
+    @pytest.mark.parametrize(
+        "payload",
+        [
+            bytes([MsgType.GETADDR]) + b"\x00",  # non-empty body
+            bytes([MsgType.ADDR]) + b"\x00",  # short count
+            bytes([MsgType.ADDR]) + b"\x00\x01" + b"\x00\x00\x01a",  # port 0
+            bytes([MsgType.ADDR]) + b"\x00\x01" + b"\x23\x28\x00",  # empty host
+            bytes([MsgType.ADDR]) + b"\x00\x02" + b"\x23\x28\x01a",  # count lies
+            bytes([MsgType.ADDR]) + b"\x00\x01" + b"\x23\x28\x01ab",  # trailing
+        ],
+    )
+    def test_malformed_rejected(self, payload):
+        with pytest.raises(ValueError):
+            protocol.decode(payload)
+
+
+class TestDiscovery:
+    def test_one_seed_bootstraps_a_full_mesh(self):
+        """Classic bootstrap: A and B each know only the seed; discovery
+        must connect A<->B through the seed's address book."""
+
+        async def scenario():
+            seed = Node(_config(target_peers=3))
+            await seed.start()
+            a = Node(
+                _config(peers=(f"127.0.0.1:{seed.port}",), target_peers=3)
+            )
+            b = Node(
+                _config(peers=(f"127.0.0.1:{seed.port}",), target_peers=3)
+            )
+            await a.start()
+            await b.start()
+            try:
+                assert await wait_until(
+                    lambda: a.peer_count() >= 2
+                    and b.peer_count() >= 2
+                    and seed.peer_count() >= 2,
+                    timeout=20,
+                )
+                # Everyone's book learned everyone's listening address.
+                for node, others in (
+                    (a, (seed, b)),
+                    (b, (seed, a)),
+                    (seed, (a, b)),
+                ):
+                    known_ports = {p for _, p in node._known_addrs}
+                    assert {o.port for o in others} <= known_ports
+            finally:
+                await stop_all((a, b, seed))
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_self_address_is_detected_and_forgotten(self):
+        async def scenario():
+            node = Node(_config(target_peers=2))
+            await node.start()
+            try:
+                own = ("127.0.0.1", node.port)
+                node._learn_addr(own)
+                # The discovery loop dials it, the HELLO nonce comes back
+                # as our own, the session dies and the address is dropped.
+                assert await wait_until(
+                    lambda: own not in node._known_addrs, timeout=15
+                )
+                assert node.peer_count() == 0
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_failed_handshake_address_is_forgotten(self):
+        """An address that accepts TCP but rejects the handshake (here: a
+        node on a different chain) must leave the book, or the discovery
+        loop would redial the same dead end every tick and starve every
+        other candidate."""
+
+        async def scenario():
+            foreign = Node(_config(difficulty=13))
+            await foreign.start()
+            node = Node(_config(target_peers=1))
+            await node.start()
+            try:
+                bad = ("127.0.0.1", foreign.port)
+                node._learn_addr(bad)
+                assert await wait_until(
+                    lambda: bad not in node._known_addrs, timeout=15
+                )
+                assert node.peer_count() == 0
+            finally:
+                await stop_all((node, foreign))
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_discovery_off_by_default(self):
+        async def scenario():
+            a = Node(_config())
+            await a.start()
+            b = Node(_config())
+            await b.start()
+            try:
+                # Books may learn addresses, but nothing dials without
+                # --target-peers: no discovery task exists.
+                a._learn_addr(("127.0.0.1", b.port))
+                await asyncio.sleep(2 * 1.5)
+                assert a.peer_count() == 0 and b.peer_count() == 0
+            finally:
+                await stop_all((a, b))
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))

@@ -34,7 +34,7 @@ class TestRoundTrips:
         import struct
 
         payload = bytes([MsgType.HELLO]) + struct.pack(
-            ">B32sIH", protocol.PROTOCOL_VERSION + 1, b"\xab" * 32, 1, 1
+            ">B32sIHQ", protocol.PROTOCOL_VERSION + 1, b"\xab" * 32, 1, 1, 7
         )
         with pytest.raises(ValueError, match="protocol version"):
             protocol.decode(payload)
@@ -142,6 +142,8 @@ class TestMalformed:
             ),
             protocol.encode_getproof(b"\x04" * 32),
             protocol.encode_getheaders([b"\x09" * 32]),
+            protocol.encode_getaddr(),
+            protocol.encode_addr([("127.0.0.1", 9444), ("h.example", 80)]),
             protocol.encode_headers([_block().header, make_genesis(12).header]),
             protocol.encode_cblock(_block(3)),
             protocol.encode_getblocktxn(b"\x07" * 32, [1, 2, 5]),
