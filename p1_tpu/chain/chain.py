@@ -239,6 +239,36 @@ class Chain:
         span = prev.block.header.timestamp - anchor.block.header.timestamp
         return rule.adjusted(prev.block.header.difficulty, span)
 
+    def fee_stats(self, window: int = 32) -> dict:
+        """Fee percentiles over the transfers confirmed in the last
+        ``window`` main-chain blocks — what a wallet consults to price a
+        spend (`p1 tx --fee auto`).  With no recent transfers every
+        percentile is 0 and callers fall back to the minimum fee; the
+        sample is confirmed-fees-only by design (pending-pool fees are a
+        bid book, confirmed fees are what actually cleared)."""
+        fees: list[int] = []
+        blocks = 0
+        for h in reversed(self._main_hashes[-window:] if window else []):
+            entry = self._index[h]
+            if entry.height == 0:
+                break  # genesis anchors, it does not sample
+            blocks += 1
+            fees.extend(tx.fee for tx in entry.block.txs if not tx.is_coinbase)
+        fees.sort()
+
+        def pct(p: float) -> int:
+            if not fees:
+                return 0
+            return fees[min(len(fees) - 1, int(p * len(fees)))]
+
+        return {
+            "window_blocks": blocks,
+            "samples": len(fees),
+            "p25": pct(0.25),
+            "p50": pct(0.50),
+            "p75": pct(0.75),
+        }
+
     def tx_proof(self, txid: bytes) -> TxProof | None:
         """SPV inclusion proof for a main-chain-confirmed transaction, or
         ``None`` if ``txid`` is not confirmed at the current tip.  Served

@@ -176,7 +176,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--recipient", required=True)
     p.add_argument("--amount", type=int, required=True)
-    p.add_argument("--fee", type=int, default=1)
+    p.add_argument(
+        "--fee",
+        default="1",
+        help="fee units, or 'auto' to price at the node's recent "
+        "confirmed-fee median (floor 1)",
+    )
     p.add_argument(
         "--seq",
         type=int,
@@ -199,6 +204,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--key", default=None, help="key file; queries its fingerprint account"
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
+        "fees", help="query confirmed-fee percentiles from a running node"
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--window", type=int, default=0, help="blocks to sample (0 = node default)"
     )
     _add_retarget(p)
 
@@ -651,6 +667,15 @@ def cmd_tx(args) -> int:
 
         key = Keypair.load(args.key)
         rule = _retarget_rule(args)
+        if args.fee == "auto":
+            from p1_tpu.node.client import get_fees
+
+            stats = asyncio.run(
+                get_fees(args.host, args.port, args.difficulty, retarget=rule)
+            )
+            fee = max(1, stats.p50)
+        else:
+            fee = int(args.fee)
         seq = args.seq
         if seq is None:
             # Wallet convenience: consensus wants the exact next nonce, so
@@ -669,7 +694,7 @@ def cmd_tx(args) -> int:
             key,
             args.recipient,
             args.amount,
-            args.fee,
+            fee,
             seq,
             chain=genesis_hash(args.difficulty, rule),
         )
@@ -692,6 +717,7 @@ def cmd_tx(args) -> int:
                 "txid": tx.txid().hex(),
                 "sender": tx.sender,
                 "seq": seq,
+                "fee": fee,
                 "peer_height": height,
             }
         )
@@ -738,6 +764,48 @@ def cmd_account(args) -> int:
                 "nonce": state.nonce,
                 "next_seq": state.next_seq,
                 "height": state.tip_height,
+            }
+        )
+    )
+    return 0
+
+
+# -- fees ----------------------------------------------------------------
+
+
+def cmd_fees(args) -> int:
+    from p1_tpu.node.client import get_fees
+
+    try:
+        stats = asyncio.run(
+            get_fees(
+                args.host,
+                args.port,
+                args.difficulty,
+                window=args.window,
+                retarget=_retarget_rule(args),
+            )
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"fee query failed: {e}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(
+            {
+                "config": "fees",
+                "window_blocks": stats.window_blocks,
+                "samples": stats.samples,
+                "p25": stats.p25,
+                "p50": stats.p50,
+                "p75": stats.p75,
+                "suggested_fee": max(1, stats.p50),
+                "height": stats.tip_height,
             }
         )
     )
@@ -1532,6 +1600,7 @@ def main(argv=None) -> int:
         "keygen": cmd_keygen,
         "account": cmd_account,
         "proof": cmd_proof,
+        "fees": cmd_fees,
         "headers": cmd_headers,
         "balances": cmd_balances,
         "compact": cmd_compact,

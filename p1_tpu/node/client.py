@@ -175,6 +175,33 @@ async def get_headers(
     return await asyncio.wait_for(_run(), timeout)
 
 
+async def get_fees(
+    host: str,
+    port: int,
+    difficulty: int,
+    window: int = 0,
+    timeout: float = 10.0,
+    retarget=None,
+) -> protocol.FeeStats:
+    """Query confirmed-fee percentiles from the node at host:port — the
+    wallet's price signal for `p1 tx --fee auto` (0 window = the node's
+    default sample)."""
+
+    async def _run() -> protocol.FeeStats:
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+            await protocol.write_frame(writer, protocol.encode_getfees(window))
+            while True:
+                mtype, body = protocol.decode(await protocol.read_frame(reader))
+                if mtype is MsgType.FEES:
+                    return body
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def get_account(
     host: str,
     port: int,

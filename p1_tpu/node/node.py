@@ -129,6 +129,11 @@ class _Peer:
         #: tooling clients.  Keys the discovery loop's "already connected"
         #: check and is what GETADDR replies share.
         self.addr: tuple[str, int] | None = None
+        #: The address WE dialed to reach this peer, if outbound.  May be
+        #: an alias of ``addr`` (hostname vs peername IP) — the discovery
+        #: loop treats both as connected so it never dials a live peer
+        #: again under a different spelling.
+        self.dial_addr: tuple[str, int] | None = None
         #: The tip height the peer advertised in its HELLO — the bar our
         #: own chain must reach before the initial mempool sync is worth
         #: requesting (see ``mempool_requested``).
@@ -425,7 +430,18 @@ class Node:
             deficit = self.config.target_peers - len(node_peers)
             if deficit <= 0:
                 continue
+            # "Connected" covers both spellings of a live peer (its
+            # advertised addr AND whatever alias we dialed), and the
+            # configured peers are excluded outright — their _dial_loop
+            # owns them (including mid-handshake windows where no peer is
+            # registered yet).
             connected = {p.addr for p in node_peers}
+            connected |= {
+                p.dial_addr
+                for p in self._peers.values()
+                if p.dial_addr is not None
+            }
+            connected |= set(self.config.peer_addrs())
             started = 0
             for addr in list(self._known_addrs):
                 if deficit <= started:
@@ -469,6 +485,7 @@ class Node:
         ever completed the handshake and registered — False means the
         address is not worth redialing (discovery forgets it)."""
         peer = _Peer(writer, label)
+        peer.dial_addr = dial_addr
         registered = False
         try:
             if len(self._peers) >= MAX_PEERS:
@@ -646,6 +663,24 @@ class Node:
             # falls back to locator sync, and answering garbage helps no one.
         elif mtype is MsgType.BLOCKTXN:
             await self._handle_blocktxn(body, peer)
+        elif mtype is MsgType.GETFEES:
+            # Wallet fee query: confirmed-fee percentiles at our tip.
+            stats = self.chain.fee_stats(body or 32)
+            await self._send_guarded(
+                peer,
+                protocol.encode_fees(
+                    protocol.FeeStats(
+                        stats["window_blocks"],
+                        stats["samples"],
+                        stats["p25"],
+                        stats["p50"],
+                        stats["p75"],
+                        self.chain.height,
+                    )
+                ),
+            )
+        elif mtype is MsgType.FEES:
+            pass  # reply frame: meaningful to querying clients only
         elif mtype is MsgType.GETADDR:
             # Share listening addresses we know, minus the asker's own
             # (it does not need to learn itself).

@@ -68,6 +68,12 @@ Messages:
              bounded address book; with ``--target-peers N`` set a node
              dials discovered addresses until it holds N connections, so
              a new node bootstraps the whole network from one seed peer.
+- GETFEES:   u16 window (blocks to sample; 0 = server default) — fee
+             estimation query (`p1 fees`, `p1 tx --fee auto`).
+- FEES:      u16 window used + u32 sample count + u64 p25/p50/p75 fee
+             percentiles over transfers confirmed in the window + u32 tip
+             height.  Confirmed fees only: what actually cleared, not the
+             pending bid book.
 - GETHEADERS: u16 count + count * 32-byte locator hashes — headers-first
              sync for light clients (`p1 headers`): same locator
              semantics as GETBLOCKS, but the reply carries bare headers.
@@ -103,8 +109,9 @@ _LEN = struct.Struct(">I")
 #: violation.  Round 3 spoke an unversioned HELLO; its frames fail here as
 #: "bad HELLO size".  v4 added compact block relay (CBLOCK/GETBLOCKTXN/
 #: BLOCKTXN); v5 headers-first sync (GETHEADERS/HEADERS); v6 peer
-#: discovery (GETADDR/ADDR + the HELLO instance nonce).
-PROTOCOL_VERSION = 6
+#: discovery (GETADDR/ADDR + the HELLO instance nonce); v7 fee
+#: estimation (GETFEES/FEES).
+PROTOCOL_VERSION = 7
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -127,6 +134,8 @@ class MsgType(enum.IntEnum):
     HEADERS = 16
     GETADDR = 17
     ADDR = 18
+    GETFEES = 19
+    FEES = 20
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,6 +157,18 @@ class CompactBlock:
     ntx: int
     prefilled: tuple[tuple[int, Transaction], ...]  # (index, tx) ascending
     txids: tuple[bytes, ...]  # remaining transactions, block order
+
+
+@dataclasses.dataclass(frozen=True)
+class FeeStats:
+    """Decoded FEES reply: confirmed-fee percentiles at the peer's tip."""
+
+    window_blocks: int
+    samples: int
+    p25: int
+    p50: int
+    p75: int
+    tip_height: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -277,6 +298,24 @@ def encode_blocktxn(block_hash: bytes, raw_txs: list[bytes]) -> bytes:
         parts.append(struct.pack(">I", len(raw)))
         parts.append(raw)
     return b"".join(parts)
+
+
+def encode_getfees(window: int = 0) -> bytes:
+    if not 0 <= window <= 0xFFFF:
+        raise ValueError("bad fee window")
+    return bytes([MsgType.GETFEES]) + struct.pack(">H", window)
+
+
+def encode_fees(stats: FeeStats) -> bytes:
+    return bytes([MsgType.FEES]) + struct.pack(
+        ">HIQQQI",
+        stats.window_blocks,
+        stats.samples,
+        stats.p25,
+        stats.p50,
+        stats.p75,
+        stats.tip_height,
+    )
 
 
 def encode_getaddr() -> bytes:
@@ -496,6 +535,14 @@ def decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in BLOCKTXN")
         return mtype, (bhash, txs)
+    if mtype is MsgType.GETFEES:
+        if len(body) != 2:
+            raise ValueError("bad GETFEES")
+        return mtype, struct.unpack(">H", body)[0]
+    if mtype is MsgType.FEES:
+        if len(body) != 34:
+            raise ValueError("bad FEES")
+        return mtype, FeeStats(*struct.unpack(">HIQQQI", body))
     if mtype is MsgType.GETADDR:
         if body:
             raise ValueError("bad GETADDR")

@@ -183,12 +183,16 @@ class TestCli:
                     sys.executable, "-m", "p1_tpu", "tx",
                     "--difficulty", "12", "--port", port,
                     "--key", alice_key, "--recipient", bob,
-                    "--amount", "5", "--fee", "1",
+                    "--amount", "5", "--fee", "auto",
                 ],
                 capture_output=True, text=True, timeout=30, cwd="/root/repo",
             )
             assert proc.returncode == 0, proc.stderr[-1000:]
-            assert json.loads(proc.stdout)["seq"] == 1
+            second = json.loads(proc.stdout)
+            assert second["seq"] == 1
+            # --fee auto priced at the confirmed median (the first spend
+            # paid 1, so the sampled median is 1).
+            assert second["fee"] == 1
             # Live account query while the node still runs.
             proc = subprocess.run(
                 [

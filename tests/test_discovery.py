@@ -120,6 +120,29 @@ class TestDiscovery:
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
 
+    def test_alias_of_connected_peer_not_redialed(self):
+        """An address-book alias of a live peer (hostname spelling vs the
+        peername IP) must count as connected — no duplicate session."""
+
+        async def scenario():
+            seed = Node(_config())
+            await seed.start()
+            node = Node(
+                _config(peers=(f"localhost:{seed.port}",), target_peers=2)
+            )
+            await node.start()
+            try:
+                assert await wait_until(lambda: node.peer_count() == 1)
+                # The book also knows the peer under its IP spelling.
+                node._learn_addr(("127.0.0.1", seed.port))
+                await asyncio.sleep(3)
+                assert node.peer_count() == 1  # no duplicate dial
+                assert seed.peer_count() == 1
+            finally:
+                await stop_all((node, seed))
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
     def test_discovery_off_by_default(self):
         async def scenario():
             a = Node(_config())

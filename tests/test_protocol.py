@@ -143,6 +143,8 @@ class TestMalformed:
             protocol.encode_getproof(b"\x04" * 32),
             protocol.encode_getheaders([b"\x09" * 32]),
             protocol.encode_getaddr(),
+            protocol.encode_getfees(16),
+            protocol.encode_fees(protocol.FeeStats(32, 9, 1, 2, 3, 44)),
             protocol.encode_addr([("127.0.0.1", 9444), ("h.example", 80)]),
             protocol.encode_headers([_block().header, make_genesis(12).header]),
             protocol.encode_cblock(_block(3)),
