@@ -59,6 +59,19 @@ def _add_retarget(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _fee_arg(value: str):
+    """--fee: an integer or the literal 'auto' — validated by argparse so
+    a typo is a usage error, not a runtime failure after other work."""
+    if value == "auto":
+        return value
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"fee must be an integer or 'auto', got {value!r}"
+        )
+
+
 def _retarget_rule(args):
     """The ``RetargetRule`` selected by the flags, or None (fixed) — flag
     validation lives in ``RetargetRule.from_params``; here only the
@@ -178,7 +191,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--amount", type=int, required=True)
     p.add_argument(
         "--fee",
-        default="1",
+        type=_fee_arg,
+        default=1,
         help="fee units, or 'auto' to price at the node's recent "
         "confirmed-fee median (floor 1)",
     )
@@ -345,6 +359,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-compact-gossip",
         action="store_true",
         help="children push full BLOCK frames instead of compact blocks",
+    )
+    p.add_argument(
+        "--discover",
+        action="store_true",
+        help="bootstrap the topology via peer discovery: every node dials "
+        "ONLY node 0 and must find the rest through GETADDR/ADDR (vs the "
+        "default statically configured full mesh)",
     )
     _add_retarget(p)
 
@@ -675,7 +696,7 @@ def cmd_tx(args) -> int:
             )
             fee = max(1, stats.p50)
         else:
-            fee = int(args.fee)
+            fee = args.fee
         seq = args.seq
         if seq is None:
             # Wallet convenience: consensus wants the exact next nonce, so
@@ -1472,7 +1493,12 @@ def cmd_net(args) -> int:
             ]
         if args.no_compact_gossip:
             cmd += ["--no-compact-gossip"]
-        peers = [f"127.0.0.1:{p}" for p in ports[:i]]
+        if args.discover:
+            # One seed only; discovery must assemble the mesh.
+            peers = [f"127.0.0.1:{ports[0]}"] if i else []
+            cmd += ["--target-peers", str(args.nodes - 1)]
+        else:
+            peers = [f"127.0.0.1:{p}" for p in ports[:i]]
         if peers:
             cmd += ["--peers", *peers]
         procs.append(

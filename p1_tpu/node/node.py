@@ -47,6 +47,10 @@ ADDR_REPLY_MAX = 64
 DISCOVERY_INTERVAL_S = 1.0
 #: Minimum spacing between repeat GETADDR broadcasts while under target.
 READDR_INTERVAL_S = 30.0
+#: Server-side cap on a GETFEES sample window — like SYNC_BATCH /
+#: HEADERS_BATCH, a peer must not be able to drive O(chain) scans on the
+#: event loop by asking big.
+FEE_WINDOW_MAX = 1024
 #: Pending compact-block reconstructions awaiting a BLOCKTXN reply.  Small
 #: and FIFO-capped: entries exist only for the one GETBLOCKTXN round trip;
 #: anything stranded (peer died mid-answer) is evicted by newer blocks and
@@ -665,7 +669,7 @@ class Node:
             await self._handle_blocktxn(body, peer)
         elif mtype is MsgType.GETFEES:
             # Wallet fee query: confirmed-fee percentiles at our tip.
-            stats = self.chain.fee_stats(body or 32)
+            stats = self.chain.fee_stats(min(body or 32, FEE_WINDOW_MAX))
             await self._send_guarded(
                 peer,
                 protocol.encode_fees(

@@ -74,6 +74,17 @@ class TestCli:
         assert out["converged"], out
         assert out["height"] >= 1
 
+    def test_net_discover_bootstrap(self):
+        """Config 4 with the topology assembled by peer discovery: every
+        node knows only the seed, and the net must still converge."""
+        out = _run(
+            "net", "--nodes", "3", "--difficulty", "12", "--duration", "4",
+            "--chunk", "16384", "--base-port", "30444", "--discover",
+            timeout=200,
+        )
+        assert out["converged"], out
+        assert out["height"] >= 1
+
     def test_keygen_tx_mine_audit_e2e(self, tmp_path):
         """The full currency drive, CLI only: keygen two identities, mine
         to alice's account, alice pays bob with a SIGNED tx, audit the
