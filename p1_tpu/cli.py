@@ -1558,6 +1558,9 @@ def cmd_net(args) -> int:
         "compact_tx_fetched_total": sum(
             s["compact"]["tx_fetched"] for s in statuses
         ),
+        "wire_bytes_total": sum(
+            s["wire"]["bytes_sent"] for s in statuses
+        ),
         # Network-level propagation delay (gossip send -> accept), the
         # worst node's view: median of per-node medians would hide a slow
         # peer, so report the max median and the max p95 across nodes.

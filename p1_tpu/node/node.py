@@ -90,6 +90,11 @@ class NodeMetrics:
     cblock_tx_hits: int = 0
     cblock_tx_fetched: int = 0
     cblock_bytes_saved: int = 0
+    #: Actual p2p wire traffic (frame payloads + 4-byte length prefixes),
+    #: counted at the one send choke point (_Peer.send) and the session
+    #: read loop — what the compact-relay savings are measured against.
+    bytes_sent: int = 0
+    bytes_received: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -124,9 +129,15 @@ class _PendingCompact:
 
 
 class _Peer:
-    def __init__(self, writer: asyncio.StreamWriter, label: str):
+    def __init__(
+        self,
+        writer: asyncio.StreamWriter,
+        label: str,
+        metrics: NodeMetrics | None = None,
+    ):
         self.writer = writer
         self.label = label
+        self.metrics = metrics
         self.synced_once = False
         #: The peer's advertised listening address (peername host + HELLO
         #: listen port), once the handshake ran; None for non-listening
@@ -159,6 +170,10 @@ class _Peer:
 
     async def send(self, payload: bytes) -> None:
         await protocol.write_frame(self.writer, payload)
+        # Count only after the write+drain completed: a failed/timed-out
+        # send never reaches the wire and must not inflate the total.
+        if self.metrics is not None:
+            self.metrics.bytes_sent += len(payload) + 4
 
 
 class Node:
@@ -488,7 +503,7 @@ class Node:
         """Run one peer session to completion.  Returns whether the peer
         ever completed the handshake and registered — False means the
         address is not worth redialing (discovery forgets it)."""
-        peer = _Peer(writer, label)
+        peer = _Peer(writer, label, self.metrics)
         peer.dial_addr = dial_addr
         registered = False
         try:
@@ -496,6 +511,7 @@ class Node:
                 raise ValueError(f"peer limit {MAX_PEERS} reached")
             await peer.send(self._hello())
             payload = await protocol.read_frame(reader)
+            self.metrics.bytes_received += len(payload) + 4
             mtype, hello = protocol.decode(payload)
             if mtype is not MsgType.HELLO:
                 raise ValueError("expected HELLO")
@@ -539,6 +555,7 @@ class Node:
                 await peer.send(protocol.encode_getmempool())
             while self._running:
                 payload = await protocol.read_frame(reader)
+                self.metrics.bytes_received += len(payload) + 4
                 await self._dispatch(peer, payload)
         except (
             asyncio.IncompleteReadError,
@@ -1029,6 +1046,10 @@ class Node:
                 "tx_hits": self.metrics.cblock_tx_hits,
                 "tx_fetched": self.metrics.cblock_tx_fetched,
                 "bytes_saved": self.metrics.cblock_bytes_saved,
+            },
+            "wire": {
+                "bytes_sent": self.metrics.bytes_sent,
+                "bytes_received": self.metrics.bytes_received,
             },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly

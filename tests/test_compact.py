@@ -132,6 +132,9 @@ class TestRelay:
                 assert a.metrics.cblock_tx_fetched == 0
                 assert b.metrics.cblocks_sent >= 1
                 assert b.metrics.cblock_bytes_saved > 0
+                # Wire accounting runs at the send/read choke points.
+                assert b.metrics.bytes_sent > 0
+                assert a.metrics.bytes_received > 0
                 # The confirmed spends actually connected (consensus ran).
                 assert a.chain.balance(account("bob")) >= 3
             finally:
