@@ -168,6 +168,13 @@ def build_parser() -> argparse.ArgumentParser:
         "preference; compact and full nodes interoperate)",
     )
     p.add_argument(
+        "--mempool-ttl",
+        type=float,
+        default=3600.0,
+        help="drop pool transactions older than this many seconds "
+        "(hygiene for unmineable spends; 0 = never)",
+    )
+    p.add_argument(
         "--target-peers",
         type=int,
         default=0,
@@ -616,6 +623,7 @@ async def _run_node(args, miner=None) -> int:
         target_spacing=getattr(args, "target_spacing", 0),
         compact_gossip=not getattr(args, "no_compact_gossip", False),
         target_peers=getattr(args, "target_peers", 0),
+        mempool_ttl_s=getattr(args, "mempool_ttl", 3600.0),
     )
     node = Node(config, miner=miner)
     await node.start()

@@ -35,6 +35,10 @@ class NodeConfig:
     #: reconstruct from their mempool and fetch only what they lack.
     #: Local preference, not a chain parameter — mixed nets interoperate.
     compact_gossip: bool = True
+    #: Age after which a pending transaction is dropped from the pool
+    #: (hygiene — an unmineable spend must not occupy capacity forever;
+    #: the owner can always rebroadcast).  0 disables expiry.
+    mempool_ttl_s: float = 3600.0
     #: Peer discovery out-degree: when > 0, the node dials addresses
     #: learned via GETADDR/ADDR gossip until it holds this many
     #: connections — one seed peer bootstraps the whole network.  0 (the

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import bisect
 import collections
+import time
 
 from p1_tpu.core.block import Block
 from p1_tpu.core.tx import Transaction
@@ -78,6 +79,11 @@ class Mempool:
         #: balance-blind, exactly as before.
         self.balance_of = balance_of
         self._txs: dict[bytes, Transaction] = {}  # insertion-ordered
+        #: txid -> monotonic admission time, for age-based expiry
+        #: (``expire``): a transfer that cannot mine — gapped seq, drained
+        #: balance, owner walked away — must not occupy pool capacity
+        #: forever.  Kept in lockstep with ``_txs``.
+        self._admitted_at: dict[bytes, float] = {}
         self._by_slot: dict[tuple[str, int], bytes] = {}  # (sender, seq) -> txid
         #: sender -> sum(amount + fee) over its pending transactions;
         #: maintained on every add/replace/evict so the affordability
@@ -163,6 +169,7 @@ class Mempool:
         if incumbent is not None:
             self._drop(self._txs[incumbent])
         self._txs[txid] = tx
+        self._admitted_at[txid] = time.monotonic()
         self._by_slot[slot] = txid
         self._pending_debit[tx.sender] = (
             self._pending_debit.get(tx.sender, 0) + tx.amount + tx.fee
@@ -175,6 +182,7 @@ class Mempool:
         sync index."""
         txid = tx.txid()
         self._txs.pop(txid, None)
+        self._admitted_at.pop(txid, None)
         d = self._pending_debit.get(tx.sender, 0) - (tx.amount + tx.fee)
         if d > 0:
             self._pending_debit[tx.sender] = d
@@ -202,6 +210,30 @@ class Mempool:
             self._confirmed_slots.move_to_end(slot)
             while len(self._confirmed_slots) > CONFIRMED_SLOT_WINDOW:
                 self._confirmed_slots.popitem(last=False)
+
+    def expire(self, max_age_s: float, now: float | None = None) -> int:
+        """Drop transactions admitted more than ``max_age_s`` ago; return
+        how many.  Pool hygiene, not consensus: an expired transfer's
+        signature stays valid and its owner can rebroadcast — but a spend
+        that has sat unmineable (gapped seq, drained balance) past any
+        realistic confirmation horizon should stop occupying capacity and
+        sync bandwidth.  ``now`` is injectable for deterministic tests.
+        """
+        import time
+
+        now = time.monotonic() if now is None else now
+        stale = [
+            txid
+            for txid, t in self._admitted_at.items()
+            if now - t > max_age_s
+        ]
+        for txid in stale:
+            tx = self._txs.get(txid)
+            if tx is None:
+                continue
+            self._by_slot.pop((tx.sender, tx.seq), None)
+            self._drop(tx)
+        return len(stale)
 
     def pending_next_seq(self, sender: str, floor: int) -> int:
         """The seq a NEW transfer from ``sender`` should carry: ``floor``

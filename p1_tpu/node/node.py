@@ -170,8 +170,11 @@ class _Peer:
 
     async def send(self, payload: bytes) -> None:
         await protocol.write_frame(self.writer, payload)
-        # Count only after the write+drain completed: a failed/timed-out
-        # send never reaches the wire and must not inflate the total.
+        # Counted after write+drain: failed sends don't inflate the total.
+        # Known slack: a send cancelled between write and drain (guarded
+        # timeout) may still be flushed by the transport and reach the
+        # peer uncounted — the figure is "completed send calls", a slight
+        # UNDERcount under peer stalls, never an overcount.
         if self.metrics is not None:
             self.metrics.bytes_sent += len(payload) + 4
 
@@ -300,6 +303,8 @@ class Node:
             self._tasks.append(asyncio.create_task(self._dial_loop(host, port)))
         if self.config.target_peers > 0:
             self._tasks.append(asyncio.create_task(self._discovery_loop()))
+        if self.config.mempool_ttl_s > 0:
+            self._tasks.append(asyncio.create_task(self._housekeeping_loop()))
         if self.config.mine:
             self.start_mining()
 
@@ -485,6 +490,16 @@ class Node:
                 # would otherwise chatter GETADDR every tick forever.
                 last_readdr = now
                 await self._gossip(protocol.encode_getaddr())
+
+    async def _housekeeping_loop(self) -> None:
+        """Periodic pool hygiene: expire transactions that have sat
+        unmineable past the configured TTL (mempool.expire)."""
+        interval = max(1.0, min(30.0, self.config.mempool_ttl_s / 4))
+        while self._running:
+            await asyncio.sleep(interval)
+            dropped = self.mempool.expire(self.config.mempool_ttl_s)
+            if dropped:
+                log.info("expired %d stale mempool transactions", dropped)
 
     def _learn_addr(self, addr: tuple[str, int]) -> None:
         """Merge one address into the bounded book (refreshes recency)."""

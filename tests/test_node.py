@@ -601,6 +601,31 @@ class TestMempoolUnit:
         assert pool.add(stx("alice", "bob", 5, 1, 8, difficulty=DIFF))
         assert len(pool) == 2
 
+    def test_expire_drops_only_stale_and_reopens_state(self):
+        import time
+
+        from p1_tpu.mempool import Mempool
+
+        pool = Mempool()
+        old = stx("alice", "bob", 5, 1, 0, difficulty=DIFF)
+        fresh = stx("carol", "bob", 5, 1, 0, difficulty=DIFF)
+        assert pool.add(old)
+        assert pool.add(fresh)
+        # Backdate `old` past the TTL; `fresh` stays current.
+        pool._admitted_at[old.txid()] -= 100.0
+        assert pool.expire(10.0) == 1
+        assert old.txid() not in pool and fresh.txid() in pool
+        assert len(pool) == 1
+        # Every index released: the slot reopens (a rebroadcast with the
+        # SAME fee re-enters — no RBF bar from a ghost incumbent), the
+        # debit is gone, and the sync pager no longer serves it.
+        assert pool.add(old)
+        page, _ = pool.sync_page(None, 10)
+        assert old.txid() in {t.txid() for t in page}
+        assert pool.expire(10.0, now=time.monotonic() + 20) == 2
+        assert len(pool) == 0 and pool._pending_debit == {}
+        assert pool.sync_page(None, 10) == ([], False)
+
     def test_confirmation_evicts_slot_rivals(self):
         from p1_tpu.core.block import Block, merkle_root
         from p1_tpu.core.header import BlockHeader
