@@ -219,21 +219,25 @@ class Mempool:
         realistic confirmation horizon should stop occupying capacity and
         sync bandwidth.  ``now`` is injectable for deterministic tests.
         """
-        import time
-
         now = time.monotonic() if now is None else now
         stale = [
             txid
             for txid, t in self._admitted_at.items()
             if now - t > max_age_s
         ]
+        dropped = 0
         for txid in stale:
             tx = self._txs.get(txid)
             if tx is None:
+                # Lockstep with _txs is a maintained invariant; if a future
+                # edit breaks it, clear the orphaned stamp here rather than
+                # re-reporting the same ghost on every pass.
+                self._admitted_at.pop(txid, None)
                 continue
             self._by_slot.pop((tx.sender, tx.seq), None)
             self._drop(tx)
-        return len(stale)
+            dropped += 1
+        return dropped
 
     def pending_next_seq(self, sender: str, floor: int) -> int:
         """The seq a NEW transfer from ``sender`` should carry: ``floor``

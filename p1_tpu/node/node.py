@@ -68,6 +68,23 @@ MEMPOOL_SYNC_TXS = 2000
 MEMPOOL_SYNC_BYTES = 2 << 20
 RECONNECT_DELAY_S = 0.5
 GOSSIP_SEND_TIMEOUT_S = 5.0
+#: Misbehavior scoring: a host that commits this many protocol violations
+#: (malformed frames, wrong chain/version, bad handshake) within the
+#: window is refused at accept time for the ban duration.  Violations are
+#: PEER-side faults only — our own refusals (peer cap, self-connect)
+#: never count against the remote.
+BAN_SCORE_THRESHOLD = 3
+BAN_WINDOW_S = 60.0
+BAN_DURATION_S = 30.0
+#: Bound on tracked misbehaving hosts: an attacker cycling source
+#: addresses must not grow node memory one deque per address forever —
+#: on overflow, stale entries are pruned first, then oldest-arbitrary.
+MAX_TRACKED_HOSTS = 4096
+
+
+class _Refused(Exception):
+    """Session ended by OUR policy (peer cap, self-connect) — ends the
+    connection like a ValueError but never scores against the remote."""
 
 
 @dataclasses.dataclass
@@ -221,6 +238,9 @@ class Node:
         self._peers: dict[asyncio.StreamWriter, _Peer] = {}
         #: Discovery dials in flight (dedup against the next tick).
         self._dialing: set[tuple[str, int]] = set()
+        #: Misbehavior scoring: host -> recent violation times / ban expiry.
+        self._violations: dict[str, collections.deque] = {}
+        self._banned_until: dict[str, float] = {}
         #: (block hash, announcing peer) -> partially reconstructed compact
         #: block (see ``_handle_cblock``); FIFO-capped.  Keyed per PEER so
         #: a front-runner pushing a tampered txid list for a real block
@@ -388,9 +408,57 @@ class Node:
             )
         )
 
+    def _is_banned(self, host: str) -> bool:
+        until = self._banned_until.get(host)
+        if until is None:
+            return False
+        if time.monotonic() >= until:
+            del self._banned_until[host]
+            return False
+        return True
+
+    def _record_violation(self, host: str) -> None:
+        now = time.monotonic()
+        window = self._violations.setdefault(host, collections.deque())
+        window.append(now)
+        while window and now - window[0] > BAN_WINDOW_S:
+            window.popleft()
+        if len(window) >= BAN_SCORE_THRESHOLD:
+            self._banned_until[host] = now + BAN_DURATION_S
+            window.clear()
+            log.warning(
+                "banning %s for %.0fs after repeated protocol violations",
+                host,
+                BAN_DURATION_S,
+            )
+        # Keep the tracking itself bounded (it guards against hostile
+        # input — it must not be a memory hole for address-cycling
+        # attackers): prune stale entries first, oldest-arbitrary after.
+        if len(self._violations) > MAX_TRACKED_HOSTS:
+            cutoff = now - BAN_WINDOW_S
+            self._violations = {
+                h: w
+                for h, w in self._violations.items()
+                if w and w[-1] >= cutoff
+            }
+            while len(self._violations) > MAX_TRACKED_HOSTS:
+                del self._violations[next(iter(self._violations))]
+        if len(self._banned_until) > MAX_TRACKED_HOSTS:
+            self._banned_until = {
+                h: u for h, u in self._banned_until.items() if u > now
+            }
+            while len(self._banned_until) > MAX_TRACKED_HOSTS:
+                del self._banned_until[next(iter(self._banned_until))]
+
     async def _on_inbound(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        peername = writer.get_extra_info("peername")
+        if peername and self._is_banned(peername[0]):
+            # Refused before any handshake work: a banned flooder costs us
+            # one accept + close, nothing more.
+            writer.close()
+            return
         task = asyncio.current_task()
         assert task is not None
         self._sessions.add(task)
@@ -472,6 +540,8 @@ class Node:
                     break
                 if addr in connected or addr in self._dialing:
                     continue
+                if self._is_banned(addr[0]):
+                    continue  # don't court a host we're refusing
                 self._dialing.add(addr)
                 task = asyncio.create_task(self._dial_once(*addr))
                 self._sessions.add(task)
@@ -523,7 +593,7 @@ class Node:
         registered = False
         try:
             if len(self._peers) >= MAX_PEERS:
-                raise ValueError(f"peer limit {MAX_PEERS} reached")
+                raise _Refused(f"peer limit {MAX_PEERS} reached")
             await peer.send(self._hello())
             payload = await protocol.read_frame(reader)
             self.metrics.bytes_received += len(payload) + 4
@@ -537,12 +607,12 @@ class Node:
                 # it from peers' ADDR gossip) — drop it for good.
                 if dial_addr is not None:
                     self._known_addrs.pop(dial_addr, None)
-                raise ValueError("connected to self")
+                raise _Refused("connected to self")
             if len(self._peers) >= MAX_PEERS:
                 # Re-check at registration: the pre-handshake check above
                 # races across the two awaits (a flood of simultaneous
                 # dials all pass it while _peers is still small).
-                raise ValueError(f"peer limit {MAX_PEERS} reached")
+                raise _Refused(f"peer limit {MAX_PEERS} reached")
             self._peers[writer] = peer
             registered = True
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
@@ -577,8 +647,16 @@ class Node:
             ConnectionError,
             ValueError,
             OSError,
+            _Refused,
         ) as e:
             log.info("peer %s closed: %s", label, e)
+            if isinstance(e, ValueError):
+                # Peer-side protocol violation (malformed frame, wrong
+                # chain/version, bad handshake) — score it; repeat
+                # offenders get refused at accept time for a cooldown.
+                peername = writer.get_extra_info("peername")
+                if peername:
+                    self._record_violation(peername[0])
         finally:
             self._peers.pop(writer, None)
             writer.close()
@@ -1046,6 +1124,11 @@ class Node:
             "tip": self.chain.tip_hash.hex(),
             "peers": self.peer_count(),
             "known_addrs": len(self._known_addrs),
+            "banned_hosts": sum(
+                1
+                for until in self._banned_until.values()
+                if until > time.monotonic()
+            ),
             "mempool": len(self.mempool),
             "hashes_per_sec": round(self.metrics.hashes_per_sec),
             "time_to_block_s": round(self.metrics.last_block_time_s, 3),

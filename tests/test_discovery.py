@@ -43,6 +43,67 @@ class TestWire:
             protocol.decode(payload)
 
 
+class TestBanScore:
+    def test_repeat_violations_ban_then_expire(self):
+        """Three malformed-frame sessions within the window get the host
+        refused at accept time; the ban lapses on its own."""
+        import time as _time
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                for _ in range(3):
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", node.port
+                    )
+                    # A framed unknown message type = protocol violation.
+                    writer.write((4).to_bytes(4, "big") + b"\x63zzz")
+                    await writer.drain()
+                    await reader.read()  # node HELLOs, then drops us
+                    writer.close()
+                assert "127.0.0.1" in node._banned_until
+                # Banned: the accept path closes before any HELLO.
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.port
+                )
+                assert await reader.read() == b""
+                writer.close()
+                # Lapse the ban: service resumes (HELLO bytes flow again).
+                node._banned_until["127.0.0.1"] = _time.monotonic() - 1
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.port
+                )
+                frame = await protocol.read_frame(reader)
+                mtype, _ = protocol.decode(frame)
+                assert mtype is MsgType.HELLO
+                writer.close()
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_own_refusals_never_score_the_remote(self):
+        """A self-connect (our policy, not the peer's fault) must not
+        creep toward a ban of the host."""
+
+        async def scenario():
+            node = Node(_config(target_peers=2))
+            await node.start()
+            try:
+                own = ("127.0.0.1", node.port)
+                node._learn_addr(own)
+                assert await wait_until(
+                    lambda: own not in node._known_addrs, timeout=15
+                )
+                assert not node._violations.get("127.0.0.1")
+                assert "127.0.0.1" not in node._banned_until
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+
 class TestDiscovery:
     def test_one_seed_bootstraps_a_full_mesh(self):
         """Classic bootstrap: A and B each know only the seed; discovery
