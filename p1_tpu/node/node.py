@@ -22,8 +22,10 @@ from __future__ import annotations
 import asyncio
 import collections
 import dataclasses
+import json
 import logging
 import time
+from pathlib import Path
 
 from p1_tpu.chain import AddStatus, Chain, ChainStore
 from p1_tpu.config import NodeConfig
@@ -271,7 +273,59 @@ class Node:
 
     # -- lifecycle -------------------------------------------------------
 
+    def _addr_book_path(self):
+        return (
+            Path(f"{self.config.store_path}.addrs")
+            if self.config.store_path
+            else None
+        )
+
+    def _load_addr_book(self) -> None:
+        """Resume discovery state: a restarting node re-joins the network
+        it knew instead of depending on its seed peers being alive."""
+        path = self._addr_book_path()
+        if path is None or not path.exists():
+            return
+        try:
+            entries = json.loads(path.read_text())
+        except (ValueError, OSError) as e:
+            log.warning("ignoring unreadable address book %s: %s", path, e)
+            return
+        if not isinstance(entries, list):
+            # Parsable-but-wrong content is just as corrupt as unparsable
+            # bytes — the book is a cache, never worth failing startup.
+            log.warning("ignoring malformed address book %s", path)
+            return
+        for entry in entries[:MAX_KNOWN_ADDRS]:
+            try:
+                host, port = entry
+                # Mirror the ADDR wire rules (protocol.encode_addr): a
+                # row the codec would refuse must not enter the book, or
+                # every later GETADDR reply dies on our own encode.
+                if (
+                    isinstance(host, str)
+                    and 0 < len(host.encode("utf-8")) <= 255
+                    and 0 < int(port) <= 0xFFFF
+                ):
+                    self._known_addrs.setdefault((host, int(port)), 0.0)
+            except (TypeError, ValueError):
+                continue  # one bad row must not poison the rest
+
+    def _save_addr_book(self) -> None:
+        path = self._addr_book_path()
+        if path is None:
+            return
+        try:
+            tmp = path.with_suffix(".addrs.tmp")
+            tmp.write_text(
+                json.dumps([list(a) for a in self._known_addrs])
+            )
+            tmp.replace(path)  # atomic: never a torn book
+        except OSError as e:
+            log.warning("could not persist address book %s: %s", path, e)
+
     async def start(self) -> None:
+        self._load_addr_book()
         if self.store is not None:
             # Hold the store's writer lock for the node's whole lifetime
             # (not just from the first append): a second node on the same
@@ -350,6 +404,7 @@ class Node:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        self._save_addr_book()
         if self.store is not None:
             self.store.close()
 
@@ -599,9 +654,9 @@ class Node:
             self.metrics.bytes_received += len(payload) + 4
             mtype, hello = protocol.decode(payload)
             if mtype is not MsgType.HELLO:
-                raise ValueError("expected HELLO")
+                raise protocol.ProtocolError("expected HELLO")
             if hello.genesis_hash != self.chain.genesis.block_hash():
-                raise ValueError("genesis mismatch")
+                raise protocol.ProtocolError("genesis mismatch")
             if hello.nonce and hello.nonce == self.instance_nonce:
                 # We dialed our own listening address (the book can learn
                 # it from peers' ADDR gossip) — drop it for good.
@@ -650,10 +705,12 @@ class Node:
             _Refused,
         ) as e:
             log.info("peer %s closed: %s", label, e)
-            if isinstance(e, ValueError):
+            if isinstance(e, protocol.ProtocolError):
                 # Peer-side protocol violation (malformed frame, wrong
                 # chain/version, bad handshake) — score it; repeat
                 # offenders get refused at accept time for a cooldown.
+                # Plain ValueErrors stay unscored: they can originate in
+                # OUR encode paths while answering an innocent peer.
                 peername = writer.get_extra_info("peername")
                 if peername:
                     self._record_violation(peername[0])

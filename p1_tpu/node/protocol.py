@@ -97,6 +97,14 @@ from p1_tpu.core.block import Block
 from p1_tpu.core.header import HEADER_SIZE, BlockHeader
 from p1_tpu.core.tx import Transaction
 
+class ProtocolError(ValueError):
+    """The peer sent bytes that violate the protocol (malformed frame,
+    wrong version, unparsable payload).  A dedicated subclass so the
+    node's misbehavior scoring can tell PEER-side faults apart from
+    ValueErrors raised by our own encode paths — only the former may
+    count against the remote."""
+
+
 MAX_FRAME = 32 << 20  # hard cap against hostile length prefixes
 _LEN = struct.Struct(">I")
 #: Wire protocol version, carried in HELLO.  Bump when the message surface
@@ -403,8 +411,20 @@ def encode_mempool(raw_txs: list[bytes], more: bool = False) -> bytes:
 
 
 def decode(payload: bytes):
-    """(MsgType, decoded body) for one frame payload; raises ValueError on
-    malformed input — the peer loop treats that as a protocol violation."""
+    """(MsgType, decoded body) for one frame payload; raises
+    ``ProtocolError`` (a ValueError) on malformed input — the peer loop
+    treats that as a scorable protocol violation."""
+    try:
+        return _decode(payload)
+    except ProtocolError:
+        raise
+    except ValueError as e:
+        # Anything the nested deserializers reject is equally the peer's
+        # bytes at fault — normalize so the caller scores uniformly.
+        raise ProtocolError(str(e)) from e
+
+
+def _decode(payload: bytes):
     if not payload:
         raise ValueError("empty frame")
     try:

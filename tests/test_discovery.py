@@ -204,6 +204,32 @@ class TestDiscovery:
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
 
+    def test_address_book_survives_restart(self, tmp_path):
+        async def scenario():
+            store = str(tmp_path / "chain.dat")
+            node = Node(_config(store_path=store))
+            await node.start()
+            node._learn_addr(("10.1.2.3", 9444))
+            node._learn_addr(("10.1.2.4", 9445))
+            await node.stop()  # persists <store>.addrs atomically
+            reborn = Node(_config(store_path=store))
+            await reborn.start()
+            try:
+                assert ("10.1.2.3", 9444) in reborn._known_addrs
+                assert ("10.1.2.4", 9445) in reborn._known_addrs
+            finally:
+                await reborn.stop()
+            # A corrupt book is ignored, never fatal.
+            (tmp_path / "chain.dat.addrs").write_text("not json{")
+            third = Node(_config(store_path=store))
+            await third.start()
+            try:
+                assert ("10.1.2.3", 9444) not in third._known_addrs
+            finally:
+                await third.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
     def test_discovery_off_by_default(self):
         async def scenario():
             a = Node(_config())
