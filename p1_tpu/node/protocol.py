@@ -675,5 +675,7 @@ async def read_frame(reader: asyncio.StreamReader) -> bytes:
     header = await reader.readexactly(_LEN.size)
     (n,) = _LEN.unpack(header)
     if n > MAX_FRAME:
-        raise ValueError(f"frame of {n} bytes exceeds cap")
+        # Purely peer-supplied bytes: a hostile length prefix is the
+        # canonical scorable violation (node misbehavior bans).
+        raise ProtocolError(f"frame of {n} bytes exceeds cap")
     return await reader.readexactly(n)

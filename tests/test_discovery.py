@@ -83,6 +83,28 @@ class TestBanScore:
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
 
+    def test_oversized_length_prefix_scores_too(self):
+        """A hostile length prefix (> MAX_FRAME) is the canonical
+        violation the cap exists for — it must count toward a ban."""
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                for _ in range(3):
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", node.port
+                    )
+                    writer.write((64 << 20).to_bytes(4, "big"))
+                    await writer.drain()
+                    await reader.read()
+                    writer.close()
+                assert "127.0.0.1" in node._banned_until
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
     def test_own_refusals_never_score_the_remote(self):
         """A self-connect (our policy, not the peer's fault) must not
         creep toward a ban of the host."""

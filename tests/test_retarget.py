@@ -231,6 +231,93 @@ class TestChainEnforcement:
                 ChainStore(path).load_chain(DIFF)
 
 
+class TestRetargetProperty:
+    """Randomized DAG property test (extends TestForkChoiceProperty to
+    moving difficulty): for ANY block DAG obeying the retarget rule,
+    delivered in ANY order, every node converges to the same tip, that
+    tip is the brute-force max-cumulative-work block (2^difficulty per
+    block — no longer equivalent to height!), and rule-violating blocks
+    (wrong difficulty, frozen timestamps) never connect anywhere."""
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_random_dag_with_rule_converges(self, seed):
+        import random as rnd
+
+        rng = rnd.Random(seed)
+        rule = RetargetRule(window=3, spacing=5, max_adjust=1)
+        base = 2
+        genesis = make_genesis(base, rule)
+        ghash = genesis.block_hash()
+        parents = {}
+        heights = {ghash: 0}
+        diffs = {ghash: base}
+        stamps = {ghash: genesis.header.timestamp}
+        works = {ghash: 1 << base}
+        blocks = {ghash: genesis}
+
+        def required(parent_hash: bytes) -> int:
+            h = heights[parent_hash] + 1
+            if h % rule.window != 0:
+                return diffs[parent_hash]
+            anchor = parent_hash
+            for _ in range(rule.window - 1):
+                anchor = parents[anchor]
+            span = stamps[parent_hash] - stamps[anchor]
+            return rule.adjusted(diffs[parent_hash], span)
+
+        valid = []
+        invalid = []
+        for i in range(40):
+            parent = rng.choice(list(blocks))
+            d = required(parent)
+            # Offsets straddle the 5 s target so the walk moves BOTH ways
+            # (window span 2..24 vs expected 10: ±1 bit boundaries).
+            ts = stamps[parent] + rng.randint(1, 12)
+            tx = Transaction("coinbase", f"m{seed}", 50, 0, i)
+            child = _child(blocks[parent], d, ts, txs=(tx,))
+            ch = child.block_hash()
+            parents[ch] = parent
+            heights[ch] = heights[parent] + 1
+            diffs[ch] = d
+            stamps[ch] = ts
+            works[ch] = works[parent] + (1 << d)
+            blocks[ch] = child
+            valid.append(child)
+            if i % 9 == 0 and d >= 2:
+                # A sibling claiming the WRONG difficulty (one bit easy —
+                # only meaningful above the floor, where d-1 != d)...
+                invalid.append(
+                    _child(blocks[parent], d - 1, ts + 1, txs=(tx,))
+                )
+            if i % 13 == 0:
+                # ...and one freezing its parent's timestamp.
+                invalid.append(
+                    _child(blocks[parent], d, stamps[parent], txs=(tx,))
+                )
+
+        best_work = max(works.values())
+        expect_tip = min(
+            h for h, w in works.items() if w == best_work
+        )
+        bad_hashes = {b.block_hash() for b in invalid}
+
+        tips = set()
+        for trial in range(3):
+            order = valid + invalid
+            rng.shuffle(order)
+            chain = Chain(base, genesis=genesis, retarget=rule)
+            for block in order:
+                chain.add_block(block)
+            # Every valid block connected; no invalid one ever did.
+            assert len(chain) == 1 + len(valid)
+            assert not any(h in chain for h in bad_hashes)
+            # The tip is the brute-force most-work block, and the chain's
+            # own next-difficulty agrees with an independent recomputation.
+            tips.add(chain.tip_hash)
+            assert chain.next_difficulty() == required(chain.tip_hash)
+        assert tips == {expect_tip}
+
+
 class TestRetargetingNode:
     def test_live_node_climbs_difficulty_and_serves_wallet(self):
         from test_node import _config, wait_until
