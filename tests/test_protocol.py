@@ -121,6 +121,17 @@ class TestMalformed:
         with pytest.raises(ValueError, match="trailing|truncated"):
             protocol.decode(good + b"\x00")
 
+    def test_pure_random_bytes_never_crash(self):
+        # Beyond mutations of valid frames: completely arbitrary payloads
+        # across every length bucket must decode or raise ValueError.
+        rng = random.Random(99)
+        for _ in range(3000):
+            buf = rng.randbytes(rng.randrange(0, 240))
+            try:
+                protocol.decode(buf)
+            except ValueError:
+                pass  # the contract: reject, don't crash
+
     def test_mutation_fuzz_never_crashes(self):
         # Truncations and byte flips of valid frames must either decode to
         # SOMETHING or raise ValueError -- never any other exception.
