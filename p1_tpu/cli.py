@@ -182,6 +182,27 @@ def build_parser() -> argparse.ArgumentParser:
         "GETADDR/ADDR gossip until this many connections hold (0 = only "
         "the configured --peers; one seed peer bootstraps the rest)",
     )
+    p.add_argument(
+        "--handshake-timeout",
+        type=float,
+        default=10.0,
+        help="seconds a new connection gets to complete HELLO before "
+        "being reaped (liveness layer)",
+    )
+    p.add_argument(
+        "--ping-interval",
+        type=float,
+        default=60.0,
+        help="probe a peer with PING after this many seconds of silence; "
+        "any received frame counts as liveness",
+    )
+    p.add_argument(
+        "--pong-timeout",
+        type=float,
+        default=20.0,
+        help="seconds of continued silence after a PING probe before the "
+        "peer is evicted and its slot reused",
+    )
     _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
@@ -624,6 +645,9 @@ async def _run_node(args, miner=None) -> int:
         compact_gossip=not getattr(args, "no_compact_gossip", False),
         target_peers=getattr(args, "target_peers", 0),
         mempool_ttl_s=getattr(args, "mempool_ttl", 3600.0),
+        handshake_timeout_s=getattr(args, "handshake_timeout", 10.0),
+        ping_interval_s=getattr(args, "ping_interval", 60.0),
+        pong_timeout_s=getattr(args, "pong_timeout", 20.0),
     )
     node = Node(config, miner=miner)
     await node.start()

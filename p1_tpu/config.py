@@ -45,6 +45,16 @@ class NodeConfig:
     #: default) dials only the configured ``peers``; the address book and
     #: GETADDR serving stay on either way.
     target_peers: int = 0
+    #: Liveness deadlines (node/node.py peer sessions).  A connection must
+    #: complete its HELLO within ``handshake_timeout_s`` or it is reaped —
+    #: a pre-handshake socket holds node resources but proves nothing.
+    #: After the handshake, a peer silent for ``ping_interval_s`` gets a
+    #: PING probe; silence through another ``pong_timeout_s`` means
+    #: eviction and the ``MAX_PEERS`` slot is reused.  ANY received frame
+    #: counts as liveness, so chatty peers are never probed at all.
+    handshake_timeout_s: float = 10.0
+    ping_interval_s: float = 60.0
+    pong_timeout_s: float = 20.0
 
     def retarget_rule(self):
         """The chain's ``RetargetRule``, or None for fixed difficulty."""

@@ -49,6 +49,18 @@ async def _session(host: str, port: int, difficulty: int, retarget=None):
             pass
 
 
+async def _read_msg(reader, writer):
+    """One decoded frame, transparently answering keepalive PINGs — a
+    client mid-round (e.g. a long headers sync between requests) must
+    show liveness or the node's idle probe evicts it (node.py)."""
+    while True:
+        mtype, body = protocol.decode(await protocol.read_frame(reader))
+        if mtype is MsgType.PING:
+            await protocol.write_frame(writer, protocol.encode_pong(body))
+            continue
+        return mtype, body
+
+
 async def send_tx(
     host: str,
     port: int,
@@ -97,7 +109,7 @@ async def get_proof(
         ):
             await protocol.write_frame(writer, protocol.encode_getproof(txid))
             while True:
-                mtype, body = protocol.decode(await protocol.read_frame(reader))
+                mtype, body = await _read_msg(reader, writer)
                 if mtype is MsgType.PROOF:
                     return body
 
@@ -136,9 +148,7 @@ async def get_headers(
                     writer, protocol.encode_getheaders(locator_hashes(hashes))
                 )
                 while True:
-                    mtype, body = protocol.decode(
-                        await protocol.read_frame(reader)
-                    )
+                    mtype, body = await _read_msg(reader, writer)
                     if mtype is MsgType.HEADERS:
                         break
                 new = [h for h in body if h.block_hash() not in pos]
@@ -195,7 +205,7 @@ async def get_fees(
         ):
             await protocol.write_frame(writer, protocol.encode_getfees(window))
             while True:
-                mtype, body = protocol.decode(await protocol.read_frame(reader))
+                mtype, body = await _read_msg(reader, writer)
                 if mtype is MsgType.FEES:
                     return body
 
@@ -223,7 +233,7 @@ async def get_account(
         ):
             await protocol.write_frame(writer, protocol.encode_getaccount(account))
             while True:
-                mtype, body = protocol.decode(await protocol.read_frame(reader))
+                mtype, body = await _read_msg(reader, writer)
                 if mtype is MsgType.ACCOUNT:
                     return body
 

@@ -62,6 +62,13 @@ MAX_PENDING_CBLOCKS = 64
 #: writer buffers).  Gossip needs a handful of peers; a dialer flood past
 #: the cap is refused at handshake time.
 MAX_PEERS = 64
+#: Cap on inbound connections that have not yet completed HELLO.  A
+#: pre-handshake socket never enters ``_peers`` (so MAX_PEERS can't see
+#: it) yet holds a session task and transport buffers — without this
+#: bound an accept flood grows ``_sessions`` until the handshake timeout
+#: fires, and with none it grows forever.  Sized above any honest burst
+#: (a whole net restarting dials in well under this).
+MAX_HANDSHAKING = 32
 #: Byte budget for one BLOCKS reply — safely under protocol.MAX_FRAME so a
 #: sync reply is never a frame the receiver is guaranteed to reject.
 SYNC_BYTES = 8 << 20
@@ -265,6 +272,8 @@ class Node:
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
+        #: Inbound sessions still inside the HELLO exchange (MAX_HANDSHAKING).
+        self._handshaking = 0
         self._abort = None  # threading.Event of the in-flight search
         self._mine_task: asyncio.Task | None = None
         self._post_seal: asyncio.Task | None = None  # shielded seal handling
@@ -514,11 +523,17 @@ class Node:
             # one accept + close, nothing more.
             writer.close()
             return
+        if self._handshaking >= MAX_HANDSHAKING:
+            # Accept-flood guard: sockets that haven't proven anything yet
+            # may hold at most MAX_HANDSHAKING session slots between them.
+            # Cost of refusal: one accept + close.
+            writer.close()
+            return
         task = asyncio.current_task()
         assert task is not None
         self._sessions.add(task)
         try:
-            await self._peer_session(reader, writer, "in")
+            await self._peer_session(reader, writer, "in", inbound=True)
         finally:
             self._sessions.discard(task)
 
@@ -639,18 +654,41 @@ class Node:
         writer: asyncio.StreamWriter,
         label: str,
         dial_addr: tuple[str, int] | None = None,
+        inbound: bool = False,
     ) -> bool:
         """Run one peer session to completion.  Returns whether the peer
         ever completed the handshake and registered — False means the
-        address is not worth redialing (discovery forgets it)."""
+        address is not worth redialing (discovery forgets it).
+
+        Liveness contract (the layer every Bitcoin-family node carries):
+        the HELLO must arrive within ``handshake_timeout_s``; after that a
+        peer silent for ``ping_interval_s`` is probed with a PING and gets
+        ``pong_timeout_s`` more to show ANY frame before eviction.  So a
+        socket can hold one of the MAX_PEERS slots only while provably
+        alive, and a pre-handshake socket (counted in ``_handshaking``)
+        for at most the handshake window."""
         peer = _Peer(writer, label, self.metrics)
         peer.dial_addr = dial_addr
         registered = False
+        # All session reads go through one FrameReader: timeouts cancel
+        # reads at arbitrary awaits, and only a reader that keeps partial
+        # progress itself can resume at the same stream position (a plain
+        # read_frame cancelled between length prefix and body would desync
+        # the stream and mis-score the peer).
+        frames = protocol.FrameReader(reader)
+        if inbound:
+            self._handshaking += 1
         try:
             if len(self._peers) >= MAX_PEERS:
                 raise _Refused(f"peer limit {MAX_PEERS} reached")
             await peer.send(self._hello())
-            payload = await protocol.read_frame(reader)
+            # Deadline on the whole HELLO read: a socket that connects and
+            # goes quiet must not hold resources past this window.  A
+            # TimeoutError lands in TimeoutError ⊂ OSError below — reaped,
+            # not scored (slowness is not a protocol violation).
+            payload = await asyncio.wait_for(
+                frames.read(), timeout=self.config.handshake_timeout_s
+            )
             self.metrics.bytes_received += len(payload) + 4
             mtype, hello = protocol.decode(payload)
             if mtype is not MsgType.HELLO:
@@ -670,6 +708,9 @@ class Node:
                 raise _Refused(f"peer limit {MAX_PEERS} reached")
             self._peers[writer] = peer
             registered = True
+            if inbound:
+                self._handshaking -= 1
+                inbound = False  # the finally below must not double-count
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
             peer.hello_height = hello.tip_height
             if hello.listen_port:
@@ -693,8 +734,49 @@ class Node:
                 # gossip.
                 peer.mempool_requested = True
                 await peer.send(protocol.encode_getmempool())
+            ping_pending = False
             while self._running:
-                payload = await protocol.read_frame(reader)
+                # Idle probing: wait ping_interval_s for traffic; on
+                # silence send one PING and allow pong_timeout_s more.
+                # ANY frame proves liveness (resets the probe) — the PONG
+                # itself is never specifically required, so a peer busy
+                # streaming sync batches is never penalized for not
+                # answering promptly.  Byte-level progress counts too: a
+                # peer trickling one large frame over a slow link shows
+                # ``frames.progressed()`` at each timeout and is left
+                # alone — only true silence is probed and evicted.
+                timeout = (
+                    self.config.pong_timeout_s
+                    if ping_pending
+                    else self.config.ping_interval_s
+                )
+                try:
+                    payload = await asyncio.wait_for(
+                        frames.read(), timeout=timeout
+                    )
+                except TimeoutError:
+                    grace = (
+                        self.config.ping_interval_s
+                        + self.config.pong_timeout_s
+                    )
+                    if frames.progressed() and not frames.overdue(grace):
+                        ping_pending = False  # flowing, just slowly
+                        continue
+                    # Overdue trickle falls through to the probe path: one
+                    # more PING + pong_timeout, then eviction — same reap,
+                    # no misbehavior score (slowness is not a violation).
+                    if ping_pending:
+                        raise _Refused(
+                            f"peer idle past keepalive deadline "
+                            f"({self.config.ping_interval_s:.0f}s + "
+                            f"{self.config.pong_timeout_s:.0f}s probe)"
+                        ) from None
+                    ping_pending = True
+                    await self._send_guarded(
+                        peer, protocol.encode_ping(self.instance_nonce)
+                    )
+                    continue
+                ping_pending = False
                 self.metrics.bytes_received += len(payload) + 4
                 await self._dispatch(peer, payload)
         except (
@@ -715,6 +797,8 @@ class Node:
                 if peername:
                     self._record_violation(peername[0])
         finally:
+            if inbound:  # still mid-handshake: release the slot
+                self._handshaking -= 1
             self._peers.pop(writer, None)
             writer.close()
         return registered
@@ -878,6 +962,10 @@ class Node:
             await self._send_guarded(
                 peer, protocol.encode_proof(self.chain.tx_proof(body))
             )
+        elif mtype is MsgType.PING:
+            await self._send_guarded(peer, protocol.encode_pong(body))
+        elif mtype is MsgType.PONG:
+            pass  # arrival already reset the session's idle probe
         elif mtype in (MsgType.ACCOUNT, MsgType.PROOF):
             pass  # reply frames: meaningful to querying clients only
         elif mtype is MsgType.HELLO:

@@ -74,6 +74,15 @@ Messages:
              percentiles over transfers confirmed in the window + u32 tip
              height.  Confirmed fees only: what actually cleared, not the
              pending bid book.
+- PING:      u64 nonce — keepalive probe.  A node that has heard nothing
+             from a peer for its idle interval sends one; ANY frame (not
+             just the PONG) counts as liveness, so a busy peer never
+             wastes a round trip.  A peer that stays silent through the
+             probe's answer window is evicted and its slot reused — the
+             liveness layer every Bitcoin-family node carries, without
+             which 64 cheap silent sockets pin MAX_PEERS forever.
+- PONG:      u64 nonce echoed from the PING.  Tooling clients answer too
+             (node/client.py) so a slow SPV sync isn't evicted as dead.
 - GETHEADERS: u16 count + count * 32-byte locator hashes — headers-first
              sync for light clients (`p1 headers`): same locator
              semantics as GETBLOCKS, but the reply carries bare headers.
@@ -91,6 +100,7 @@ import asyncio
 import dataclasses
 import enum
 import struct
+import time
 
 from p1_tpu.chain.proof import TxProof
 from p1_tpu.core.block import Block
@@ -118,8 +128,9 @@ _LEN = struct.Struct(">I")
 #: "bad HELLO size".  v4 added compact block relay (CBLOCK/GETBLOCKTXN/
 #: BLOCKTXN); v5 headers-first sync (GETHEADERS/HEADERS); v6 peer
 #: discovery (GETADDR/ADDR + the HELLO instance nonce); v7 fee
-#: estimation (GETFEES/FEES).
-PROTOCOL_VERSION = 7
+#: estimation (GETFEES/FEES); v8 liveness (PING/PONG + handshake/idle
+#: deadlines — a v7 node would call the probe a protocol violation).
+PROTOCOL_VERSION = 8
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -144,6 +155,8 @@ class MsgType(enum.IntEnum):
     ADDR = 18
     GETFEES = 19
     FEES = 20
+    PING = 21
+    PONG = 22
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,8 +209,6 @@ def encode_hello(h: Hello) -> bytes:
 
 
 def encode_block(block: Block, sent_ts: float | None = None) -> bytes:
-    import time
-
     ts = time.time() if sent_ts is None else sent_ts
     return bytes([MsgType.BLOCK]) + struct.pack(">d", ts) + block.serialize()
 
@@ -249,8 +260,6 @@ def encode_cblock(block: Block, sent_ts: float | None = None) -> bytes:
     have it — it is minted by this block), elide everything else to its
     txid.  ~32 bytes per transaction on the wire instead of the full
     serialization."""
-    import time
-
     ts = time.time() if sent_ts is None else sent_ts
     if len(block.txs) > 0xFFFF:
         # The compact form's counts are u16; consensus blocks are u32.
@@ -328,6 +337,14 @@ def encode_fees(stats: FeeStats) -> bytes:
 
 def encode_getaddr() -> bytes:
     return bytes([MsgType.GETADDR])
+
+
+def encode_ping(nonce: int) -> bytes:
+    return bytes([MsgType.PING]) + struct.pack(">Q", nonce)
+
+
+def encode_pong(nonce: int) -> bytes:
+    return bytes([MsgType.PONG]) + struct.pack(">Q", nonce)
 
 
 def encode_addr(addrs: list[tuple[str, int]]) -> bytes:
@@ -567,6 +584,10 @@ def _decode(payload: bytes):
         if body:
             raise ValueError("bad GETADDR")
         return mtype, None
+    if mtype in (MsgType.PING, MsgType.PONG):
+        if len(body) != 8:
+            raise ValueError(f"bad {mtype.name}")
+        return mtype, struct.unpack(">Q", body)[0]
     if mtype is MsgType.ADDR:
         if len(body) < 2:
             raise ValueError("bad ADDR")
@@ -679,3 +700,83 @@ async def read_frame(reader: asyncio.StreamReader) -> bytes:
         # canonical scorable violation (node misbehavior bans).
         raise ProtocolError(f"frame of {n} bytes exceeds cap")
     return await reader.readexactly(n)
+
+
+#: Minimum sustained delivery rate for an in-progress frame.  Byte-level
+#: progress counts as liveness (a slow link mid-frame is not silence) —
+#: but unboundedly so, one byte per probe interval would re-pin a peer
+#: slot forever, the very attack the liveness layer closes.  So a frame
+#: must complete within grace + promised_size / MIN_FRAME_RATE seconds
+#: (``FrameReader.overdue``; node.py passes its idle deadlines as the
+#: grace).  10 kB/s tolerates any link worth keeping, and even a hostile
+#: 32 MB frame trickled at exactly the floor bounds the slot hold to
+#: under an hour of real bandwidth spent — paid, not free.
+MIN_FRAME_RATE = 10_000
+
+
+class FrameReader:
+    """Cancellation-tolerant framed reader for the node's session loop.
+
+    ``read_frame`` is two ``readexactly`` calls; a timeout (``wait_for``)
+    that cancels it BETWEEN them — length prefix consumed, body pending —
+    desyncs the stream, and the next read then interprets body bytes as a
+    length prefix: an honest-but-slow peer would be scored for a protocol
+    violation it never committed.  This reader instead accumulates all
+    partial progress in its own buffer, so a cancelled ``read`` resumes at
+    the exact stream position, and it records whether ANY bytes arrived
+    since the last completed frame (``progressed``) — the idle prober's
+    way to tell a peer trickling a large frame over a slow link (alive,
+    never evicted while ``overdue`` is not) from one that has gone silent.
+    """
+
+    def __init__(self, reader: asyncio.StreamReader):
+        self._reader = reader
+        self._buf = bytearray()
+        self._need: int | None = None  # body length once the prefix parsed
+        self._progress = False
+        self._started: float | None = None  # first byte of current frame
+
+    def progressed(self) -> bool:
+        """True if bytes arrived mid-frame since the last completed frame
+        or last call (the flag is consumed)."""
+        p = self._progress
+        self._progress = False
+        return p
+
+    def overdue(self, grace: float) -> bool:
+        """True when the in-progress frame has outlived its delivery
+        budget of ``grace`` + promised_size / MIN_FRAME_RATE seconds —
+        the bound that keeps byte-trickle liveness from being free."""
+        if self._started is None:
+            return False
+        budget = grace + (self._need or _LEN.size) / MIN_FRAME_RATE
+        return time.monotonic() - self._started > budget
+
+    async def read(self) -> bytes:
+        while True:
+            target = _LEN.size if self._need is None else self._need
+            while len(self._buf) < target:
+                chunk = await self._reader.read(target - len(self._buf))
+                if not chunk:
+                    raise asyncio.IncompleteReadError(bytes(self._buf), target)
+                if self._started is None:
+                    self._started = time.monotonic()
+                self._progress = True
+                self._buf += chunk
+            if self._need is None:
+                (n,) = _LEN.unpack(bytes(self._buf))
+                if n > MAX_FRAME:
+                    raise ProtocolError(f"frame of {n} bytes exceeds cap")
+                self._need = n
+                self._buf.clear()
+                continue
+            payload = bytes(self._buf)
+            self._buf.clear()
+            self._need = None
+            self._started = None
+            # A completed frame is reported to the caller, which resets its
+            # idle state wholesale; ``progressed`` is reserved for partial
+            # progress only, so one finished frame can't also grant a later
+            # silent interval a free pass.
+            self._progress = False
+            return payload

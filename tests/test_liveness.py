@@ -1,0 +1,383 @@
+"""Peer liveness layer: PING/PONG keepalive, handshake + idle deadlines,
+slot recovery.
+
+The attack this layer closes (round-4 verdict): a socket that completes
+HELLO and then merely keeps reading held one of the MAX_PEERS slots
+forever (the only prior eviction path was a *send* timeout, which a
+reading-but-silent peer never trips), and a socket that never sent HELLO
+grew ``_sessions`` without bound.  These tests drive real Nodes with raw
+sockets playing the silent attacker and assert the deadlines actually
+fire, the slots actually recover, and honest chatter is never penalized.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from p1_tpu.config import NodeConfig
+from p1_tpu.core.genesis import make_genesis
+from p1_tpu.node import Node, protocol
+from p1_tpu.node.protocol import Hello, MsgType, ProtocolError
+
+DIFF = 12
+CHUNK = 1 << 14
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def wait_until(cond, timeout=20.0, interval=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+def _config(peers=(), **kw) -> NodeConfig:
+    kw.setdefault("difficulty", DIFF)
+    kw.setdefault("chunk", CHUNK)
+    kw.setdefault("mine", False)
+    # Snappy deadlines so the suite doesn't sit through Bitcoin-scale
+    # minutes; the production defaults differ only in magnitude.
+    kw.setdefault("handshake_timeout_s", 0.3)
+    kw.setdefault("ping_interval_s", 0.25)
+    kw.setdefault("pong_timeout_s", 0.25)
+    return NodeConfig(peers=tuple(peers), **kw)
+
+
+async def raw_hello(port: int, nonce: int):
+    """A bare socket that completes the HELLO exchange like a node and
+    then does whatever the test says — the adversary's half of the
+    handshake, without any of Node's liveness reflexes."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    genesis_hash = make_genesis(DIFF).block_hash()
+    await protocol.write_frame(
+        writer, protocol.encode_hello(Hello(genesis_hash, 0, 0, nonce))
+    )
+    mtype, _ = protocol.decode(await protocol.read_frame(reader))
+    assert mtype is MsgType.HELLO
+    return reader, writer
+
+
+async def read_types_until_eof(reader) -> list:
+    """Drain frames (the reading-but-silent attacker) until the node
+    hangs up; returns the message types seen."""
+    types = []
+    try:
+        while True:
+            mtype, _ = protocol.decode(await protocol.read_frame(reader))
+            types.append(mtype)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return types
+
+
+class TestCodec:
+    def test_ping_pong_round_trip(self):
+        for enc, mtype in (
+            (protocol.encode_ping, MsgType.PING),
+            (protocol.encode_pong, MsgType.PONG),
+        ):
+            got_type, got_nonce = protocol.decode(enc(0xDEADBEEF12345678))
+            assert got_type is mtype
+            assert got_nonce == 0xDEADBEEF12345678
+
+    def test_bad_ping_size_is_violation(self):
+        with pytest.raises(ProtocolError):
+            protocol.decode(bytes([MsgType.PING]) + b"\x00" * 7)
+        with pytest.raises(ProtocolError):
+            protocol.decode(bytes([MsgType.PONG]) + b"\x00" * 9)
+
+
+class TestIdleEviction:
+    def test_silent_after_hello_probed_then_evicted(self):
+        """The verdict's exact attack: HELLO then silence while reading.
+        The node must probe with a PING and, absent any reply, evict
+        within ping_interval + pong_timeout — not hold the slot forever."""
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                reader, writer = await raw_hello(node.port, nonce=101)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                t0 = time.monotonic()
+                types = await asyncio.wait_for(
+                    read_types_until_eof(reader), timeout=10
+                )
+                elapsed = time.monotonic() - t0
+                assert MsgType.PING in types  # probed before sentencing
+                # Deadline honored with slack for a loaded CI box, but
+                # far below "forever": interval (0.25) + probe (0.25).
+                assert elapsed < 5.0
+                assert await wait_until(lambda: node.peer_count() == 0)
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_any_frame_resets_probe(self):
+        """A peer that keeps talking (here: periodic GETADDR) must never
+        be evicted, even if it never answers a PING explicitly."""
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                reader, writer = await raw_hello(node.port, nonce=102)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                drainer = asyncio.create_task(read_types_until_eof(reader))
+                # Chatter at half the idle interval for 6 intervals.
+                for _ in range(12):
+                    await protocol.write_frame(
+                        writer, protocol.encode_getaddr()
+                    )
+                    await asyncio.sleep(0.12)
+                assert node.peer_count() == 1  # still welcome
+                drainer.cancel()
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_slow_trickle_is_liveness_not_silence(self):
+        """A peer delivering ONE frame byte-by-byte, slower than the idle
+        interval per byte-gap but inside the frame's delivery budget
+        (grace + size/MIN_FRAME_RATE), is alive — byte-level progress must
+        reset the probe, and a cancelled mid-frame read must not desync
+        the stream into a phantom protocol violation (so: no eviction AND
+        no misbehavior score)."""
+
+        async def scenario():
+            # grace = 0.15 + 1.0 = 1.15s; the 5-byte frame below arrives
+            # over ~0.8s — inside budget, while every 0.15s idle timeout
+            # fires mid-frame and must take the progressed() exemption.
+            node = Node(_config(ping_interval_s=0.15, pong_timeout_s=1.0))
+            await node.start()
+            try:
+                reader, writer = await raw_hello(node.port, nonce=103)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                drainer = asyncio.create_task(read_types_until_eof(reader))
+                # One GETADDR frame (4-byte length + 1-byte type), a byte
+                # every 0.2s vs the 0.15s probe interval.
+                frame = b"\x00\x00\x00\x01" + bytes(
+                    [protocol.MsgType.GETADDR]
+                )
+                for b in frame:
+                    writer.write(bytes([b]))
+                    await writer.drain()
+                    await asyncio.sleep(0.2)
+                assert node.peer_count() == 1  # never evicted
+                assert not node._violations  # and never scored
+                drainer.cancel()
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_endless_trickle_is_bounded(self):
+        """The counter-attack to byte-level liveness: a peer promising a
+        100-byte body and trickling bytes forever at one per probe
+        interval must NOT hold its slot past the frame's delivery budget
+        — evicted as a liveness reap, never scored as a violation."""
+
+        async def scenario():
+            # Budget: (0.15 + 0.2) grace + 100/10000 ≈ 0.36s; the trickle
+            # below would take ~20s to finish the frame.
+            node = Node(_config(ping_interval_s=0.15, pong_timeout_s=0.2))
+            await node.start()
+            try:
+                reader, writer = await raw_hello(node.port, nonce=105)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                drainer = asyncio.create_task(read_types_until_eof(reader))
+                writer.write(b"\x00\x00\x00\x64")  # 100-byte body promised
+                await writer.drain()
+                t0 = time.monotonic()
+                evicted = False
+                for _ in range(100):
+                    try:
+                        writer.write(b"\x55")
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        evicted = True
+                        break
+                    if node.peer_count() == 0:
+                        evicted = True
+                        break
+                    await asyncio.sleep(0.14)
+                assert evicted
+                assert time.monotonic() - t0 < 5.0  # bounded, not ~20s
+                assert not node._violations and not node._banned_until
+                drainer.cancel()
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_midframe_stall_still_evicted_without_ban(self):
+        """A length prefix promising a body that never comes: the probe
+        must still evict once progress stops — but as a liveness reap,
+        never as a scorable protocol violation."""
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                reader, writer = await raw_hello(node.port, nonce=104)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                writer.write(b"\x00\x00\x00\x64")  # 100-byte body promised
+                await writer.drain()
+                types = await asyncio.wait_for(
+                    read_types_until_eof(reader), timeout=10
+                )
+                assert MsgType.PING in types
+                assert await wait_until(lambda: node.peer_count() == 0)
+                assert not node._violations and not node._banned_until
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_two_real_nodes_keep_each_other_alive(self):
+        """Mutual keepalive: two idle nodes with tiny intervals stay
+        connected through many probe cycles — the PONG path works."""
+
+        async def scenario():
+            a = Node(_config())
+            await a.start()
+            b = Node(_config(peers=[f"127.0.0.1:{a.port}"]))
+            await b.start()
+            try:
+                assert await wait_until(
+                    lambda: a.peer_count() == 1 and b.peer_count() == 1
+                )
+                await asyncio.sleep(1.5)  # ~6 idle intervals
+                assert a.peer_count() == 1 and b.peer_count() == 1
+            finally:
+                await b.stop()
+                await a.stop()
+
+        run(scenario())
+
+
+class TestHandshakeDeadline:
+    def test_never_hello_socket_reaped(self):
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.port
+                )
+                t0 = time.monotonic()
+                types = await asyncio.wait_for(
+                    read_types_until_eof(reader), timeout=10
+                )
+                assert types == [MsgType.HELLO]  # their half, then hangup
+                assert time.monotonic() - t0 < 5.0
+                assert await wait_until(lambda: node._handshaking == 0)
+                assert node.peer_count() == 0
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_prehandshake_session_cap(self):
+        """More simultaneous no-HELLO sockets than MAX_HANDSHAKING: the
+        excess is closed on accept (no session task), the rest die at the
+        handshake deadline, and the counter returns to zero."""
+
+        async def scenario():
+            from p1_tpu.node import node as node_mod
+
+            node = Node(_config(handshake_timeout_s=1.0))
+            await node.start()
+            try:
+                conns = []
+                for _ in range(node_mod.MAX_HANDSHAKING + 8):
+                    conns.append(
+                        await asyncio.open_connection("127.0.0.1", node.port)
+                    )
+                await asyncio.sleep(0.2)  # let accepts land
+                assert node._handshaking <= node_mod.MAX_HANDSHAKING
+                # Every socket — capped-out and timed-out alike — sees EOF.
+                results = await asyncio.wait_for(
+                    asyncio.gather(
+                        *(read_types_until_eof(r) for r, _ in conns)
+                    ),
+                    timeout=15,
+                )
+                over_cap = sum(1 for t in results if t == [])
+                assert over_cap >= 8  # the excess never even got a HELLO
+                assert await wait_until(lambda: node._handshaking == 0)
+                for _, w in conns:
+                    w.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+
+class TestSlotRecovery:
+    def test_max_peers_slots_recover_after_eviction(self, monkeypatch):
+        """Fill MAX_PEERS with silent sockets: a real node is refused;
+        after the idle evictions it connects fine — the slots provably
+        recycle instead of being pinned by dead weight."""
+        from p1_tpu.node import node as node_mod
+
+        monkeypatch.setattr(node_mod, "MAX_PEERS", 2)
+
+        async def scenario():
+            victim = Node(_config())
+            await victim.start()
+            drains = []
+            try:
+                socks = [
+                    await raw_hello(victim.port, nonce=200 + i)
+                    for i in range(2)
+                ]
+                assert await wait_until(lambda: victim.peer_count() == 2)
+                # Keep the attackers' read sides flowing (the verdict's
+                # reading-but-silent profile) without answering probes.
+                drains = [
+                    asyncio.create_task(read_types_until_eof(r))
+                    for r, _ in socks
+                ]
+                # A third HELLO is refused at the cap while both slots
+                # are held.
+                with pytest.raises(
+                    (asyncio.IncompleteReadError, ConnectionError)
+                ):
+                    r3, w3 = await raw_hello(victim.port, nonce=300)
+                    await protocol.read_frame(r3)  # node hangs up
+                # The idle deadline reaps both attackers...
+                assert await wait_until(lambda: victim.peer_count() == 0)
+                # ...and a real node then takes a recovered slot.
+                joiner = Node(
+                    _config(peers=[f"127.0.0.1:{victim.port}"])
+                )
+                await joiner.start()
+                try:
+                    assert await wait_until(
+                        lambda: victim.peer_count() == 1
+                        and joiner.peer_count() == 1
+                    )
+                finally:
+                    await joiner.stop()
+                for _, w in socks:
+                    w.close()
+            finally:
+                for d in drains:
+                    d.cancel()
+                await victim.stop()
+
+        run(scenario())
