@@ -76,12 +76,22 @@ def verify_tx_proof(
     Work-bar honesty on retargeting chains: the difficulty consensus
     required at the proof's height is contextual (a function of the whole
     ancestor chain — chain/chain.py), which a stateless verifier cannot
-    recompute.  So with ``retarget`` set, the check is proof-of-work *at
-    the header's claimed difficulty*: forging the proof costs
-    ``2^claimed`` hashes, and the claimed figure is surfaced by
-    ``p1 proof`` so the caller sees exactly what bar the evidence meets.
-    Fixed-difficulty chains (every benchmark config) keep the strict
-    equality check.
+    recompute.  So with ``retarget`` set, the check is proof-of-work at
+    the header's claimed difficulty, **floored by what the rule could
+    legitimately have reached by the claimed height**: difficulty moves
+    at most ``max_adjust`` bits per completed window, so a proof at
+    height h may claim no less than ``base - max_adjust * (h // window)``
+    bits.  Be clear about what that buys: ``height`` and ``tip_height``
+    are themselves peer claims, so a forger willing to claim a height of
+    ``~window * (base-1) / max_adjust`` blocks (where the floor decays to
+    1) still gets ~2-hash evidence past this check, with a plausible
+    confirmation count — the floor only forces the lie into the height
+    field, it cannot price it.  Stateless one-header SPV fundamentally
+    cannot do better on a retargeting chain; clients that need the real
+    bar MUST anchor against a locally verified header chain (``p1 proof
+    --headers``), which checks the claimed height against real blocks and
+    recomputes confirmations locally.  Fixed-difficulty chains (every
+    benchmark config) keep the strict equality check.
     """
     header = proof.header
     have_txid = proof.tx.txid()
@@ -101,9 +111,22 @@ def verify_tx_proof(
                 f"header difficulty {header.difficulty} != chain "
                 f"difficulty {difficulty}"
             )
-    elif header.difficulty < 1:
-        # Difficulty 0 makes every hash "valid" — zero-work evidence.
-        raise SPVError("difficulty-0 header proves nothing")
+    else:
+        # The schedule floor: per-window drift is clamped to max_adjust
+        # bits, so 2-hash evidence (difficulty 1) requires claiming
+        # enough elapsed windows to have legitimately drifted that far.
+        floor = max(
+            1,
+            difficulty
+            - retarget.max_adjust * (proof.height // retarget.window),
+        )
+        if header.difficulty < floor:
+            raise SPVError(
+                f"claimed difficulty {header.difficulty} below the "
+                f"schedule floor {floor} for height {proof.height} "
+                f"(base {difficulty}, ≤{retarget.max_adjust} bits per "
+                f"{retarget.window}-block window)"
+            )
     if proof.height == 0:
         # Genesis anchors by identity, not work (core/genesis.py) — the
         # only height-0 header a client accepts is the chain tag itself.

@@ -225,6 +225,15 @@ def build_parser() -> argparse.ArgumentParser:
         "confirmed-fee median (floor 1)",
     )
     p.add_argument(
+        "--max-fee",
+        type=int,
+        default=100,
+        help="refuse an --fee auto quote above this many units — the "
+        "quote is peer-supplied, and a hostile or broken node must not "
+        "be able to price a wallet's spend unbounded (explicit --fee N "
+        "is never capped: the user stated the number)",
+    )
+    p.add_argument(
         "--seq",
         type=int,
         default=None,
@@ -727,6 +736,17 @@ def cmd_tx(args) -> int:
                 get_fees(args.host, args.port, args.difficulty, retarget=rule)
             )
             fee = max(1, stats.p50)
+            if fee > args.max_fee:
+                # The quote is the PEER's number; signing it unseen would
+                # let one hostile node drain the account through fees.
+                print(
+                    f"refusing auto fee {fee} above --max-fee "
+                    f"{args.max_fee} (node quote p50={stats.p50} over "
+                    f"{stats.samples} samples); pass an explicit --fee "
+                    f"or raise --max-fee to accept",
+                    file=sys.stderr,
+                )
+                return 2
         else:
             fee = args.fee
         seq = args.seq
@@ -914,6 +934,20 @@ def cmd_proof(args) -> int:
         return 4
     confirmations = proof.confirmations  # the peer's claim...
     anchored = False
+    if rule is not None and not args.headers:
+        # Retargeting chains verify at the header's claimed difficulty
+        # (schedule-floored — chain/proof.py), and height/tip/
+        # confirmations are all the peer's claims; only --headers
+        # anchoring pins them to a locally verified chain.  Say so
+        # loudly rather than letting scripts equate the two modes.
+        print(
+            "warning: retargeting chain without --headers — proof "
+            "verified at its claimed difficulty only, and the height/"
+            "confirmation figures are the peer's unverified claims; "
+            "anchor against `p1 headers` output for real light-client "
+            "verification",
+            file=sys.stderr,
+        )
     if args.headers:
         # ...unless anchored: the proof's block must sit at its claimed
         # height on a LOCALLY verified header chain, and confirmations are

@@ -45,6 +45,18 @@ HEADERS_BATCH = 2000
 #: Address-book bound and per-ADDR-reply cap (peer discovery).
 MAX_KNOWN_ADDRS = 1024
 ADDR_REPLY_MAX = 64
+#: Tried-address bucket: addresses verified by a completed handshake.
+#: Kept apart from the gossip-fed book so unsolicited ADDR floods can
+#: never evict a known-good node (the round-4 eclipse vector) — gossip
+#: fills the "new" book, handshakes promote to "tried".
+MAX_TRIED_ADDRS = 256
+#: Per-peer unsolicited-ADDR budget: a token bucket refilled at
+#: ADDR_TOKENS_RATE addresses/second up to one full reply's burst.  Our
+#: own GETADDR requests re-credit the responder (solicited replies
+#: always fit); a peer streaming ADDR frames on its own initiative is
+#: clamped to the refill rate, excess entries silently ignored.
+ADDR_TOKENS_MAX = float(ADDR_REPLY_MAX)
+ADDR_TOKENS_RATE = 1.0
 #: How often the discovery loop checks whether to dial a learned address.
 DISCOVERY_INTERVAL_S = 1.0
 #: Minimum spacing between repeat GETADDR broadcasts while under target.
@@ -193,6 +205,11 @@ class _Peer:
         #: must strictly advance in key order or the sync stops (hostile
         #: responders can't loop us).
         self.mempool_cursor: tuple[int, bytes] | None = None
+        #: Remote host (peername IP), for per-HOST accounting such as the
+        #: ADDR budget — per-connection state would reset on reconnect.
+        self.host: str | None = (
+            writer.get_extra_info("peername") or (None,)
+        )[0]
 
     async def send(self, payload: bytes) -> None:
         await protocol.write_frame(self.writer, payload)
@@ -259,16 +276,28 @@ class Node:
         self._pending_cblocks: collections.OrderedDict[
             tuple[bytes, _Peer], _PendingCompact
         ] = collections.OrderedDict()
-        #: Address book: (host, port) -> last-learned monotonic time.
-        #: Seeded from config, fed by peer HELLOs and ADDR gossip, FIFO-
-        #: bounded; the discovery loop (``target_peers`` > 0) dials from
-        #: it.  Never contains our own address knowingly — a self-dial is
+        #: Address book, two buckets: ``_known_addrs`` ("new") is seeded
+        #: from config and fed by ADDR gossip, FIFO-bounded — hostile
+        #: gossip churns only here; ``_tried_addrs`` holds addresses a
+        #: completed handshake verified, bounded separately, and gossip
+        #: can never evict them (the eclipse-resistance split).  The
+        #: discovery loop (``target_peers`` > 0) dials tried first.
+        #: Neither contains our own address knowingly — a self-dial is
         #: detected by nonce and the address dropped.
         self._known_addrs: collections.OrderedDict[
             tuple[str, int], float
         ] = collections.OrderedDict(
             (addr, 0.0) for addr in config.peer_addrs()
         )
+        self._tried_addrs: collections.OrderedDict[
+            tuple[str, int], float
+        ] = collections.OrderedDict()
+        #: Per-HOST unsolicited-ADDR token buckets: host -> [tokens,
+        #: last_refill].  Keyed like the misbehavior tracking (not per
+        #: connection — a reconnect must not refresh the budget, or ~16
+        #: quick reconnects flush the whole gossip book) and bounded the
+        #: same way against address-cycling attackers.
+        self._addr_budgets: dict[str, list[float]] = {}
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
@@ -300,25 +329,47 @@ class Node:
         except (ValueError, OSError) as e:
             log.warning("ignoring unreadable address book %s: %s", path, e)
             return
-        if not isinstance(entries, list):
+        # Two formats: the current {"tried": [...], "new": [...]} split
+        # and the legacy flat list (loaded as "new" — a restart earns
+        # tried status afresh through real handshakes).
+        if isinstance(entries, dict):
+            tried_rows = entries.get("tried", [])
+            new_rows = entries.get("new", [])
+            if not isinstance(tried_rows, list) or not isinstance(
+                new_rows, list
+            ):
+                log.warning("ignoring malformed address book %s", path)
+                return
+        elif isinstance(entries, list):
+            tried_rows, new_rows = [], entries
+        else:
             # Parsable-but-wrong content is just as corrupt as unparsable
             # bytes — the book is a cache, never worth failing startup.
             log.warning("ignoring malformed address book %s", path)
             return
-        for entry in entries[:MAX_KNOWN_ADDRS]:
-            try:
-                host, port = entry
-                # Mirror the ADDR wire rules (protocol.encode_addr): a
-                # row the codec would refuse must not enter the book, or
-                # every later GETADDR reply dies on our own encode.
-                if (
-                    isinstance(host, str)
-                    and 0 < len(host.encode("utf-8")) <= 255
-                    and 0 < int(port) <= 0xFFFF
-                ):
-                    self._known_addrs.setdefault((host, int(port)), 0.0)
-            except (TypeError, ValueError):
-                continue  # one bad row must not poison the rest
+
+        def _rows(rows, limit):
+            for entry in rows[:limit]:
+                try:
+                    host, port = entry
+                    # Mirror the ADDR wire rules (protocol.encode_addr):
+                    # a row the codec would refuse must not enter the
+                    # book, or every later GETADDR reply dies on our own
+                    # encode.
+                    if (
+                        isinstance(host, str)
+                        and 0 < len(host.encode("utf-8")) <= 255
+                        and 0 < int(port) <= 0xFFFF
+                    ):
+                        yield (host, int(port))
+                except (TypeError, ValueError):
+                    continue  # one bad row must not poison the rest
+
+        for addr in _rows(tried_rows, MAX_TRIED_ADDRS):
+            self._tried_addrs.setdefault(addr, 0.0)
+        for addr in _rows(new_rows, MAX_KNOWN_ADDRS):
+            if addr not in self._tried_addrs:
+                self._known_addrs.setdefault(addr, 0.0)
 
     def _save_addr_book(self) -> None:
         path = self._addr_book_path()
@@ -327,7 +378,12 @@ class Node:
         try:
             tmp = path.with_suffix(".addrs.tmp")
             tmp.write_text(
-                json.dumps([list(a) for a in self._known_addrs])
+                json.dumps(
+                    {
+                        "tried": [list(a) for a in self._tried_addrs],
+                        "new": [list(a) for a in self._known_addrs],
+                    }
+                )
             )
             tmp.replace(path)  # atomic: never a torn book
         except OSError as e:
@@ -560,19 +616,22 @@ class Node:
                     asyncio.open_connection(host, port), timeout=5.0
                 )
             except (OSError, asyncio.TimeoutError):
-                # Unreachable: forget the address (a live peer's ADDR
-                # gossip will re-teach it if it comes back).
-                self._known_addrs.pop((host, port), None)
+                # Unreachable: demote/forget (a live peer's ADDR gossip
+                # re-teaches it if it comes back; a tried entry survives
+                # one failure as a rumor rather than vanishing).
+                self._demote_addr((host, port))
                 return
             registered = await self._peer_session(
                 reader, writer, f"disc:{host}:{port}", dial_addr=(host, port)
             )
             if not registered:
                 # Accepted TCP but failed the handshake (wrong chain,
-                # version skew, peer full, ourselves): forget it, or the
-                # next tick redials the same dead end forever and starves
-                # every other candidate in the book.
-                self._known_addrs.pop((host, port), None)
+                # version skew, peer full, ourselves): demote/forget, or
+                # the next tick redials the same dead end forever and
+                # starves every other candidate in the book.  (A self-
+                # connect already erased the address inside the session —
+                # demote leaves absent entries absent.)
+                self._demote_addr((host, port))
         finally:
             self._dialing.discard((host, port))
 
@@ -605,7 +664,10 @@ class Node:
             }
             connected |= set(self.config.peer_addrs())
             started = 0
-            for addr in list(self._known_addrs):
+            # Handshake-verified addresses first: an attacker who filled
+            # the gossip book cannot redirect the next dials away from
+            # nodes we have actually spoken to.
+            for addr in [*self._tried_addrs, *self._known_addrs]:
                 if deficit <= started:
                     break
                 if addr in connected or addr in self._dialing:
@@ -629,7 +691,22 @@ class Node:
                 # limited — a node whose target exceeds the network size
                 # would otherwise chatter GETADDR every tick forever.
                 last_readdr = now
-                await self._gossip(protocol.encode_getaddr())
+                # Re-ask outbound peers only, crediting each reply —
+                # same reasoning as the handshake-time GETADDR: inbound
+                # connections must never be able to induce a grant.
+                outbound = [
+                    p
+                    for p in self._peers.values()
+                    if p.dial_addr is not None
+                ]
+                for p in outbound:
+                    if p.host:
+                        self._addr_budget(p.host, grant=True)
+                if outbound:
+                    payload = protocol.encode_getaddr()
+                    await asyncio.gather(
+                        *(self._send_guarded(p, payload) for p in outbound)
+                    )
 
     async def _housekeeping_loop(self) -> None:
         """Periodic pool hygiene: expire transactions that have sat
@@ -641,12 +718,72 @@ class Node:
             if dropped:
                 log.info("expired %d stale mempool transactions", dropped)
 
-    def _learn_addr(self, addr: tuple[str, int]) -> None:
-        """Merge one address into the bounded book (refreshes recency)."""
+    def _learn_addr(self, addr: tuple[str, int], tried: bool = False) -> None:
+        """Merge one address into the bounded book (refreshes recency).
+        ``tried`` promotes it to the handshake-verified bucket, where
+        gossip-driven churn can never reach it."""
+        if tried:
+            self._known_addrs.pop(addr, None)
+            self._tried_addrs.pop(addr, None)
+            self._tried_addrs[addr] = time.monotonic()
+            while len(self._tried_addrs) > MAX_TRIED_ADDRS:
+                self._tried_addrs.popitem(last=False)
+            return
+        if addr in self._tried_addrs:
+            return  # already known-good; gossip cannot demote it
         self._known_addrs.pop(addr, None)
         self._known_addrs[addr] = time.monotonic()
         while len(self._known_addrs) > MAX_KNOWN_ADDRS:
             self._known_addrs.popitem(last=False)
+
+    def _forget_addr(self, addr: tuple[str, int]) -> None:
+        """Drop an address from both buckets (dead, or ourselves)."""
+        self._known_addrs.pop(addr, None)
+        self._tried_addrs.pop(addr, None)
+
+    def _demote_addr(self, addr: tuple[str, int]) -> None:
+        """One failed dial: a tried address loses its protected status
+        but stays as a rumor (a real node may be mid-restart — exactly
+        when an eclipse attacker wants it erased for good); an unproven
+        one is forgotten outright.  An address absent from both buckets
+        (e.g. already dropped as a self-connect) stays absent."""
+        if self._tried_addrs.pop(addr, None) is not None:
+            self._known_addrs.pop(addr, None)
+            self._known_addrs[addr] = time.monotonic()
+            while len(self._known_addrs) > MAX_KNOWN_ADDRS:
+                self._known_addrs.popitem(last=False)
+        else:
+            self._known_addrs.pop(addr, None)
+
+    def _addr_budget(self, host: str, grant: bool = False) -> list[float]:
+        """The host's refilled ADDR token bucket ([tokens, last_refill]).
+        ``grant`` refills it outright — used when WE solicit with a
+        GETADDR, so the reply we asked for always fits the budget."""
+        now = time.monotonic()
+        bucket = self._addr_budgets.get(host)
+        if bucket is None:
+            bucket = self._addr_budgets[host] = [ADDR_TOKENS_MAX, now]
+            if len(self._addr_budgets) > MAX_TRACKED_HOSTS:
+                # Fully-refilled entries carry no state worth keeping.
+                refill_s = ADDR_TOKENS_MAX / ADDR_TOKENS_RATE
+                cutoff = now - refill_s
+                self._addr_budgets = {
+                    h: b
+                    for h, b in self._addr_budgets.items()
+                    if b[1] >= cutoff and b[0] < ADDR_TOKENS_MAX
+                }
+                self._addr_budgets.setdefault(host, bucket)
+                while len(self._addr_budgets) > MAX_TRACKED_HOSTS:
+                    del self._addr_budgets[next(iter(self._addr_budgets))]
+        elif grant:
+            bucket[0], bucket[1] = ADDR_TOKENS_MAX, now
+        else:
+            bucket[0] = min(
+                ADDR_TOKENS_MAX,
+                bucket[0] + (now - bucket[1]) * ADDR_TOKENS_RATE,
+            )
+            bucket[1] = now
+        return bucket
 
     async def _peer_session(
         self,
@@ -694,12 +831,12 @@ class Node:
             if mtype is not MsgType.HELLO:
                 raise protocol.ProtocolError("expected HELLO")
             if hello.genesis_hash != self.chain.genesis.block_hash():
-                raise protocol.ProtocolError("genesis mismatch")
+                raise protocol.ChainMismatch("genesis mismatch")
             if hello.nonce and hello.nonce == self.instance_nonce:
                 # We dialed our own listening address (the book can learn
                 # it from peers' ADDR gossip) — drop it for good.
                 if dial_addr is not None:
-                    self._known_addrs.pop(dial_addr, None)
+                    self._forget_addr(dial_addr)
                 raise _Refused("connected to self")
             if len(self._peers) >= MAX_PEERS:
                 # Re-check at registration: the pre-handshake check above
@@ -714,13 +851,35 @@ class Node:
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
             peer.hello_height = hello.tip_height
             if hello.listen_port:
-                # The peer's reachable address: its socket host + the
-                # listen port it advertised.  Feeds the book and GETADDR.
+                # The peer's claimed reachable address: its socket host +
+                # the listen port it advertised.  NOT promoted to tried —
+                # the port is self-claimed and unverified, and an inbound
+                # attacker completing 256 cheap HELLOs with rotating port
+                # claims would otherwise flush the whole tried bucket.
+                # Charged against the same per-host ADDR budget as gossip:
+                # a reconnect loop claiming a new port each time is just
+                # an ADDR flood spelled differently.
                 peername = writer.get_extra_info("peername")
                 if peername:
                     peer.addr = (peername[0], hello.listen_port)
-                    self._learn_addr(peer.addr)
-            if hello.nonce:  # a real node (not a one-shot tooling client)
+                    bucket = self._addr_budget(peername[0])
+                    if bucket[0] >= 1.0:
+                        bucket[0] -= 1.0
+                        self._learn_addr(peer.addr)
+            if dial_addr is not None:
+                # Tried promotion is outbound-only (Bitcoin's rule, for
+                # Bitcoin's reason): WE dialed this exact address and a
+                # real node answered — that is verified reachability,
+                # which no inbound claim can counterfeit.
+                self._learn_addr(dial_addr, tried=True)
+            if hello.nonce and dial_addr is not None:
+                # Solicit addresses on OUTBOUND connections only
+                # (Bitcoin's rule): an inbound attacker could otherwise
+                # induce the ask and ride the solicited budget grant to
+                # flush the gossip book by reconnecting.  We control the
+                # dial rate, so the grant is attacker-independent.
+                if peer.host:
+                    self._addr_budget(peer.host, grant=True)
                 await peer.send(protocol.encode_getaddr())
             if hello.tip_height > self.chain.height:
                 # Blocks first, mempool after: the BLOCKS handler requests
@@ -787,12 +946,18 @@ class Node:
             _Refused,
         ) as e:
             log.info("peer %s closed: %s", label, e)
-            if isinstance(e, protocol.ProtocolError):
-                # Peer-side protocol violation (malformed frame, wrong
-                # chain/version, bad handshake) — score it; repeat
-                # offenders get refused at accept time for a cooldown.
-                # Plain ValueErrors stay unscored: they can originate in
-                # OUR encode paths while answering an innocent peer.
+            if isinstance(e, protocol.ProtocolError) and not isinstance(
+                e, protocol.ChainMismatch
+            ):
+                # Peer-side protocol violation (malformed frame, bad
+                # handshake bytes) — score it; repeat offenders get
+                # refused at accept time for a cooldown.  Plain
+                # ValueErrors stay unscored (they can originate in OUR
+                # encode paths while answering an innocent peer), and so
+                # do well-formed HELLOs for the wrong chain or version:
+                # that is misconfiguration — e.g. a wallet run with the
+                # wrong --difficulty — not hostility, and scoring it
+                # would let three such invocations ban loopback.
                 peername = writer.get_extra_info("peername")
                 if peername:
                     self._record_violation(peername[0])
@@ -938,13 +1103,29 @@ class Node:
             pass  # reply frame: meaningful to querying clients only
         elif mtype is MsgType.GETADDR:
             # Share listening addresses we know, minus the asker's own
-            # (it does not need to learn itself).
-            addrs = [a for a in self._known_addrs if a != peer.addr]
-            await self._send_guarded(
-                peer, protocol.encode_addr(addrs[-ADDR_REPLY_MAX:])
-            )
+            # (it does not need to learn itself): every tried address
+            # first (handshake-verified beats rumor), newest gossip after.
+            tried = [a for a in self._tried_addrs if a != peer.addr]
+            addrs = tried[-ADDR_REPLY_MAX:]
+            room = ADDR_REPLY_MAX - len(addrs)
+            if room > 0:
+                addrs += [
+                    a for a in self._known_addrs if a != peer.addr
+                ][-room:]
+            await self._send_guarded(peer, protocol.encode_addr(addrs))
         elif mtype is MsgType.ADDR:
+            # Per-HOST token bucket: one host must not be able to churn
+            # the whole gossip book by streaming ADDR frames — nor by
+            # reconnecting for fresh budgets (and tried addresses are out
+            # of reach regardless).  Over-budget entries are ignored, not
+            # scored — ADDR is advisory.
+            bucket = (
+                self._addr_budget(peer.host) if peer.host else [0.0, 0.0]
+            )
             for addr in body[:ADDR_REPLY_MAX]:  # cap hostile batches
+                if bucket[0] < 1.0:
+                    break
+                bucket[0] -= 1.0
                 self._learn_addr(addr)
         elif mtype is MsgType.GETHEADERS:
             # Headers-first sync for light clients: same locator
@@ -1268,7 +1449,7 @@ class Node:
             "height": self.chain.height,
             "tip": self.chain.tip_hash.hex(),
             "peers": self.peer_count(),
-            "known_addrs": len(self._known_addrs),
+            "known_addrs": len(self._known_addrs) + len(self._tried_addrs),
             "banned_hosts": sum(
                 1
                 for until in self._banned_until.values()

@@ -115,6 +115,15 @@ class ProtocolError(ValueError):
     count against the remote."""
 
 
+class ChainMismatch(ProtocolError):
+    """A well-formed HELLO for the wrong chain or protocol version.
+    Still ends the session — but as *misconfiguration*, not hostility:
+    the node's ban scoring must ignore it, or three wallet invocations
+    with the wrong --difficulty/retarget flags inside the scoring window
+    would ban 127.0.0.1 and refuse a whole localhost mesh (ADVICE r4).
+    Ban scores are reserved for malformed bytes and forgeries."""
+
+
 MAX_FRAME = 32 << 20  # hard cap against hostile length prefixes
 _LEN = struct.Struct(">I")
 #: Wire protocol version, carried in HELLO.  Bump when the message surface
@@ -454,7 +463,7 @@ def _decode(payload: bytes):
             raise ValueError("bad HELLO size")
         version, *fields = _HELLO.unpack(body)
         if version != PROTOCOL_VERSION:
-            raise ValueError(
+            raise ChainMismatch(
                 f"protocol version mismatch: peer speaks v{version}, "
                 f"this node v{PROTOCOL_VERSION}"
             )

@@ -286,3 +286,70 @@ class TestCli:
         )
         assert proc.returncode != 0
         assert "nope" in proc.stderr
+
+
+class TestAutoFeeCap:
+    """ADVICE r4: `p1 tx --fee auto` signed whatever fee the peer quoted.
+    The wallet now refuses quotes above --max-fee before signing."""
+
+    def test_hostile_quote_refused(self, tmp_path, monkeypatch):
+        from p1_tpu import cli
+        import p1_tpu.node.client as client_mod
+        from p1_tpu.node.protocol import FeeStats
+
+        key = str(tmp_path / "k.json")
+        assert cli.main(["keygen", "--out", key]) == 0
+
+        called = {}
+
+        async def hostile_fees(*a, **k):
+            return FeeStats(32, 5, 10**9, 10**9, 10**9, 10)
+
+        async def never_send(*a, **k):  # pragma: no cover - must not run
+            called["sent"] = True
+            raise AssertionError("wallet signed a capped fee")
+
+        monkeypatch.setattr(client_mod, "get_fees", hostile_fees)
+        monkeypatch.setattr(client_mod, "send_tx", never_send)
+        rc = cli.main(
+            [
+                "tx", "--difficulty", "12", "--key", key,
+                "--recipient", "p1deadbeefdeadbeef",
+                "--amount", "1", "--fee", "auto",
+            ]
+        )
+        assert rc == 2
+        assert "sent" not in called
+
+    def test_quote_within_cap_accepted(self, tmp_path, monkeypatch, capsys):
+        import json as _json
+
+        from p1_tpu import cli
+        import p1_tpu.node.client as client_mod
+        from p1_tpu.node.protocol import AccountState, FeeStats
+
+        key = str(tmp_path / "k.json")
+        assert cli.main(["keygen", "--out", key]) == 0
+        capsys.readouterr()
+
+        async def fair_fees(*a, **k):
+            return FeeStats(32, 5, 2, 3, 4, 10)
+
+        async def fake_account(host, port, account, *a, **k):
+            return AccountState(account, 100, 0, 0, 10)
+
+        async def fake_send(*a, **k):
+            return 10
+
+        monkeypatch.setattr(client_mod, "get_fees", fair_fees)
+        monkeypatch.setattr(client_mod, "get_account", fake_account)
+        monkeypatch.setattr(client_mod, "send_tx", fake_send)
+        rc = cli.main(
+            [
+                "tx", "--difficulty", "12", "--key", key,
+                "--recipient", "p1deadbeefdeadbeef",
+                "--amount", "1", "--fee", "auto",
+            ]
+        )
+        assert rc == 0
+        assert _json.loads(capsys.readouterr().out)["fee"] == 3

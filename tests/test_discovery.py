@@ -155,7 +155,12 @@ class TestDiscovery:
                     (b, (seed, a)),
                     (seed, (a, b)),
                 ):
-                    known_ports = {p for _, p in node._known_addrs}
+                    # Connected peers are promoted to the tried bucket;
+                    # the book is the union of both.
+                    known_ports = {
+                        p
+                        for _, p in (*node._known_addrs, *node._tried_addrs)
+                    }
                     assert {o.port for o in others} <= known_ports
             finally:
                 await stop_all((a, b, seed))
@@ -266,5 +271,223 @@ class TestDiscovery:
                 assert a.peer_count() == 0 and b.peer_count() == 0
             finally:
                 await stop_all((a, b))
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+
+class TestAddrHygiene:
+    """ADVICE r4: one peer repeatedly sending ADDR frames could flush the
+    whole bounded book (an eclipse vector).  Tried addresses (handshake-
+    verified) now live beyond gossip's reach, and unsolicited ADDR is
+    budgeted per peer."""
+
+    def test_flood_cannot_flush_tried_and_is_budgeted(self):
+        async def scenario():
+            from p1_tpu.core.genesis import make_genesis
+            from test_node import DIFF
+
+            b = Node(_config())
+            await b.start()
+            a = Node(_config(peers=[f"127.0.0.1:{b.port}"]))
+            await a.start()
+            try:
+                assert await wait_until(lambda: a.peer_count() == 1)
+                tried_before = set(a._tried_addrs)
+                assert tried_before  # B's handshake promoted it
+                # Raw attacker completes HELLO, then streams far more
+                # ADDR entries than its budget allows.
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", a.port
+                )
+                gh = make_genesis(DIFF).block_hash()
+                await protocol.write_frame(
+                    writer, protocol.encode_hello(Hello(gh, 0, 0, 999))
+                )
+                for burst in range(20):
+                    addrs = [
+                        (f"10.9.{burst}.{i}", 7000 + i) for i in range(64)
+                    ]
+                    await protocol.write_frame(
+                        writer, protocol.encode_addr(addrs)
+                    )
+                await asyncio.sleep(0.5)  # let the frames dispatch
+                # Tried bucket untouched; gossip book holds at most the
+                # attacker's initial token burst (64) + seeds, not 1280.
+                assert tried_before <= set(a._tried_addrs)
+                flood_learned = sum(
+                    1 for (h, _p) in a._known_addrs if h.startswith("10.9.")
+                )
+                assert flood_learned <= 66
+                writer.close()
+            finally:
+                await a.stop()
+                await b.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+
+class TestMisconfigurationIsNotHostility:
+    """ADVICE r4: three wallet invocations with the wrong --difficulty
+    banned 127.0.0.1 for every peer, including a whole localhost mesh.
+    Wrong-chain/version HELLOs now disconnect without scoring."""
+
+    def test_wrong_chain_hellos_never_ban(self):
+        async def scenario():
+            from p1_tpu.core.genesis import make_genesis
+            from test_node import DIFF
+
+            node = Node(_config())
+            await node.start()
+            try:
+                wrong = make_genesis(DIFF + 1).block_hash()
+                for _ in range(4):
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", node.port
+                    )
+                    await protocol.write_frame(
+                        writer, protocol.encode_hello(Hello(wrong, 0, 0, 0))
+                    )
+                    await reader.read()  # node HELLOs then hangs up
+                    writer.close()
+                assert "127.0.0.1" not in node._banned_until
+                assert not node._violations.get("127.0.0.1")
+                # Loopback service uninterrupted for correctly configured
+                # clients.
+                right = make_genesis(DIFF).block_hash()
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.port
+                )
+                await protocol.write_frame(
+                    writer, protocol.encode_hello(Hello(right, 0, 0, 0))
+                )
+                mtype, _ = protocol.decode(await protocol.read_frame(reader))
+                assert mtype is MsgType.HELLO
+                assert await wait_until(lambda: node.peer_count() == 1)
+                writer.close()
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+
+class TestAddrBudgetPerHost:
+    """Review r5 hardening: the ADDR budget keys on the HOST, so
+    reconnecting cannot mint fresh budgets, and inbound HELLO port
+    claims never reach the tried bucket."""
+
+    async def _hello_socket(self, port, nonce, listen_port=7777):
+        from p1_tpu.core.genesis import make_genesis
+        from test_node import DIFF
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        gh = make_genesis(DIFF).block_hash()
+        await protocol.write_frame(
+            writer,
+            protocol.encode_hello(Hello(gh, 0, listen_port, nonce)),
+        )
+        mtype, _ = protocol.decode(await protocol.read_frame(reader))
+        assert mtype is MsgType.HELLO
+        return reader, writer
+
+    def test_reconnects_do_not_refresh_budget(self):
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                for round_ in range(5):
+                    r, w = await self._hello_socket(node.port, 400 + round_)
+                    addrs = [
+                        (f"10.7.{round_}.{i}", 7000 + i) for i in range(64)
+                    ]
+                    await protocol.write_frame(w, protocol.encode_addr(addrs))
+                    await asyncio.sleep(0.1)
+                    w.close()
+                flood = sum(
+                    1
+                    for (h, _p) in node._known_addrs
+                    if h.startswith("10.7.")
+                )
+                # One host = one budget: ~64 entries + the trickle refill
+                # across the run, not 5 * 64.
+                assert flood <= 70, flood
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_inbound_port_claim_never_tried(self):
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                r, w = await self._hello_socket(node.port, 500)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                # The claimed (127.0.0.1, 7777) lands in the gossip book
+                # only; tried stays empty (we never dialed anything).
+                assert ("127.0.0.1", 7777) in node._known_addrs
+                assert not node._tried_addrs
+                w.close()
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_hello_port_claims_are_budgeted(self):
+        """A reconnect loop claiming a fresh listen port per HELLO is an
+        ADDR flood spelled differently — it must draw from the same
+        per-host budget."""
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                # Burn the host's token budget with one full ADDR frame.
+                r, w = await self._hello_socket(node.port, 600)
+                await protocol.write_frame(
+                    w,
+                    protocol.encode_addr(
+                        [(f"10.8.0.{i}", 7000 + i) for i in range(64)]
+                    ),
+                )
+                await asyncio.sleep(0.2)
+                w.close()
+                # Rotating port claims on fresh connections: each learned
+                # claim costs a token the host no longer has.
+                for i in range(10):
+                    r, w = await self._hello_socket(
+                        node.port, 601 + i, listen_port=8000 + i
+                    )
+                    await asyncio.sleep(0.02)
+                    w.close()
+                claimed = sum(
+                    1
+                    for (h, p) in node._known_addrs
+                    if h == "127.0.0.1" and 8000 <= p < 8010
+                )
+                assert claimed <= 2, claimed  # refill trickle at most
+            finally:
+                await node.stop()
+
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+    def test_tried_survives_one_failed_dial_as_rumor(self):
+        """A tried (handshake-verified) address whose node is briefly
+        down is demoted to the gossip book on a failed dial — not erased,
+        which is exactly what an eclipse attacker would want."""
+
+        async def scenario():
+            node = Node(_config(target_peers=1))
+            await node.start()
+            try:
+                addr = ("127.0.0.1", 1)  # nothing listens there
+                node._learn_addr(addr, tried=True)
+                assert await wait_until(
+                    lambda: addr not in node._tried_addrs, timeout=15
+                )
+                # Demoted to rumor status (the next failed dial may
+                # forget it for good — one survival is the guarantee).
+                assert addr in node._known_addrs
+            finally:
+                await node.stop()
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))

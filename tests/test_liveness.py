@@ -15,26 +15,12 @@ import time
 
 import pytest
 
+from test_node import CHUNK, DIFF, run, wait_until
+
 from p1_tpu.config import NodeConfig
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.node import Node, protocol
 from p1_tpu.node.protocol import Hello, MsgType, ProtocolError
-
-DIFF = 12
-CHUNK = 1 << 14
-
-
-def run(coro):
-    return asyncio.run(asyncio.wait_for(coro, timeout=60))
-
-
-async def wait_until(cond, timeout=20.0, interval=0.02) -> bool:
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        await asyncio.sleep(interval)
-    return False
 
 
 def _config(peers=(), **kw) -> NodeConfig:

@@ -269,3 +269,60 @@ class TestProofOverWire:
                 await node.stop()
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+
+class TestRetargetScheduleFloor:
+    """ADVICE r4: on retargeting chains, verification runs at the header's
+    CLAIMED difficulty — without a floor, ~2 hashes forge "evidence".
+    The schedule floor prices forgery at what the retarget rule could
+    legitimately have reached by the claimed height."""
+
+    def _mined_header(self, txid: bytes, difficulty: int):
+        from p1_tpu.core import BlockHeader
+        from p1_tpu.core.header import meets_target
+
+        header = BlockHeader(
+            version=1,
+            prev_hash=b"\x55" * 32,
+            merkle_root=merkle_root([txid]),
+            timestamp=1_000,
+            difficulty=difficulty,
+            nonce=0,
+        )
+        nonce = 0
+        while not meets_target(header.with_nonce(nonce).block_hash(), difficulty):
+            nonce += 1
+        return header.with_nonce(nonce)
+
+    def test_cheap_forgery_below_floor_rejected(self):
+        from p1_tpu.core.retarget import RetargetRule
+
+        rule = RetargetRule(window=50, spacing=5)  # max_adjust = 2
+        cb = Transaction.coinbase("m", 7)
+        # Difficulty-1 "work" (~2 hashes) at height 7: zero completed
+        # windows, so the floor is the full base difficulty.
+        forged = TxProof(cb, self._mined_header(cb.txid(), 1), 7, 7, 0, ())
+        with pytest.raises(SPVError, match="schedule floor"):
+            verify_tx_proof(
+                forged, DIFF, genesis_hash(DIFF, rule), retarget=rule
+            )
+
+    def test_floor_tracks_claimed_height(self):
+        from p1_tpu.core.retarget import RetargetRule
+
+        rule = RetargetRule(window=50, spacing=5)
+        cb = Transaction.coinbase("m", 100)
+        # Two completed windows at height 100: the rule could have moved
+        # at most 2*2 bits, so DIFF-4 evidence is plausible and accepted…
+        ok = TxProof(
+            cb, self._mined_header(cb.txid(), DIFF - 4), 100, 120, 0, ()
+        )
+        verify_tx_proof(ok, DIFF, genesis_hash(DIFF, rule), retarget=rule)
+        # …but one bit below the reachable floor is not.
+        cheap = TxProof(
+            cb, self._mined_header(cb.txid(), DIFF - 5), 100, 120, 0, ()
+        )
+        with pytest.raises(SPVError, match="schedule floor"):
+            verify_tx_proof(
+                cheap, DIFF, genesis_hash(DIFF, rule), retarget=rule
+            )
