@@ -1,3 +1,17 @@
-from p1_tpu.mempool.mempool import Mempool, sync_key
+from p1_tpu.mempool.mempool import (
+    Mempool,
+    dump_mempool,
+    load_mempool,
+    save_mempool,
+    sync_key,
+    write_mempool_file,
+)
 
-__all__ = ["Mempool", "sync_key"]
+__all__ = [
+    "Mempool",
+    "dump_mempool",
+    "load_mempool",
+    "save_mempool",
+    "sync_key",
+    "write_mempool_file",
+]

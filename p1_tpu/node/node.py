@@ -298,6 +298,8 @@ class Node:
         #: quick reconnects flush the whole gossip book) and bounded the
         #: same way against address-cycling attackers.
         self._addr_budgets: dict[str, list[float]] = {}
+        #: Pool mutation count at the last persisted checkpoint.
+        self._mempool_saved_at = 0
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
@@ -317,6 +319,66 @@ class Node:
             if self.config.store_path
             else None
         )
+
+    def _mempool_path(self):
+        return (
+            Path(f"{self.config.store_path}.mempool")
+            if self.config.store_path
+            else None
+        )
+
+    def _load_mempool(self) -> None:
+        """Resume the pending pool (Bitcoin's mempool.dat analog): every
+        record re-passes full admission against the freshly loaded chain,
+        so anything the downtime invalidated is dropped, and restored
+        ages keep the TTL clock honest across the restart."""
+        from p1_tpu.mempool import load_mempool
+
+        path = self._mempool_path()
+        if path is None or not path.exists():
+            return
+        restored, dropped = load_mempool(self.mempool, path)
+        if restored or dropped:
+            log.info(
+                "mempool resumed: %d restored, %d dropped on revalidation",
+                restored,
+                dropped,
+            )
+
+    def _save_mempool(self) -> None:
+        """Synchronous save (shutdown path — nothing left to stall)."""
+        from p1_tpu.mempool import save_mempool
+
+        path = self._mempool_path()
+        if path is None:
+            return
+        try:
+            save_mempool(self.mempool, path)
+            self._mempool_saved_at = self.mempool.mutations
+        except OSError as e:
+            log.warning("could not persist mempool %s: %s", path, e)
+
+    async def _checkpoint_mempool(self) -> None:
+        """Periodic crash checkpoint: skipped when the pool is unchanged
+        since the last save, and the encoding + atomic write run in a
+        worker thread — a near-capacity pool (~tens of MB) must not
+        stall frame reads, ping deadlines, or mining for the duration.
+        The snapshot itself is taken on the event loop, where all pool
+        mutation happens, so it is internally consistent."""
+        from p1_tpu.mempool import dump_mempool, write_mempool_file
+
+        path = self._mempool_path()
+        if path is None or self.mempool.mutations == self._mempool_saved_at:
+            return
+        mutations = self.mempool.mutations
+        rows = self.mempool.snapshot()
+        try:
+            await asyncio.to_thread(
+                write_mempool_file, dump_mempool(rows), path
+            )
+            self._mempool_saved_at = mutations
+        except OSError as e:
+            log.warning("could not persist mempool %s: %s", path, e)
 
     def _load_addr_book(self) -> None:
         """Resume discovery state: a restarting node re-joins the network
@@ -432,6 +494,8 @@ class Node:
                     self.chain.height,
                     self.chain.tip_hash.hex()[:16],
                 )
+            # After the chain: admission validates against the ledger.
+            self._load_mempool()
         self._running = True
         self._server = await asyncio.start_server(
             self._on_inbound, self.config.host, self.config.port
@@ -442,7 +506,9 @@ class Node:
             self._tasks.append(asyncio.create_task(self._dial_loop(host, port)))
         if self.config.target_peers > 0:
             self._tasks.append(asyncio.create_task(self._discovery_loop()))
-        if self.config.mempool_ttl_s > 0:
+        if self.config.mempool_ttl_s > 0 or self.store is not None:
+            # TTL expiry and/or the crash checkpoint: a persistent node
+            # with expiry disabled still checkpoints its pool.
             self._tasks.append(asyncio.create_task(self._housekeeping_loop()))
         if self.config.mine:
             self.start_mining()
@@ -470,6 +536,7 @@ class Node:
             self._server.close()
             await self._server.wait_closed()
         self._save_addr_book()
+        self._save_mempool()
         if self.store is not None:
             self.store.close()
 
@@ -711,12 +778,19 @@ class Node:
     async def _housekeeping_loop(self) -> None:
         """Periodic pool hygiene: expire transactions that have sat
         unmineable past the configured TTL (mempool.expire)."""
-        interval = max(1.0, min(30.0, self.config.mempool_ttl_s / 4))
+        ttl = self.config.mempool_ttl_s
+        interval = max(1.0, min(30.0, ttl / 4)) if ttl > 0 else 30.0
         while self._running:
             await asyncio.sleep(interval)
-            dropped = self.mempool.expire(self.config.mempool_ttl_s)
-            if dropped:
-                log.info("expired %d stale mempool transactions", dropped)
+            if ttl > 0:
+                dropped = self.mempool.expire(ttl)
+                if dropped:
+                    log.info(
+                        "expired %d stale mempool transactions", dropped
+                    )
+            # Periodic checkpoint so a crash (not just a clean stop)
+            # loses at most one interval's worth of admissions.
+            await self._checkpoint_mempool()
 
     def _learn_addr(self, addr: tuple[str, int], tried: bool = False) -> None:
         """Merge one address into the bounded book (refreshes recency).
@@ -748,17 +822,19 @@ class Node:
         one is forgotten outright.  An address absent from both buckets
         (e.g. already dropped as a self-connect) stays absent."""
         if self._tried_addrs.pop(addr, None) is not None:
-            self._known_addrs.pop(addr, None)
-            self._known_addrs[addr] = time.monotonic()
-            while len(self._known_addrs) > MAX_KNOWN_ADDRS:
-                self._known_addrs.popitem(last=False)
+            self._learn_addr(addr)  # back to the gossip book
         else:
             self._known_addrs.pop(addr, None)
 
     def _addr_budget(self, host: str, grant: bool = False) -> list[float]:
         """The host's refilled ADDR token bucket ([tokens, last_refill]).
-        ``grant`` refills it outright — used when WE solicit with a
-        GETADDR, so the reply we asked for always fits the budget."""
+        ``grant`` ADDS one reply's worth of credit (bounded) — used when
+        WE solicit with a GETADDR, so each reply we ask for fits the
+        budget even when several outbound peers share one host (the
+        localhost mesh).  Grants are additive rather than set-to-max
+        because two same-host solicited replies would otherwise race for
+        a single refill; safe because only our own outbound dials can
+        trigger a grant, never an inbound peer."""
         now = time.monotonic()
         bucket = self._addr_budgets.get(host)
         if bucket is None:
@@ -776,12 +852,16 @@ class Node:
                 while len(self._addr_budgets) > MAX_TRACKED_HOSTS:
                     del self._addr_budgets[next(iter(self._addr_budgets))]
         elif grant:
-            bucket[0], bucket[1] = ADDR_TOKENS_MAX, now
+            bucket[0] = min(4 * ADDR_TOKENS_MAX, bucket[0] + ADDR_TOKENS_MAX)
+            bucket[1] = now
         else:
-            bucket[0] = min(
-                ADDR_TOKENS_MAX,
-                bucket[0] + (now - bucket[1]) * ADDR_TOKENS_RATE,
-            )
+            if bucket[0] < ADDR_TOKENS_MAX:
+                # Trickle refill toward the base cap; never claw back
+                # grant credit sitting above it.
+                bucket[0] = min(
+                    ADDR_TOKENS_MAX,
+                    bucket[0] + (now - bucket[1]) * ADDR_TOKENS_RATE,
+                )
             bucket[1] = now
         return bucket
 

@@ -312,12 +312,16 @@ class TestAddrHygiene:
                     )
                 await asyncio.sleep(0.5)  # let the frames dispatch
                 # Tried bucket untouched; gossip book holds at most the
-                # attacker's initial token burst (64) + seeds, not 1280.
+                # attacker's token budget, not the full 1280 streamed.
+                # Budget on localhost: the base burst (64) + the one
+                # solicited grant issued to 127.0.0.1 when A dialed B —
+                # the attacker shares its victim's host here, a test-
+                # topology artifact; on distinct hosts it gets 64.
                 assert tried_before <= set(a._tried_addrs)
                 flood_learned = sum(
                     1 for (h, _p) in a._known_addrs if h.startswith("10.9.")
                 )
-                assert flood_learned <= 66
+                assert flood_learned <= 130
                 writer.close()
             finally:
                 await a.stop()
