@@ -298,8 +298,11 @@ class Node:
         #: quick reconnects flush the whole gossip book) and bounded the
         #: same way against address-cycling attackers.
         self._addr_budgets: dict[str, list[float]] = {}
-        #: Pool mutation count at the last persisted checkpoint.
+        #: Pool mutation count at the last persisted checkpoint, and the
+        #: in-flight checkpoint writer task (stop() drains it before the
+        #: final synchronous save — see _checkpoint_mempool).
         self._mempool_saved_at = 0
+        self._mempool_io: asyncio.Task | None = None
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
         self._sessions: set[asyncio.Task] = set()  # live inbound handlers
@@ -360,11 +363,16 @@ class Node:
 
     async def _checkpoint_mempool(self) -> None:
         """Periodic crash checkpoint: skipped when the pool is unchanged
-        since the last save, and the encoding + atomic write run in a
+        since the last save; the encoding AND atomic write both run in a
         worker thread — a near-capacity pool (~tens of MB) must not
         stall frame reads, ping deadlines, or mining for the duration.
         The snapshot itself is taken on the event loop, where all pool
-        mutation happens, so it is internally consistent."""
+        mutation happens, so it is internally consistent (transactions
+        are frozen dataclasses — safe to serialize off-thread).  The
+        worker future is exposed as ``_mempool_io`` so ``stop()`` can
+        wait it out: cancelling this coroutine does NOT stop the thread,
+        and a stale checkpoint landing after the shutdown save would
+        silently roll the file back."""
         from p1_tpu.mempool import dump_mempool, write_mempool_file
 
         path = self._mempool_path()
@@ -372,13 +380,18 @@ class Node:
             return
         mutations = self.mempool.mutations
         rows = self.mempool.snapshot()
-        try:
-            await asyncio.to_thread(
-                write_mempool_file, dump_mempool(rows), path
+        self._mempool_io = asyncio.create_task(
+            asyncio.to_thread(
+                lambda: write_mempool_file(dump_mempool(rows), path)
             )
+        )
+        try:
+            await self._mempool_io
             self._mempool_saved_at = mutations
         except OSError as e:
             log.warning("could not persist mempool %s: %s", path, e)
+        finally:
+            self._mempool_io = None
 
     def _load_addr_book(self) -> None:
         """Resume discovery state: a restarting node re-joins the network
@@ -536,6 +549,12 @@ class Node:
             self._server.close()
             await self._server.wait_closed()
         self._save_addr_book()
+        if self._mempool_io is not None:
+            # A cancelled housekeeping task cannot cancel its worker
+            # THREAD: let any in-flight checkpoint write finish before
+            # the authoritative shutdown save, or the stale file could
+            # land second and roll back every admission since.
+            await asyncio.gather(self._mempool_io, return_exceptions=True)
         self._save_mempool()
         if self.store is not None:
             self.store.close()
