@@ -192,6 +192,26 @@ class Chain:
     def height_of(self, block_hash: bytes) -> int:
         return self._index[block_hash].height
 
+    def best_block_within(self, ts_bound: int) -> Block:
+        """The most-work block (main chain or branch) whose timestamp is
+        <= ``ts_bound``.  Serves the miner's hostile-anchor policy
+        (node.py _mining_parent): when the tip's stamp is absurdly far
+        past wall time, honest mining continues from the heaviest sane
+        block — which includes the policy fork's own earlier blocks, so
+        the honest branch makes progress instead of re-mining one
+        candidate forever.  O(index); only called in that rare mode.
+        Genesis always qualifies (its stamp is a fixed past constant)."""
+        best = self._index[self.genesis.block_hash()]
+        for entry in self._index.values():
+            if entry.block.header.timestamp > ts_bound:
+                continue
+            if entry.work > best.work or (
+                entry.work == best.work
+                and entry.block.block_hash() < best.block.block_hash()
+            ):
+                best = entry
+        return best.block
+
     def balance(self, account: str) -> int:
         """``account``'s balance at the current tip (consensus ledger) —
         never negative, because an overdrawing block cannot connect."""
@@ -463,13 +483,19 @@ class Chain:
             return AddStatus.REJECTED, (
                 f"difficulty {block.header.difficulty} != required {expected}"
             )
-        if (
-            self.retarget is not None
-            and block.header.timestamp <= prev.block.header.timestamp
-        ):
-            # Strictly increasing timestamps make the retarget span
-            # positive and time-freezing unprofitable (core/retarget.py).
-            return AddStatus.REJECTED, "timestamp does not increase over parent"
+        if self.retarget is not None:
+            # Strict increase (positive retarget spans; time-freezing
+            # unprofitable) + the forward-dating cap with its height-1
+            # bootstrap-anchor exemption — the rule lives in ONE place,
+            # RetargetRule.timestamp_violation, shared with the replay
+            # verifier and the miner's clamp.
+            reason = self.retarget.timestamp_violation(
+                prev.height,
+                prev.block.header.timestamp,
+                block.header.timestamp,
+            )
+            if reason is not None:
+                return AddStatus.REJECTED, reason
         if not prevalidated:
             try:
                 check_block(

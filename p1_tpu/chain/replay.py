@@ -115,10 +115,13 @@ def replay_host(headers: list[BlockHeader], retarget=None) -> ReplayReport:
             expected = _expected_difficulty_at(headers, i, retarget)
         pow_ok = i == 0 or meets_target(digest, expected)
         diff_ok = header.difficulty == expected
-        ts_ok = (
-            retarget is None
-            or i == 0
-            or header.timestamp > headers[i - 1].timestamp
+        # The shared timestamp rule (strict increase + forward cap with
+        # the height-1 anchor exemption) — RetargetRule owns it.
+        ts_ok = retarget is None or i == 0 or (
+            retarget.timestamp_violation(
+                i - 1, headers[i - 1].timestamp, header.timestamp
+            )
+            is None
         )
         if not (pow_ok and diff_ok and ts_ok and header.prev_hash == prev_digest):
             first_invalid = i

@@ -705,6 +705,14 @@ async def _run_node(args, miner=None) -> int:
 
 def cmd_node(args) -> int:
     _retarget_rule(args)  # flag-pair validation: clean error, no traceback
+    # The CPU miner thread is GIL-bound (hashlib holds the GIL for
+    # 80-byte messages) and the default 5 ms switch interval lets it
+    # convoy the event loop hard enough that a wallet's HELLO can starve
+    # past its 10 s timeout on 1-vCPU hosts (observed live).  A 0.5 ms
+    # interval hands the loop the GIL ~10x more often for a few percent
+    # of hash throughput — only worth paying in the node process, where
+    # p2p responsiveness under mining load is the product.
+    sys.setswitchinterval(0.0005)
     if getattr(args, "platform", None):
         import jax
 

@@ -28,7 +28,9 @@ from p1_tpu.core.retarget import RetargetRule
 
 GENESIS_VERSION = 1
 GENESIS_TIMESTAMP = 1735689600  # 2025-01-01T00:00:00Z, fixed forever
-_RETARGET_TAG = b"p1-retarget-v1"
+#: v2: the commitment gained max_step (the forward-dating bound) — a
+#: chain with a different cap is a different chain.
+_RETARGET_TAG = b"p1-retarget-v2"
 
 
 @functools.lru_cache(maxsize=256)
@@ -43,7 +45,11 @@ def make_genesis(
         merkle = sha256d(
             _RETARGET_TAG
             + struct.pack(
-                ">III", retarget.window, retarget.spacing, retarget.max_adjust
+                ">IIII",
+                retarget.window,
+                retarget.spacing,
+                retarget.max_adjust,
+                retarget.max_step,
             )
         )
     header = BlockHeader(

@@ -101,6 +101,12 @@ BAN_DURATION_S = 30.0
 #: addresses must not grow node memory one deque per address forever —
 #: on overflow, stale entries are pruned first, then oldest-arbitrary.
 MAX_TRACKED_HOSTS = 4096
+#: Mining POLICY (never consensus): refuse to extend a tip stamped more
+#: than this far past local wall time — the hostile-bootstrap-anchor
+#: guard (_mining_parent).  30 days: unreachable by honest +1 s/block
+#: clock drift at any plausible block count, decades under any attack
+#: anchor worth mounting.
+ANCHOR_SLACK_S = 30 * 86_400
 
 
 class _Refused(Exception):
@@ -1464,21 +1470,70 @@ class Node:
         if self._abort is not None:
             self._abort.set()
 
-    def _assemble(self) -> Block:
+    def _mining_parent(self) -> Block:
+        """The block this miner chooses to extend.  Normally the tip —
+        but MINING POLICY (not consensus: the DAG's validity rules stay
+        wall-clock-free) refuses to extend a block stamped more than
+        ``ANCHOR_SLACK_S`` past local wall time.  The height-1
+        bootstrap-anchor exemption (core/retarget.py) means a hostile
+        first miner CAN stamp decades ahead and validly poison the
+        chain clock — every later honest stamp would crawl at parent+1,
+        spans would read seconds, and difficulty would ratchet toward
+        255 until the chain stalls.  This guard is how the honest
+        majority responds: their miners build from the heaviest
+        sanely-stamped block instead, out-working and orphaning the
+        poisoned suffix.  Wall time influences only which branch THIS
+        miner grows, never what any node accepts — replay determinism
+        holds.
+
+        The slack is deliberately enormous compared to the consensus
+        cap: honest chains legitimately run their clock ahead of wall
+        time during mining bursts (strict increase forces +1 s stamps
+        at any block rate, so a 5k-block soak sits ~1.4 h "in the
+        future"; an early too-tight bound of now + max_increment wedged
+        real nodes at height ~33, hot-looping one candidate).  Only an
+        anchor-style jump — months-to-decades, impossible to reach by
+        +1 s crawling at any realistic block count — trips it.
+        """
         tip = self.chain.tip
-        coinbase = Transaction.coinbase(self.miner_id, self.chain.height + 1)
-        txs = (
-            coinbase,
-            *self.mempool.select(max(0, self.config.max_block_txs - 1)),
-        )
+        if self.chain.retarget is None:
+            return tip
+        bound = int(time.time()) + ANCHOR_SLACK_S
+        if tip.header.timestamp <= bound:
+            return tip
+        return self.chain.best_block_within(bound)
+
+    def _assemble(self) -> Block:
+        parent = self._mining_parent()
+        on_tip = parent.block_hash() == self.chain.tip_hash
+        height = self.chain.height_of(parent.block_hash()) + 1
+        coinbase = Transaction.coinbase(self.miner_id, height)
+        if on_tip:
+            txs = (
+                coinbase,
+                *self.mempool.select(max(0, self.config.max_block_txs - 1)),
+            )
+        else:
+            # Policy fork off a poisoned suffix: pool selection is only
+            # guaranteed connectable against the TIP's ledger, so carry
+            # the coinbase alone until the honest branch takes over.
+            txs = (coinbase,)
+        ts = max(parent.header.timestamp + 1, int(time.time()))
+        if self.chain.retarget is not None:
+            # The shared clamp: largest consensus-valid stamp (strict
+            # increase; forward cap from height 2 — a runaway local
+            # clock must not assemble a block every peer rejects).
+            ts = self.chain.retarget.clamp_timestamp(
+                height - 1, parent.header.timestamp, ts
+            )
         header = BlockHeader(
             version=1,
-            prev_hash=tip.block_hash(),
+            prev_hash=parent.block_hash(),
             merkle_root=merkle_root([tx.txid() for tx in txs]),
-            timestamp=max(tip.header.timestamp + 1, int(time.time())),
+            timestamp=ts,
             # What consensus requires of the next block — equals the
             # configured difficulty unless a retarget rule has moved it.
-            difficulty=self.chain.next_difficulty(),
+            difficulty=self.chain.required_difficulty(parent.block_hash()),
             nonce=0,
         )
         return Block(header, txs)

@@ -9,6 +9,7 @@ rather than merely erroring late.
 """
 
 import asyncio
+import random
 
 import pytest
 
@@ -117,7 +118,9 @@ class TestChainEnforcement:
 
     def test_difficulty_steps_down_when_slow(self):
         chain = Chain(DIFF + 2, retarget=RULE)
-        _extend(chain, 3, dt=1000)  # span 3000 >= 4x expected
+        # span 1200 >= 4x expected (300); dt sits exactly at the
+        # per-block forward cap of max_step * spacing = 400 s.
+        _extend(chain, 3, dt=400)
         assert chain.next_difficulty() == DIFF
 
     def test_wrong_difficulty_rejected_contextually(self):
@@ -450,3 +453,209 @@ class TestRetargetingNode:
             )
             assert chain.add_block(block).status is AddStatus.ACCEPTED
         assert sum(chain.balances_snapshot().values()) == 9 * BLOCK_REWARD
+
+
+class TestForwardDatingBound:
+    """The time-warp hardening (VERDICT r4 weak #2): consensus caps the
+    per-block timestamp increment at max_step * spacing, so forward-dated
+    time must be accumulated block by block instead of claimed in one
+    inflated window-closing stamp."""
+
+    def test_height_one_anchors_clock_freely(self):
+        """Genesis carries a fixed 2025 timestamp (chain identity), so
+        block 1 must be allowed an arbitrary forward jump — it anchors
+        the chain clock at the real bootstrap time.  Capped, the clock
+        could never catch wall time and difficulty would ratchet to 1
+        (observed live before the exemption)."""
+        chain = Chain(DIFF, retarget=RULE)
+        tip = chain.tip
+        year_ahead = tip.header.timestamp + 365 * 86_400
+        anchor = _child(tip, chain.next_difficulty(), year_ahead)
+        assert chain.add_block(anchor).status is AddStatus.ACCEPTED
+
+    def test_increment_above_cap_rejected_from_height_two(self):
+        chain = Chain(DIFF, retarget=RULE)
+        _extend(chain, 1, dt=1)  # height 1: the exempt clock anchor
+        tip = chain.tip
+        cap = RULE.max_increment  # max_step * spacing
+        over = _child(tip, chain.next_difficulty(), tip.header.timestamp + cap + 1)
+        res = chain.add_block(over)
+        assert res.status is AddStatus.REJECTED and "cap" in res.reason
+        at_cap = _child(tip, chain.next_difficulty(), tip.header.timestamp + cap)
+        assert chain.add_block(at_cap).status is AddStatus.ACCEPTED
+
+    def test_assemble_clamps_to_cap(self, monkeypatch):
+        from p1_tpu.config import NodeConfig
+        from p1_tpu.node import Node
+
+        node = Node(
+            NodeConfig(
+                difficulty=DIFF,
+                mine=False,
+                retarget_window=RULE.window,
+                target_spacing=RULE.spacing,
+            )
+        )
+        import p1_tpu.node.node as node_mod
+
+        # Height 1 (tip = genesis): the assembler must NOT clamp — it is
+        # the bootstrap anchor that brings the chain clock to wall time.
+        far = node.chain.tip.header.timestamp + 10 * RULE.max_increment
+        monkeypatch.setattr(node_mod.time, "time", lambda: far)
+        anchor = node._assemble()
+        assert anchor.header.timestamp == far
+        # From height 2 on, a runaway local clock is clamped to the cap.
+        _extend(node.chain, 1, dt=1)
+        tip_ts = node.chain.tip.header.timestamp
+        monkeypatch.setattr(
+            node_mod.time, "time", lambda: tip_ts + 10 * RULE.max_increment
+        )
+        block = node._assemble()
+        assert block.header.timestamp == tip_ts + RULE.max_increment
+
+    @staticmethod
+    def _simulate(alpha: float, capped: bool, windows: int, seed: int,
+                  rule: RetargetRule, d0: int) -> list[int]:
+        """Difficulty trajectory of a chain under a lone forward-dating
+        miner owning fraction ``alpha`` of the hashrate.
+
+        Real block times are exponential with mean spacing * 2^(d - d0)
+        (d0 = the difficulty matching the network's real hashrate).
+        Honest miners stamp real time clamped into consensus bounds;
+        the attacker always stamps the maximum the rules allow —
+        parent + cap when capped, enough for a full max_adjust drop at a
+        window close when not.  Uses the SAME RetargetRule.adjusted as
+        consensus, so the simulation measures the deployed rule.
+        """
+        rng = random.Random(seed)
+        cap = rule.max_increment
+        d = d0
+        chain_ts = 0.0  # last block's claimed time
+        real = 0.0
+        out = []
+        for _ in range(windows):
+            anchor = chain_ts
+            for blk in range(rule.window):
+                real += rng.expovariate(1.0) * rule.spacing * 2.0 ** (d - d0)
+                if rng.random() < alpha:
+                    if capped:
+                        chain_ts = chain_ts + cap
+                    else:
+                        # One stamp buys the whole span needed for the
+                        # maximum drop (plus slack) — the uncapped abuse.
+                        want = anchor + (2 ** rule.max_adjust + 1) * rule.expected_span
+                        chain_ts = max(chain_ts + 1, want)
+                else:
+                    honest = max(chain_ts + 1, real)
+                    if capped:
+                        honest = min(honest, chain_ts + cap)
+                    chain_ts = honest
+            span = int(chain_ts - anchor)
+            d = rule.adjusted(d, span)
+            out.append(d)
+        return out
+
+    def test_lone_attacker_bounded_with_cap_collapses_without(self):
+        """The documented claims of core/retarget.py, measured: under
+        the default cap (max_step=4) a quarter-hashrate forward-dating
+        miner cannot hold difficulty below the honest equilibrium, while
+        the SAME attacker — even at 10% — with the cap removed ratchets
+        the chain to difficulty 1."""
+        rule = RetargetRule(window=16, spacing=100)
+        d0 = 20
+        windows = 400
+        for seed in (7, 23):
+            # Honest baseline: equilibrium held within one bit.
+            honest = self._simulate(0.0, True, windows, seed, rule, d0)
+            assert min(honest) >= d0 - 1 and max(honest) <= d0 + 1
+            # 25% attacker, capped: time-average within one bit of d0,
+            # sustained excursions below d0 - max_adjust essentially
+            # absent (random-walk dips only, <= 5% of windows).
+            capped = self._simulate(0.25, True, windows, seed, rule, d0)
+            assert sum(capped) / len(capped) >= d0 - 1
+            below = sum(1 for d in capped if d < d0 - rule.max_adjust)
+            assert below / len(capped) <= 0.05
+            # 10% attacker, uncapped: total collapse — the attack the
+            # cap exists to stop.
+            uncapped = self._simulate(0.10, False, windows, seed, rule, d0)
+            assert min(uncapped) == 1
+            assert sum(uncapped) / len(uncapped) <= 5
+
+    def test_near_majority_attacker_is_the_documented_limit(self):
+        """The honest residual, asserted so the docs can't overclaim: a
+        ~45% forward-dating miner DOES grind a capped chain down over
+        many windows (per-window rate still clamped to max_adjust).
+        That is the fundamental limit of wall-clock-free timestamping —
+        at near-majority hashrate the chain is reorg-attackable anyway."""
+        rule = RetargetRule(window=16, spacing=100)
+        d0 = 20
+        traj = self._simulate(0.45, True, 400, 11, rule, d0)
+        drops = [b - a for a, b in zip(traj, traj[1:])]
+        assert min(drops) >= -rule.max_adjust  # rate clamp holds
+        assert sum(traj) / len(traj) < d0 - rule.max_adjust  # but it sinks
+
+    def test_replay_host_enforces_forward_cap(self):
+        """The light-client verifier applies the same forward-dating cap
+        as connect-time consensus — a forward-dated header file must not
+        verify for SPV/headers-first clients either."""
+        from p1_tpu.chain import replay_host
+
+        g = make_genesis(DIFF, RULE)
+        b1 = _child(g, DIFF, g.header.timestamp + 1)
+        good = _child(b1, DIFF, b1.header.timestamp + RULE.max_increment)
+        bad = _child(b1, DIFF, b1.header.timestamp + RULE.max_increment + 1)
+        assert replay_host(
+            [g.header, b1.header, good.header], retarget=RULE
+        ).valid
+        report = replay_host(
+            [g.header, b1.header, bad.header], retarget=RULE
+        )
+        assert not report.valid and report.first_invalid == 2
+
+    def test_hostile_bootstrap_anchor_gets_orphaned_by_policy(self):
+        """The height-1 exemption means a hostile first miner CAN stamp
+        the far future (consensus accepts it) — the defense is mining
+        POLICY: honest miners refuse to extend a tip stamped past their
+        wall clock + cap, build from the last sane ancestor, and
+        out-work the poisoned suffix."""
+        import time as _time
+
+        from p1_tpu.config import NodeConfig
+        from p1_tpu.node import Node
+
+        node = Node(
+            NodeConfig(
+                difficulty=DIFF,
+                mine=False,
+                retarget_window=RULE.window,
+                target_spacing=RULE.spacing,
+            )
+        )
+        g = node.chain.tip
+        hostile = _child(
+            g,
+            node.chain.next_difficulty(),
+            # ~70 years ahead: far past any wall clock, within the
+            # header's u32 timestamp range.
+            g.header.timestamp + 70 * 365 * 86_400,
+        )
+        assert node.chain.add_block(hostile).status is AddStatus.ACCEPTED
+        assert node.chain.tip_hash == hostile.block_hash()
+        # Policy: the assembler walks back to genesis, not the poison.
+        parent = node._mining_parent()
+        assert parent.block_hash() == g.block_hash()
+        candidate = node._assemble()
+        assert candidate.header.prev_hash == g.block_hash()
+        # Its stamp is the real bootstrap anchor (height 1: no cap).
+        assert abs(candidate.header.timestamp - int(_time.time())) < 5
+        # Seal honest blocks on the sane branch until it out-works the
+        # hostile one and the chain reorgs away from the poison.
+        for _ in range(2):
+            candidate = node._assemble()
+            sealed = _MINER.search_nonce(candidate.header)
+            assert sealed is not None
+            res = node.chain.add_block(Block(sealed, candidate.txs))
+            assert res.status is AddStatus.ACCEPTED, res.reason
+        assert node.chain.tip_hash != hostile.block_hash()
+        assert node.chain.tip.header.timestamp < hostile.header.timestamp
+        assert node.chain.height == 2  # the honest branch won
