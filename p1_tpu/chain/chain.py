@@ -202,8 +202,14 @@ class Chain:
         candidate forever.  O(index); only called in that rare mode.
         Genesis always qualifies (its stamp is a fixed past constant)."""
         best = self._index[self.genesis.block_hash()]
-        for entry in self._index.values():
-            if entry.block.header.timestamp > ts_bound:
+        for bhash, entry in self._index.items():
+            if (
+                entry.block.header.timestamp > ts_bound
+                or bhash in self._invalid
+            ):
+                # Invalid branches keep their index entries (permanent
+                # rejection memory) but nothing may mine on them — the
+                # same exclusion _best_valid_tip applies.
                 continue
             if entry.work > best.work or (
                 entry.work == best.work

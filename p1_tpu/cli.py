@@ -384,6 +384,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--duration", type=float, default=10.0)
     p.add_argument("--base-port", type=int, default=19444)
     p.add_argument(
+        "--byzantine",
+        type=int,
+        default=0,
+        help="run this many actively malicious participants alongside "
+        "the honest mesh (invalid signatures, overdraws, replays, "
+        "forged compact blocks, ADDR spam, oversized frames — each "
+        "from its own loopback alias so bans land on the attacker); "
+        "the summary asserts the honest net converged, conserved, "
+        "banned them, and stayed within memory bounds",
+    )
+    p.add_argument(
         "--tx-rate",
         type=float,
         default=0.0,
@@ -1456,7 +1467,7 @@ def cmd_compact(args) -> int:
 # -- net -----------------------------------------------------------------
 
 
-def _net_inject_txs(
+async def _inject_txs(
     ports, keys, difficulty, deadline, rate, retarget=None
 ) -> tuple[int, int]:
     """Drive a live economy during a `p1 net` run: ~``rate`` transfers/sec,
@@ -1472,49 +1483,255 @@ def _net_inject_txs(
 
     tag = genesis_hash(difficulty, retarget)
     submitted = failed = 0
-
-    async def run() -> None:
-        nonlocal submitted, failed
-        rng = random.Random(0xD1CE)
-        period = 1.0 / rate
-        while time.time() < deadline - 1.0:
-            i = rng.randrange(len(keys))
-            recipient = keys[rng.randrange(len(keys))].account
-            try:
-                state = await get_account(
+    rng = random.Random(0xD1CE)
+    period = 1.0 / rate
+    while time.time() < deadline - 1.0:
+        i = rng.randrange(len(keys))
+        recipient = keys[rng.randrange(len(keys))].account
+        try:
+            state = await get_account(
+                "127.0.0.1",
+                ports[i],
+                keys[i].account,
+                difficulty,
+                timeout=5,
+                retarget=retarget,
+            )
+            amount = rng.randint(1, 5)
+            if state.balance >= amount + 1:
+                tx = Transaction.transfer(
+                    keys[i], recipient, amount, 1, state.next_seq, chain=tag
+                )
+                await send_tx(
                     "127.0.0.1",
                     ports[i],
-                    keys[i].account,
+                    tx,
                     difficulty,
                     timeout=5,
                     retarget=retarget,
                 )
-                amount = rng.randint(1, 5)
-                if state.balance >= amount + 1:
-                    tx = Transaction.transfer(
-                        keys[i], recipient, amount, 1, state.next_seq, chain=tag
-                    )
-                    await send_tx(
-                        "127.0.0.1",
-                        ports[i],
-                        tx,
-                        difficulty,
-                        timeout=5,
-                        retarget=retarget,
-                    )
-                    submitted += 1
-            except (
-                ConnectionError,
-                OSError,
-                ValueError,
-                asyncio.TimeoutError,
-                asyncio.IncompleteReadError,
-            ):
-                failed += 1
-            await asyncio.sleep(period)
-
-    asyncio.run(run())
+                submitted += 1
+        except (
+            ConnectionError,
+            OSError,
+            ValueError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+        ):
+            failed += 1
+        await asyncio.sleep(period)
     return submitted, failed
+
+
+async def _byzantine_actor(
+    actor: int, ports, difficulty, deadline, retarget, stats: dict
+) -> None:
+    """One actively malicious participant (VERDICT r4 weak #5): connects
+    to honest nodes from its own loopback alias (127.0.0.{10+actor}, so
+    misbehavior bans hit the attacker's address, not the honest mesh's)
+    and cycles the whole hostile repertoire — invalid signatures,
+    overdraws, replays of confirmed transfers, forged compact-block
+    material, unsolicited BLOCKTXN, ADDR spam, oversized frames, random
+    garbage.  Counts what it sent and how often the node refused it at
+    accept time (= an active ban).  Every attack is fire-and-observe:
+    the honest invariants are asserted from the nodes' final statuses,
+    not from here."""
+    import dataclasses
+    import random
+    import struct
+
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.core.header import BlockHeader
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.node import protocol
+    from p1_tpu.node.protocol import Hello, MsgType
+
+    rng = random.Random(0xBAD + actor)
+    source = f"127.0.0.{10 + actor}"
+    genesis = make_genesis(difficulty, retarget)
+    gh = genesis.block_hash()
+    tag = gh
+    key = Keypair.from_seed_text(f"p1-byz-{actor}")
+    harvested_txs: list[bytes] = []  # raw TX payloads seen in gossip
+    harvested_headers: list[BlockHeader] = []
+
+    def bump(name: str) -> None:
+        stats["attacks"][name] = stats["attacks"].get(name, 0) + 1
+
+    while time.time() < deadline - 1.0:
+        port = ports[rng.randrange(len(ports))]
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port, local_addr=(source, 0)
+            )
+        except OSError:
+            await asyncio.sleep(0.2)
+            continue
+        try:
+            first = await asyncio.wait_for(protocol.read_frame(reader), 5)
+            mtype, _ = protocol.decode(first)
+            assert mtype is MsgType.HELLO
+        except asyncio.TimeoutError:
+            # Slow HELLO ≠ ban: a GIL-loaded honest node can take
+            # seconds — counting it as a refusal would let bans_fired
+            # read true with the ban machinery broken.
+            stats["slow_hellos"] = stats.get("slow_hellos", 0) + 1
+            writer.close()
+            await asyncio.sleep(0.2)
+            continue
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            ValueError,
+        ):
+            # Immediate hang-up before HELLO: the accept-time ban said no.
+            stats["refused_connects"] += 1
+            writer.close()
+            await asyncio.sleep(0.2)
+            continue
+        harvester = None
+        try:
+            await protocol.write_frame(
+                writer, protocol.encode_hello(Hello(gh, 0, 0, 0))
+            )
+            session_end = min(deadline - 0.5, time.time() + 2.0)
+
+            async def harvest() -> None:
+                while True:
+                    payload = await protocol.read_frame(reader)
+                    if not payload:
+                        continue
+                    if payload[0] == MsgType.TX and len(harvested_txs) < 64:
+                        harvested_txs.append(payload)
+                    elif payload[0] == MsgType.BLOCK:
+                        try:
+                            _, (_ts, blk) = protocol.decode(payload)
+                            if len(harvested_headers) < 16:
+                                harvested_headers.append(blk.header)
+                        except ValueError:
+                            pass
+
+            harvester = asyncio.create_task(harvest())
+            while time.time() < session_end:
+                attack = rng.choice(
+                    (
+                        "badsig",
+                        "overdraw",
+                        "replay",
+                        "cblock",
+                        "blocktxn",
+                        "addr_spam",
+                        "garbage",
+                    )
+                )
+                if attack == "replay" and not harvested_txs:
+                    attack = "garbage"  # nothing harvested yet
+                if attack == "cblock" and not harvested_headers:
+                    attack = "garbage"
+                if attack == "badsig":
+                    tx = Transaction.transfer(
+                        key, "p1deadbeefdeadbeef", 1, 1, 0, chain=tag
+                    )
+                    forged = dataclasses.replace(
+                        tx, sig=bytes(64)  # zeroed signature
+                    )
+                    await protocol.write_frame(
+                        writer, protocol.encode_tx(forged)
+                    )
+                elif attack == "overdraw":
+                    tx = Transaction.transfer(
+                        key,
+                        "p1deadbeefdeadbeef",
+                        10**12,  # the attacker's balance is zero
+                        1,
+                        0,
+                        chain=tag,
+                    )
+                    await protocol.write_frame(writer, protocol.encode_tx(tx))
+                elif attack == "replay":
+                    # A transfer harvested from gossip earlier: by now
+                    # confirmed on-chain — a definite nonce replay.
+                    await protocol.write_frame(
+                        writer, harvested_txs[rng.randrange(len(harvested_txs))]
+                    )
+                elif attack == "cblock":
+                    # Real recent header with the nonce bumped: parent
+                    # known, PoW broken — must die at the work gate.
+                    h = harvested_headers[-1]
+                    fake = dataclasses.replace(h, nonce=h.nonce ^ 1)
+                    payload = (
+                        bytes([MsgType.CBLOCK])
+                        + struct.pack(">d", time.time())
+                        + fake.serialize()
+                        + struct.pack(">HH", 1, 0)
+                        + bytes(32)
+                    )
+                    await protocol.write_frame(writer, payload)
+                elif attack == "blocktxn":
+                    await protocol.write_frame(
+                        writer,
+                        protocol.encode_blocktxn(
+                            rng.randbytes(32), [rng.randbytes(40)]
+                        ),
+                    )
+                elif attack == "addr_spam":
+                    addrs = [
+                        (f"10.66.{rng.randrange(256)}.{rng.randrange(256)}",
+                         rng.randrange(1, 0xFFFF))
+                        for _ in range(64)
+                    ]
+                    await protocol.write_frame(
+                        writer, protocol.encode_addr(addrs)
+                    )
+                else:  # garbage: malformed bytes — a scorable violation
+                    writer.write(
+                        (rng.randrange(1, 64)).to_bytes(4, "big")
+                        + rng.randbytes(rng.randrange(1, 64))
+                    )
+                    await writer.drain()
+                bump(attack)
+                await asyncio.sleep(0.05)
+            # Sign off with the canonical scorable violation so bans
+            # accumulate: a hostile length prefix.
+            writer.write((64 << 20).to_bytes(4, "big"))
+            await writer.drain()
+            bump("oversized")
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass  # node dropped us mid-attack: working as intended
+        finally:
+            if harvester is not None:
+                harvester.cancel()  # on every exit path, or the orphaned
+                # task dies loudly with an unretrieved IncompleteReadError
+            writer.close()
+        await asyncio.sleep(0.1)
+
+
+async def _net_drive(
+    ports, keys, difficulty, deadline, rate, n_byzantine, retarget=None
+):
+    """Run the benign economy and the byzantine actors concurrently."""
+    byz_stats = {"attacks": {}, "refused_connects": 0, "slow_hellos": 0}
+    tasks = []
+    if rate > 0:
+        tasks.append(
+            _inject_txs(ports, keys, difficulty, deadline, rate, retarget)
+        )
+    for actor in range(n_byzantine):
+        tasks.append(
+            _byzantine_actor(
+                actor, ports, difficulty, deadline, retarget, byz_stats
+            )
+        )
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    submitted = failed = 0
+    for r in results:
+        if isinstance(r, tuple):
+            submitted, failed = r
+        elif isinstance(r, BaseException):
+            raise r
+    return submitted, failed, byz_stats
 
 
 def cmd_net(args) -> int:
@@ -1594,14 +1811,19 @@ def cmd_net(args) -> int:
             proc.stdin.write(f"{deadline!r}\n")
             proc.stdin.flush()  # leave stdin open: communicate() closes it
         txs_submitted = txs_failed = 0
-        if args.tx_rate > 0:
-            txs_submitted, txs_failed = _net_inject_txs(
-                ports,
-                keys,
-                args.difficulty,
-                deadline,
-                args.tx_rate,
-                retarget=net_rule,
+        byz_stats = None
+        n_byz = getattr(args, "byzantine", 0)
+        if args.tx_rate > 0 or n_byz > 0:
+            txs_submitted, txs_failed, byz_stats = asyncio.run(
+                _net_drive(
+                    ports,
+                    keys,
+                    args.difficulty,
+                    deadline,
+                    args.tx_rate,
+                    n_byz,
+                    retarget=net_rule,
+                )
             )
         for proc in procs:
             out, _ = proc.communicate(timeout=args.duration + 120)
@@ -1668,6 +1890,39 @@ def cmd_net(args) -> int:
         }
         if not conserved:
             result["converged"] = False  # fail loudly: consensus bug
+    if n_byz > 0 and byz_stats is not None:
+        # The byzantine soak's containment contract, asserted in the
+        # summary rather than left to log-reading: honest nodes must
+        # have (a) kept converging and conserving (checked above),
+        # (b) actually banned the attackers (their oversized/garbage
+        # frames are scorable, so refused connects must appear), and
+        # (c) stayed within their memory bounds — the address book and
+        # pool caps hold under spam.
+        from p1_tpu.mempool import Mempool
+        from p1_tpu.node.node import MAX_KNOWN_ADDRS, MAX_TRIED_ADDRS
+
+        attacks_sent = sum(byz_stats["attacks"].values())
+        bans_fired = byz_stats["refused_connects"] > 0
+        pool_cap = Mempool().max_txs  # the node's actual bound
+        memory_bounded = all(
+            s["known_addrs"] <= MAX_KNOWN_ADDRS + MAX_TRIED_ADDRS
+            and s["mempool"] <= pool_cap
+            for s in statuses
+        )
+        result["byzantine"] = {
+            "attackers": n_byz,
+            "attacks_sent": attacks_sent,
+            "attacks": byz_stats["attacks"],
+            "refused_connects": byz_stats["refused_connects"],
+            "slow_hellos": byz_stats["slow_hellos"],
+            "bans_fired": bans_fired,
+            "memory_bounded": memory_bounded,
+            "contained": bool(
+                result["converged"] and bans_fired and memory_bounded
+            ),
+        }
+        if not result["byzantine"]["contained"]:
+            result["converged"] = False
     print(json.dumps(result))
     return 0 if result["converged"] else 1
 
