@@ -344,16 +344,25 @@ class Chain:
 
     # -- mutation --------------------------------------------------------
 
-    def add_block(self, block: Block) -> AddResult:
+    def add_block(self, block: Block, trusted: bool = False) -> AddResult:
         """Index ``block`` (and any orphans it unblocks); report the outcome.
 
         The reorg paths in the result describe the net tip movement of the
         whole call — computed once against the tip as it was on entry, so
         an orphan cascade that moves the tip twice still reports one
         coherent removed/added pair.
+
+        ``trusted=True`` skips the stateless per-block checks (PoW,
+        merkle, signatures, coinbase rules) — strictly for records this
+        node itself validated before persisting (ChainStore's fast
+        resume: the store is exclusively flocked and append-only, so its
+        contents are this node's own past accepts).  Contextual rules
+        (difficulty schedule, timestamp bounds) and the connect-time
+        ledger/nonce validation still run, so the rebuilt state is
+        byte-identical to a full revalidation — tested both ways.
         """
         old_tip = self._tip_hash
-        status, reason = self._insert(block)
+        status, reason = self._insert(block, prevalidated=trusted)
         if status is not AddStatus.ACCEPTED:
             return AddResult(status, reason=reason)
 

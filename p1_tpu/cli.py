@@ -150,6 +150,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="coinbase recipient id (default: random per process)",
     )
     p.add_argument("--store", default=None, help="chain persistence path")
+    p.add_argument(
+        "--revalidate-store",
+        action="store_true",
+        help="re-run full stateless validation (PoW, merkle, Ed25519) "
+        "over the stored chain at boot instead of the trusted fast "
+        "resume (the store is this node's own validated, flocked log)",
+    )
     p.add_argument("--duration", type=float, default=None, help="exit after N s")
     p.add_argument(
         "--deadline",
@@ -668,6 +675,7 @@ async def _run_node(args, miner=None) -> int:
         handshake_timeout_s=getattr(args, "handshake_timeout", 10.0),
         ping_interval_s=getattr(args, "ping_interval", 60.0),
         pong_timeout_s=getattr(args, "pong_timeout", 20.0),
+        revalidate_store=getattr(args, "revalidate_store", False),
     )
     node = Node(config, miner=miner)
     await node.start()
@@ -1599,19 +1607,29 @@ async def _byzantine_actor(
             session_end = min(deadline - 0.5, time.time() + 2.0)
 
             async def harvest() -> None:
-                while True:
-                    payload = await protocol.read_frame(reader)
-                    if not payload:
-                        continue
-                    if payload[0] == MsgType.TX and len(harvested_txs) < 64:
-                        harvested_txs.append(payload)
-                    elif payload[0] == MsgType.BLOCK:
-                        try:
-                            _, (_ts, blk) = protocol.decode(payload)
-                            if len(harvested_headers) < 16:
-                                harvested_headers.append(blk.header)
-                        except ValueError:
-                            pass
+                try:
+                    while True:
+                        payload = await protocol.read_frame(reader)
+                        if not payload:
+                            continue
+                        if (
+                            payload[0] == MsgType.TX
+                            and len(harvested_txs) < 64
+                        ):
+                            harvested_txs.append(payload)
+                        elif payload[0] == MsgType.BLOCK:
+                            try:
+                                _, (_ts, blk) = protocol.decode(payload)
+                                if len(harvested_headers) < 16:
+                                    harvested_headers.append(blk.header)
+                            except ValueError:
+                                pass
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    OSError,
+                ):
+                    return  # node hung up on us (a ban working) — done
 
             harvester = asyncio.create_task(harvest())
             while time.time() < session_end:
@@ -1702,8 +1720,9 @@ async def _byzantine_actor(
             pass  # node dropped us mid-attack: working as intended
         finally:
             if harvester is not None:
-                harvester.cancel()  # on every exit path, or the orphaned
-                # task dies loudly with an unretrieved IncompleteReadError
+                harvester.cancel()  # no-op if it already returned; its
+                # own except clause swallows disconnects, so no
+                # unretrieved-exception warnings either way
             writer.close()
         await asyncio.sleep(0.1)
 

@@ -55,6 +55,13 @@ class NodeConfig:
     handshake_timeout_s: float = 10.0
     ping_interval_s: float = 60.0
     pong_timeout_s: float = 20.0
+    #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
+    #: every stored block at boot instead of the trusted fast resume.
+    #: The store is this node's own flocked append-only log of blocks it
+    #: already validated, so the default trusts it (~3x faster boots at
+    #: 100k blocks, docs/PERF.md); set True when on-disk integrity is in
+    #: question.
+    revalidate_store: bool = False
 
     def retarget_rule(self):
         """The chain's ``RetargetRule``, or None for fixed difficulty."""

@@ -503,6 +503,9 @@ class Node:
                     self.config.difficulty,
                     blocks,
                     retarget=self.config.retarget_rule(),
+                    # Our own flocked log of blocks we already validated:
+                    # fast resume by default (store.py's trust argument).
+                    trusted=not self.config.revalidate_store,
                 )
             except ValueError as e:
                 self.store.close()
