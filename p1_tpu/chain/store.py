@@ -172,8 +172,9 @@ class ChainStore:
         end-to-end at 100k blocks — 4.6 s vs 14.0 s, docs/PERF.md;
         equivalence is tested).  The cost:
         on-disk bit-rot inside a record body goes undetected until it
-        disagrees with the network — ``p1 node --revalidate-store`` and
-        ``p1 replay --verify`` both exist for when that matters.
+        disagrees with the network — ``p1 node --revalidate-store`` is
+        the remedy when disk integrity is in question (header-only
+        tools like ``p1 replay`` check PoW/linkage, not bodies).
 
         Raises ValueError when records exist but NONE connect — that is a
         store from a chain with different parameters (wrong difficulty /

@@ -848,10 +848,6 @@ class TestTrustedResume:
     revalidation — tip, every balance, every nonce, side branches."""
 
     def test_trusted_equals_full_validation(self, tmp_path):
-        from txutil import account, stx
-
-        from p1_tpu.core.genesis import genesis_hash
-
         store_path = tmp_path / "chain.dat"
         chain = Chain(DIFF)
         store = ChainStore(store_path)
