@@ -57,6 +57,15 @@ parameter**.  Design (Bitcoin's shape, bit-granular):
   its +2h network-time rule — a wall clock — for exactly this reason).
   DAG-purity buys deterministic replay and testability at that price,
   and the cap prices the residual attack at near-majority hashrate.
+
+Resolution floor, observed live: timestamps are integer seconds and
+must strictly increase, so when real blocks arrive faster than 1/s the
+chain clock advances +1 s per block regardless of real time — a window
+of W blocks then spans ~W seconds and a rule with ``spacing`` near 1
+reads perfect pacing forever, never adjusting.  Retargeting only
+regulates block rates at or below ~1 block/second; pick ``spacing``
+comfortably above 1 (and expect the rule to RAISE difficulty until real
+spacing exceeds a second before it can see anything).
 """
 
 from __future__ import annotations
