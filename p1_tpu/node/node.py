@@ -139,6 +139,10 @@ class NodeMetrics:
     #: read loop — what the compact-relay savings are measured against.
     bytes_sent: int = 0
     bytes_received: int = 0
+    #: Liveness layer (protocol v8): keepalive probes sent to silent
+    #: peers, and peers evicted for staying silent through one.
+    pings_sent: int = 0
+    peers_evicted_idle: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -1033,12 +1037,14 @@ class Node:
                     # more PING + pong_timeout, then eviction — same reap,
                     # no misbehavior score (slowness is not a violation).
                     if ping_pending:
+                        self.metrics.peers_evicted_idle += 1
                         raise _Refused(
                             f"peer idle past keepalive deadline "
                             f"({self.config.ping_interval_s:.0f}s + "
                             f"{self.config.pong_timeout_s:.0f}s probe)"
                         ) from None
                     ping_pending = True
+                    self.metrics.pings_sent += 1
                     await self._send_guarded(
                         peer, protocol.encode_ping(self.instance_nonce)
                     )
@@ -1631,6 +1637,10 @@ class Node:
             "wire": {
                 "bytes_sent": self.metrics.bytes_sent,
                 "bytes_received": self.metrics.bytes_received,
+            },
+            "liveness": {
+                "pings_sent": self.metrics.pings_sent,
+                "peers_evicted_idle": self.metrics.peers_evicted_idle,
             },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly

@@ -428,3 +428,27 @@ class TestFrameReaderFuzz:
 
         for seed in range(8):
             run(scenario(seed))
+
+
+class TestLivenessMetrics:
+    def test_probe_and_eviction_counted(self):
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                reader, writer = await raw_hello(node.port, nonce=700)
+                assert await wait_until(lambda: node.peer_count() == 1)
+                await asyncio.wait_for(
+                    read_types_until_eof(reader), timeout=10
+                )
+                assert node.metrics.pings_sent >= 1
+                assert node.metrics.peers_evicted_idle == 1
+                assert node.status()["liveness"] == {
+                    "pings_sent": node.metrics.pings_sent,
+                    "peers_evicted_idle": 1,
+                }
+                writer.close()
+            finally:
+                await node.stop()
+
+        run(scenario())
