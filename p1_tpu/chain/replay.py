@@ -94,10 +94,11 @@ def replay_host(headers: list[BlockHeader], retarget=None) -> ReplayReport:
     retargeting chains: the required difficulty is recomputed per header
     from the sequence itself (it is a pure function of the headers), and
     timestamps must strictly increase — exactly the rules ``Chain``
-    enforces at connect time.  This is the engine the SPV docs point
+    enforces at connect time.  This is the oracle the SPV docs point
     wallet operators at when a one-header proof's work bar is not enough
-    (chain/proof.py).  The native/device engines stay fixed-difficulty
-    (the benchmark-config form); the host oracle is the retarget path.
+    (chain/proof.py); ``replay_native`` runs the identical retarget
+    rules ~100x faster in C++ (parity-fuzzed), while the DEVICE engine
+    stays fixed-difficulty (the benchmark-config form).
 
     Trust note: ``headers[0]`` self-attests the base difficulty — the
     CALLER must pin it to the chain it cares about
@@ -136,11 +137,18 @@ def replay_host(headers: list[BlockHeader], retarget=None) -> ReplayReport:
     )
 
 
-def replay_native(headers: list[BlockHeader]) -> ReplayReport:
+def replay_native(
+    headers: list[BlockHeader], retarget=None
+) -> ReplayReport:
     """C++ verification engine: one ctypes call over the packed headers
     (SHA-NI compressions, no per-header Python) — the native tier of
-    benchmark config 3, same rules as ``replay_host`` (its oracle)."""
-    from p1_tpu.hashx.native_backend import verify_header_chain
+    benchmark config 3, same rules as ``replay_host`` (its oracle),
+    including the contextual difficulty schedule + timestamp rules on
+    retargeting chains (``p1_verify_chain_retarget``)."""
+    from p1_tpu.hashx.native_backend import (
+        verify_header_chain,
+        verify_header_chain_retarget,
+    )
 
     difficulty = headers[0].difficulty if headers else 0
     # Packing is inside the timer: replay_host pays per-header serialize
@@ -148,7 +156,12 @@ def replay_native(headers: list[BlockHeader]) -> ReplayReport:
     # Python join costs about as much as the C verify itself).
     t0 = time.perf_counter()
     raw = b"".join(h.serialize() for h in headers)
-    first_invalid = verify_header_chain(raw, len(headers), difficulty)
+    if retarget is None:
+        first_invalid = verify_header_chain(raw, len(headers), difficulty)
+    else:
+        first_invalid = verify_header_chain_retarget(
+            raw, len(headers), retarget
+        )
     return ReplayReport(
         len(headers),
         first_invalid is None,

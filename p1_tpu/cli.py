@@ -564,14 +564,15 @@ def cmd_replay(args) -> int:
     from p1_tpu.hashx import get_backend
 
     rule = _retarget_rule(args)
-    if rule is not None and args.method != "host":
-        # The host oracle is the retarget-aware engine (chain/replay.py);
-        # the native/device tiers implement the benchmark-config form
-        # (fixed difficulty) and would mis-report an honest retargeting
-        # chain as invalid at the first adjustment.
+    if rule is not None and args.method in ("device", "both"):
+        # The host oracle and the C++ engine are both retarget-aware
+        # (chain/replay.py, native p1_verify_chain_retarget); the DEVICE
+        # tier implements the benchmark-config form (fixed difficulty:
+        # the lax.scan carries one target) and would mis-report an
+        # honest retargeting chain as invalid at the first adjustment.
         print(
-            "retargeting chains verify with --method host (the native/"
-            "device engines are fixed-difficulty)",
+            "retargeting chains verify with --method host/native/all "
+            "(the device engine is fixed-difficulty)",
             file=sys.stderr,
         )
         return 2
@@ -618,8 +619,11 @@ def cmd_replay(args) -> int:
     if args.method in ("host", "both", "all"):
         reports.append(replay_host(headers, retarget=rule))
     if args.method in ("native", "all"):
-        reports.append(replay_native(headers))
-    if args.method in ("device", "both", "all"):
+        reports.append(replay_native(headers, retarget=rule))
+    if args.method in ("device", "both", "all") and rule is None:
+        # Fixed-difficulty only (the guard above rejects explicit device
+        # requests on retargeting chains; `all` quietly covers what can
+        # run: host + native).
         reports.append(replay_device(headers))
         reports.append(replay_device(headers))  # warm (compile amortized)
     ok = all(r.valid for r in reports)

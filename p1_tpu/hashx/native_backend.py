@@ -44,6 +44,15 @@ def _load():
         ctypes.c_int,
     ]
     lib.p1_verify_chain.restype = ctypes.c_longlong
+    lib.p1_verify_chain_retarget.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.c_uint32,
+        ctypes.c_uint32,
+        ctypes.c_uint32,
+    ]
+    lib.p1_verify_chain_retarget.restype = ctypes.c_longlong
     return lib
 
 
@@ -57,6 +66,22 @@ def verify_header_chain(
     if len(raw) != 80 * n:
         raise ValueError(f"expected {80 * n} header bytes, got {len(raw)}")
     idx = _lib().p1_verify_chain(raw, n, difficulty, int(genesis_exempt))
+    return None if idx < 0 else int(idx)
+
+
+def verify_header_chain_retarget(raw: bytes, n: int, retarget) -> int | None:
+    """Retargeting form of ``verify_header_chain``: the C engine
+    recomputes the contextual difficulty schedule and enforces the
+    timestamp rules (strict increase + forward cap, height-1 anchor
+    exempt) — rule-for-rule ``replay_host(retarget=...)``.  The caller
+    validates header 0 against the chain's genesis identity, exactly as
+    the host path's callers do."""
+    if len(raw) != 80 * n:
+        raise ValueError(f"expected {80 * n} header bytes, got {len(raw)}")
+    idx = _lib().p1_verify_chain_retarget(
+        raw, n, retarget.window, retarget.spacing,
+        retarget.max_adjust, retarget.max_step,
+    )
     return None if idx < 0 else int(idx)
 
 

@@ -21,6 +21,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 #if defined(__x86_64__) || defined(_M_X64)
 #include <immintrin.h>
@@ -257,6 +258,36 @@ void p1_sha256d(const uint8_t* data, uint64_t len, uint8_t out[32]) {
   sha256(first, 32, out);
 }
 
+// The three-compression SHA-256d of one 80-byte header, with the
+// padding templates owned by a small reusable state so the two chain
+// verifiers below cannot drift apart on the byte layout.
+struct HeaderHasher {
+  // 80-byte message templates: chunk 2 = bytes 64..80 + pad + bitlen
+  // 640; second pass = 32-byte digest + pad + bitlen 256.
+  uint8_t block2[64];
+  uint8_t block3[64];
+  HeaderHasher() {
+    std::memset(block2, 0, sizeof(block2));
+    block2[16] = 0x80;
+    block2[62] = 0x02;
+    block2[63] = 0x80;
+    std::memset(block3, 0, sizeof(block3));
+    block3[32] = 0x80;
+    block3[62] = 0x01;
+    block3[63] = 0x00;
+  }
+  void digest(const uint8_t* h, uint32_t st2[8]) {
+    uint32_t st[8];
+    std::memcpy(st, IV, sizeof(st));
+    g_compress(st, h);
+    std::memcpy(block2, h + 64, 16);
+    g_compress(st, block2);
+    for (int j = 0; j < 8; ++j) put_be32(block3 + 4 * j, st[j]);
+    std::memcpy(st2, IV, 8 * sizeof(uint32_t));
+    g_compress(st2, block3);
+  }
+};
+
 // Verify a header chain laid out as n contiguous 80-byte headers
 // (layout: version[0..4) prev_hash[4..36) merkle[36..68) timestamp[68..72)
 // difficulty[72..76) nonce[76..80), all big-endian — core/header.py's
@@ -268,38 +299,95 @@ void p1_sha256d(const uint8_t* data, uint64_t len, uint8_t out[32]) {
 // (benchmark config 3).  Returns the first invalid index, or -1.
 long long p1_verify_chain(const uint8_t* headers, uint64_t n,
                           uint32_t difficulty, int genesis_exempt) {
-  // 80-byte message templates: chunk 2 = bytes 64..80 + pad + bitlen 640;
-  // second pass = 32-byte digest + pad + bitlen 256.
-  uint8_t block2[64];
-  std::memset(block2, 0, sizeof(block2));
-  block2[16] = 0x80;
-  block2[62] = 0x02;
-  block2[63] = 0x80;
-  uint8_t block3[64];
-  std::memset(block3, 0, sizeof(block3));
-  block3[32] = 0x80;
-  block3[62] = 0x01;
-  block3[63] = 0x00;
-
+  HeaderHasher hasher;
   uint8_t prev[32];
   std::memset(prev, 0, sizeof(prev));
   for (uint64_t i = 0; i < n; ++i) {
     const uint8_t* h = headers + 80 * i;
-    uint32_t st[8];
-    std::memcpy(st, IV, sizeof(st));
-    g_compress(st, h);
-    std::memcpy(block2, h + 64, 16);
-    g_compress(st, block2);
-    for (int j = 0; j < 8; ++j) put_be32(block3 + 4 * j, st[j]);
     uint32_t st2[8];
-    std::memcpy(st2, IV, sizeof(st2));
-    g_compress(st2, block3);
+    hasher.digest(h, st2);
 
     bool pow_ok = (genesis_exempt && i == 0) ||
                   leading_zero_bits_ge(st2, difficulty);
     bool diff_ok = be32(h + 72) == difficulty;
     bool link_ok = std::memcmp(h + 4, prev, 32) == 0;
     if (!(pow_ok && diff_ok && link_ok)) return (long long)i;
+    for (int j = 0; j < 8; ++j) put_be32(prev + 4 * j, st2[j]);
+  }
+  return -1;
+}
+
+// RetargetRule.adjusted (core/retarget.py), bit-for-bit: one bit harder
+// per halving of the expected span, one easier per doubling, clamped to
+// max_adjust and 1..255.  Integer-only, exactly the Python rule.
+static uint32_t rt_adjusted(uint32_t parent_d, long long span,
+                            uint32_t window, uint32_t spacing,
+                            uint32_t max_adjust) {
+  const long long expected = (long long)spacing * (long long)(window - 1);
+  if (span < 1) span = 1;
+  int adj = 0;
+  while (adj < (int)max_adjust && span * (2LL << adj) <= expected) adj++;
+  if (adj == 0) {
+    while (adj > -(int)max_adjust && span >= (2LL << (-adj)) * expected)
+      adj--;
+  }
+  long long nd = (long long)parent_d + adj;
+  if (nd < 1) nd = 1;
+  if (nd > 255) nd = 255;
+  return (uint32_t)nd;
+}
+
+// Retargeting variant of p1_verify_chain: same layout, but the required
+// difficulty is the CONTEXTUAL schedule (a pure function of the ancestor
+// headers — chain/chain.py), and the timestamp rules apply: strictly
+// increasing, with the forward-dating cap of max_step*spacing seconds per
+// block from height 2 on (height 1 is the bootstrap clock anchor —
+// core/retarget.py).  Header 0 is the genesis record: validated by
+// identity upstream (the Python caller checks the genesis hash), so PoW
+// is waived and its difficulty field seeds the schedule.  Mirrors
+// chain/replay.py::replay_host(retarget=...) rule-for-rule — the parity
+// tests corrupt chains at boundaries and compare first-invalid indices.
+// Returns the first invalid index, or -1.
+long long p1_verify_chain_retarget(const uint8_t* headers, uint64_t n,
+                                   uint32_t window, uint32_t spacing,
+                                   uint32_t max_adjust, uint32_t max_step) {
+  if (window < 2 || spacing < 1) return 0;
+  HeaderHasher hasher;
+  // Ring of the last `window` timestamps: at a boundary i the span is
+  // ts[i-1] - ts[i-window], and slot i % window still holds ts[i-window].
+  std::vector<uint32_t> ring((size_t)window, 0);
+  uint8_t prev[32];
+  std::memset(prev, 0, sizeof(prev));
+  uint32_t prev_ts = 0, prev_d = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint8_t* h = headers + 80 * i;
+    uint32_t st2[8];
+    hasher.digest(h, st2);
+
+    const uint32_t ts = be32(h + 68);
+    const uint32_t d = be32(h + 72);
+    uint32_t expected;
+    if (i == 0) {
+      expected = d;  // genesis seeds the schedule (identity-checked)
+    } else if (i % window != 0) {
+      expected = prev_d;
+    } else {
+      const long long span =
+          (long long)prev_ts - (long long)ring[i % window];
+      expected = rt_adjusted(prev_d, span, window, spacing, max_adjust);
+    }
+    const bool pow_ok = (i == 0) || leading_zero_bits_ge(st2, expected);
+    const bool diff_ok = d == expected;
+    const bool link_ok = std::memcmp(h + 4, prev, 32) == 0;
+    const bool ts_ok =
+        (i == 0) ||
+        ((long long)ts > (long long)prev_ts &&
+         (i == 1 || (long long)ts - (long long)prev_ts <=
+                        (long long)max_step * (long long)spacing));
+    if (!(pow_ok && diff_ok && link_ok && ts_ok)) return (long long)i;
+    ring[i % window] = ts;
+    prev_ts = ts;
+    prev_d = d;
     for (int j = 0; j < 8; ++j) put_be32(prev + 4 * j, st2[j]);
   }
   return -1;

@@ -659,3 +659,79 @@ class TestForwardDatingBound:
         assert node.chain.tip_hash != hostile.block_hash()
         assert node.chain.tip.header.timestamp < hostile.header.timestamp
         assert node.chain.height == 2  # the honest branch won
+
+
+class TestNativeRetargetReplay:
+    """The C++ verification engine's retargeting form
+    (p1_verify_chain_retarget): rule-for-rule parity with the host
+    oracle on clean chains and on every single-field corruption —
+    contextual difficulty schedule, PoW at the scheduled bar, linkage,
+    and both timestamp rules."""
+
+    def test_parity_with_host_oracle(self):
+        import dataclasses
+
+        from p1_tpu.chain import generate_headers, replay_host
+        from p1_tpu.chain.replay import replay_native
+
+        fast = RetargetRule(window=4, spacing=100)
+        headers = generate_headers(12, DIFF, retarget=fast)
+        assert replay_host(headers, retarget=fast).valid
+        assert replay_native(headers, retarget=fast).valid
+        # Every position x every field corruption: the two engines must
+        # agree on the exact first-invalid index.
+        for i in range(1, len(headers)):
+            for mutate in (
+                lambda h: dataclasses.replace(h, nonce=h.nonce ^ 1),
+                lambda h: dataclasses.replace(
+                    h, difficulty=h.difficulty + 1
+                ),
+                lambda h: dataclasses.replace(
+                    h, timestamp=h.timestamp + 7
+                ),
+            ):
+                mutated = [*headers]
+                mutated[i] = mutate(mutated[i])
+                host = replay_host(mutated, retarget=fast)
+                native = replay_native(mutated, retarget=fast)
+                assert not host.valid
+                assert native.first_invalid == host.first_invalid, (
+                    i,
+                    host.first_invalid,
+                    native.first_invalid,
+                )
+
+    def test_native_enforces_forward_cap_and_backdate(self):
+        from p1_tpu.chain.replay import replay_host, replay_native
+
+        g = make_genesis(DIFF, RULE)
+        b1 = _child(g, DIFF, g.header.timestamp + 1)
+        over = _child(
+            b1, DIFF, b1.header.timestamp + RULE.max_increment + 1
+        )
+        chain_hdrs = [g.header, b1.header, over.header]
+        host = replay_host(chain_hdrs, retarget=RULE)
+        native = replay_native(chain_hdrs, retarget=RULE)
+        assert host.first_invalid == native.first_invalid == 2
+        # Backdated (non-increasing) header: same agreement.
+        stale = _child(b1, DIFF, b1.header.timestamp)
+        chain_hdrs = [g.header, b1.header, stale.header]
+        host = replay_host(chain_hdrs, retarget=RULE)
+        native = replay_native(chain_hdrs, retarget=RULE)
+        assert host.first_invalid == native.first_invalid == 2
+        # Height 1 anchor exemption holds natively too.
+        far = _child(g, DIFF, g.header.timestamp + 50_000_000)
+        ok = [g.header, far.header]
+        assert replay_host(ok, retarget=RULE).valid
+        assert replay_native(ok, retarget=RULE).valid
+
+    def test_native_retarget_scales(self):
+        from p1_tpu.chain import generate_headers
+        from p1_tpu.chain.replay import replay_native
+
+        fast = RetargetRule(window=64, spacing=1)
+        headers = generate_headers(2000, DIFF, retarget=fast)
+        report = replay_native(headers, retarget=fast)
+        assert report.valid
+        # The native engine stays native-fast with the schedule on.
+        assert report.headers_per_sec > 100_000, report
