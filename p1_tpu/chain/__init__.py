@@ -5,6 +5,7 @@ from p1_tpu.chain.replay import (
     ReplayReport,
     generate_headers,
     replay_device,
+    replay_fast,
     replay_host,
     replay_native,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "check_block",
     "generate_headers",
     "replay_device",
+    "replay_fast",
     "replay_host",
     "replay_native",
     "save_chain",

@@ -171,6 +171,24 @@ def replay_native(
     )
 
 
+def replay_fast(
+    headers: list[BlockHeader], retarget=None
+) -> ReplayReport:
+    """Strongest available verification engine: the C++ core (~2-3x the
+    host oracle end-to-end, rule-for-rule parity-tested on fixed and
+    retargeting chains alike), falling back to the hashlib oracle when
+    the native library cannot build (no toolchain).  The light-client
+    entry point (`p1 headers`, `p1 proof --headers`)."""
+    from p1_tpu.hashx.native_build import NativeBuildError
+
+    try:
+        return replay_native(headers, retarget=retarget)
+    except (NativeBuildError, OSError, AttributeError):
+        # No compiler / unloadable .so / stale symbol table: the host
+        # path is always available and equally correct, just slower.
+        return replay_host(headers, retarget=retarget)
+
+
 def replay_device(
     headers: list[BlockHeader], segment: int = 8192, platform: str | None = None
 ) -> ReplayReport:

@@ -1029,7 +1029,7 @@ def _load_header_file(path: str, difficulty: int, rule):
     Returns the genesis-first header list; raises SystemExit on any
     failure (wrong chain, bad PoW/linkage/schedule) — a light client must
     never proceed on unverified headers."""
-    from p1_tpu.chain import replay_host
+    from p1_tpu.chain import replay_fast
     from p1_tpu.core.genesis import make_genesis
     from p1_tpu.core.header import HEADER_SIZE, BlockHeader
 
@@ -1048,7 +1048,7 @@ def _load_header_file(path: str, difficulty: int, rule):
             file=sys.stderr,
         )
         raise SystemExit(2)
-    report = replay_host(headers, retarget=rule)
+    report = replay_fast(headers, retarget=rule)
     if not report.valid:
         print(
             f"{path}: header chain INVALID at index {report.first_invalid}",
@@ -1062,7 +1062,7 @@ def cmd_headers(args) -> int:
     """Light-client sync: fetch the peer's header chain (~80 B/block) and
     verify it locally — PoW, linkage, and (with the retarget flags) the
     full contextual difficulty schedule.  Trusts nothing but work."""
-    from p1_tpu.chain import replay_host
+    from p1_tpu.chain import replay_fast
     from p1_tpu.node.client import get_headers
 
     rule = _retarget_rule(args)
@@ -1081,7 +1081,7 @@ def cmd_headers(args) -> int:
     ) as e:
         print(f"header sync failed: {e}", file=sys.stderr)
         return 1
-    report = replay_host(headers, retarget=rule)
+    report = replay_fast(headers, retarget=rule)
     if report.valid and args.out:
         with open(args.out, "wb") as fh:
             for h in headers:

@@ -89,10 +89,15 @@ class RetargetRule:
     max_step: int = 4
 
     def __post_init__(self) -> None:
-        if self.window < 2:
-            raise ValueError("retarget window must be >= 2 blocks")
-        if self.spacing < 1:
-            raise ValueError("target spacing must be >= 1 second")
+        # Upper bounds are consensus sanity AND native-engine safety: the
+        # C++ verifier ring-buffers `window` timestamps and does int64
+        # span arithmetic (spacing * window * 2^max_adjust must not
+        # overflow), and it is built -fno-exceptions, where a gigantic
+        # allocation would abort the process instead of raising.
+        if not 2 <= self.window <= 1_000_000:
+            raise ValueError("retarget window must be in 2..1_000_000 blocks")
+        if not 1 <= self.spacing <= 31_536_000:  # one year per block, max
+            raise ValueError("target spacing must be in 1..31_536_000 seconds")
         if not 1 <= self.max_adjust <= 8:
             raise ValueError("max_adjust must be in 1..8 bits")
         if not 2 <= self.max_step <= 1024:
