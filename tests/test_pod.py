@@ -225,7 +225,7 @@ def test_many_process_pod_with_follower_loss_and_restart(tmp_path):
     # per-host device counts — 3x anything can't be a power of two, so
     # the smallest many-follower pod is leader + 3.)
     env = _env(2)
-    env["P1_POD_GRACE_S"] = "20"
+    env["P1_POD_GRACE_S"] = "30"
 
     def pod_cmd(coord: int) -> list[str]:
         return [
@@ -236,10 +236,10 @@ def test_many_process_pod_with_follower_loss_and_restart(tmp_path):
             "--difficulty", "12",
             "--chunk", str(1 << 12),
             "--batch", "256",
-            # Comfortably above the worst-case phase budget (180 s mine
-            # wait + 75 s failover wait) so a slow host can't hit the
-            # leader's own deadline mid-test; teardown kills the procs.
-            "--duration", "400",
+            # Comfortably above the worst-case phase budgets so a slow
+            # host can't hit the leader's own deadline mid-test;
+            # teardown kills the procs.
+            "--duration", "700",
         ]
 
     logs = []
@@ -284,20 +284,22 @@ def test_many_process_pod_with_follower_loss_and_restart(tmp_path):
     procs = [leader, *followers]
     try:
         # (a) the 3-process pod actually mines.
-        assert wait_blocks(3, 180), "4-proc pod never started mining"
+        # Generous: four interpreter+jax.distributed startups on a hot
+        # 1-vCPU box (the full suite runs this late) contend hard.
+        assert wait_blocks(3, 300), "4-proc pod never started mining"
         pre_kill = store_blocks()
 
         # (b) lose one follower mid-run.
         followers[0].send_signal(signal.SIGKILL)
         followers[0].wait(timeout=10)
         # The leader must keep the chain growing (failover within grace).
-        assert wait_blocks(pre_kill + 3, 75), (
+        assert wait_blocks(pre_kill + 3, 120), (
             f"chain stuck at {store_blocks()} after follower kill; "
             "leader.log tail: " + tail()
         )
         # The surviving followers exit 3 for their supervisor.
-        assert followers[1].wait(timeout=60) == 3
-        assert followers[2].wait(timeout=60) == 3
+        assert followers[1].wait(timeout=90) == 3
+        assert followers[2].wait(timeout=90) == 3
 
         # (c) the supervisor recipe: tear down, relaunch the WHOLE pod
         # against the same store, fresh coordinator.
@@ -308,7 +310,7 @@ def test_many_process_pod_with_follower_loss_and_restart(tmp_path):
         pre_restart = store_blocks()
         leader, followers, _ = launch(_free_port())
         procs = [leader, *followers]
-        assert wait_blocks(pre_restart + 3, 150), (
+        assert wait_blocks(pre_restart + 3, 300), (
             f"restarted pod never extended the chain past {pre_restart}; "
             "leader.log tail: " + tail()
         )
