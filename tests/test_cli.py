@@ -382,3 +382,108 @@ class TestByzantineSoak:
         assert out["economy"]["ledger_conserved"]
         # Several distinct attack categories actually ran.
         assert len(byz["attacks"]) >= 4, byz["attacks"]
+
+
+class TestRetargetWalletE2E:
+    """The round-5 manual drive as a suite test: a live retargeting
+    node (schedule actually climbing), funded wallet spend with
+    --fee auto, SPV proof verified at the claimed-difficulty bar with
+    the unanchored-figures warning on stderr, then headers-first
+    anchoring through the native-verified chain."""
+
+    def test_wallet_round_on_retargeting_chain(self, tmp_path):
+        import time
+
+        RT = ["--retarget-window", "50", "--target-spacing", "5"]
+        key = str(tmp_path / "alice.json")
+        out = _run("keygen", "--out", key)
+        alice = out["account"]
+        node = subprocess.Popen(
+            [
+                sys.executable, "-m", "p1_tpu", "node",
+                "--difficulty", "12", "--port", "0", "--platform", "cpu",
+                *RT, "--miner-id", alice, "--deadline", "stdin",
+            ],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, cwd="/root/repo",
+        )
+        try:
+            port = None
+            for line in node.stdout:
+                line = line.strip()
+                if line.startswith("{"):
+                    port = str(json.loads(line)["ready"])
+                    break
+            assert port
+            time.sleep(2)  # a few blocks of funding
+            tx = None
+            for _ in range(10):  # tolerate miner-load handshake stalls
+                proc = subprocess.run(
+                    [
+                        sys.executable, "-m", "p1_tpu", "tx",
+                        "--difficulty", "12", *RT, "--port", port,
+                        "--key", key, "--recipient", "p1deadbeefdeadbeef",
+                        "--amount", "3", "--fee", "auto",
+                    ],
+                    capture_output=True, text=True, timeout=60,
+                    cwd="/root/repo",
+                )
+                if proc.returncode == 0:
+                    tx = json.loads(proc.stdout)
+                    break
+                time.sleep(1)
+            assert tx is not None, proc.stderr[-500:]
+            txid = tx["txid"]
+            proved = None
+            for _ in range(60):
+                proc = subprocess.run(
+                    [
+                        sys.executable, "-m", "p1_tpu", "proof",
+                        "--difficulty", "12", *RT, "--port", port,
+                        "--txid", txid,
+                    ],
+                    capture_output=True, text=True, timeout=60,
+                    cwd="/root/repo",
+                )
+                if proc.returncode == 0:
+                    proved = json.loads(proc.stdout)
+                    # Unanchored retarget proofs must shout about it.
+                    assert "without --headers" in proc.stderr
+                    break
+                assert proc.returncode == 3, proc.stderr[-500:]
+                time.sleep(0.5)
+            assert proved is not None and proved["verified"]
+            # Headers-first anchoring (native-verified schedule).
+            hdrs = str(tmp_path / "h.bin")
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "headers",
+                    "--difficulty", "12", *RT, "--port", port,
+                    "--out", hdrs,
+                ],
+                capture_output=True, text=True, timeout=60, cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-500:]
+            synced = json.loads(proc.stdout)
+            assert synced["valid"]
+            # The schedule actually moved: sub-second real blocks at
+            # spacing 5 force the difficulty up past the base.
+            assert synced["tip_difficulty"] > 12
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "proof",
+                    "--difficulty", "12", *RT, "--port", port,
+                    "--txid", txid, "--headers", hdrs,
+                ],
+                capture_output=True, text=True, timeout=60, cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-500:]
+            anchored = json.loads(proc.stdout)
+            assert anchored["anchored"] and anchored["verified"]
+        finally:
+            try:
+                node.stdin.write(str(time.time()) + "\n")
+                node.stdin.flush()
+                node.wait(timeout=60)
+            except Exception:
+                node.kill()

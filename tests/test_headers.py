@@ -256,3 +256,27 @@ class TestLightClientSync:
                 await node.stop()
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+
+
+class TestReplayFastFallback:
+    def test_falls_back_to_host_without_toolchain(self, monkeypatch):
+        """replay_fast must keep working on machines that cannot build
+        the C++ engine — the host oracle serves, same verdicts."""
+        from p1_tpu.chain import generate_headers, replay_fast
+        from p1_tpu.chain import replay as replay_mod
+        from p1_tpu.hashx.native_build import NativeBuildError
+
+        headers = generate_headers(8, 8)
+
+        def no_native(*a, **k):
+            raise NativeBuildError("no compiler on this host")
+
+        monkeypatch.setattr(replay_mod, "replay_native", no_native)
+        report = replay_fast(headers)
+        assert report.valid and report.method == "host"
+
+    def test_prefers_native_when_available(self):
+        from p1_tpu.chain import generate_headers, replay_fast
+
+        report = replay_fast(generate_headers(8, 8))
+        assert report.valid and report.method == "native"
