@@ -130,6 +130,33 @@ def main() -> None:
 
     ttb = _time_to_block(Miner(backend=device), difficulty=20)
 
+    # Host ingest plane (the serialization-side headline,
+    # benchmarks/host_ingest.py): a quick same-session measurement,
+    # reported against the ONE recorded constant so a regression in the
+    # zero-repack pipeline surfaces in the bench JSON — same convention
+    # as the TPU degradation guard above.
+    from p1_tpu.hashx.perf_record import (
+        HOST_INGEST_DEGRADED_FRACTION,
+        RECORDED_HOST_INGEST_BPS,
+    )
+
+    try:
+        from benchmarks.host_ingest import bench_ingest, build_blocks
+
+        chain, raws = build_blocks(300, 2, difficulty=1)
+        for blk in chain.main_chain():
+            for tx in blk.txs:
+                tx.verify_signature()  # warm the memo, as ingest meets it
+        ingest_bps = bench_ingest(raws, 1, repeats=3)
+        extra["host_ingest_bps"] = round(ingest_bps)
+        extra["host_ingest_vs_recorded"] = round(
+            ingest_bps / RECORDED_HOST_INGEST_BPS, 2
+        )
+        if ingest_bps < HOST_INGEST_DEGRADED_FRACTION * RECORDED_HOST_INGEST_BPS:
+            extra["host_ingest_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     print(
         json.dumps(
             {

@@ -1,0 +1,225 @@
+"""Host ingest benchmark: the serialization plane, measured end-to-end.
+
+The Pallas kernel already runs at the v5e VPU issue-rate wall
+(docs/PERF.md "VPU roofline"), so the system's remaining headroom is the
+*host* plane: gossip ingest → validate → ``add_block`` → store append →
+relay, and the resume/replay paths.  This harness measures exactly those,
+with the same contract as ``bench.py``: print ONE JSON line, measured on
+this machine, no estimates.
+
+Three measurements:
+
+- **ingest** — blocks/s through the object-plane pipeline a gossip frame
+  pays: ``Block.deserialize(wire bytes)`` → ``Chain.add_block`` (which
+  runs the full stateless ``check_block`` + connect-time ledger).
+  Ed25519 signature verification is warmed first and stated in the
+  output: mempool admission has already verified every transfer a block
+  carries by the time the block arrives (``keys.verify`` memoizes), so
+  the steady-state ingest cost is the serialization/hashing plane, not
+  signature math — exactly what this harness isolates.
+- **resume** — blocks/s through ``ChainStore.load_chain(trusted=True)``
+  from a real on-disk store: the node-restart path (parse + index +
+  ledger bookkeeping, docs/PERF.md "Restart at scale").
+- **replay** — headers/s verifying a mined header chain from
+  ``BlockHeader`` objects (``replay_fast`` — the native engine when it
+  builds, else the hashlib oracle), plus the hashlib oracle and the
+  pre-packed native ceiling for context.  Encodings are warmed before
+  the timed run: the object-plane figure models a node replaying headers
+  it already holds (ingested off the wire or serialized once), which is
+  how every real caller reaches this path.
+
+Runs anywhere (``JAX_PLATFORMS=cpu``, no TPU, no network); difficulty 1
+keeps mining the fixtures cheap while exercising real PoW checks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+# Runnable as `python benchmarks/host_ingest.py` from a checkout, like
+# bench.py — the repo root is the import root.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_blocks(n_blocks: int, txs_per_block: int, difficulty: int):
+    """Mine a valid n-block chain carrying signed transfers; return the
+    wire bytes of every post-genesis block (what gossip would deliver)."""
+    from p1_tpu.chain.chain import Chain
+    from p1_tpu.core.block import Block, merkle_root
+    from p1_tpu.core.header import BlockHeader
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    alice = Keypair.from_seed_text("host-ingest-alice")
+    chain = Chain(difficulty)
+    tag = chain.genesis.block_hash()
+    miner = Miner(backend=get_backend("cpu"))
+    raws: list[bytes] = []
+    seq = 0
+    for height in range(1, n_blocks + 1):
+        txs = [Transaction.coinbase(alice.account, height)]
+        # Transfers only once the ledger can afford them (coinbase at
+        # height h is spendable from height h+1's perspective here since
+        # the ledger credits on connect).
+        if height > 1:
+            for _ in range(txs_per_block):
+                txs.append(
+                    Transaction.transfer(alice, "bob", 1, 1, seq, chain=tag)
+                )
+                seq += 1
+        parent = chain.tip
+        draft = BlockHeader(
+            version=1,
+            prev_hash=parent.block_hash(),
+            merkle_root=merkle_root([tx.txid() for tx in txs]),
+            timestamp=parent.header.timestamp + height,
+            difficulty=difficulty,
+            nonce=0,
+        )
+        sealed = miner.search_nonce(draft)
+        assert sealed is not None
+        block = Block(sealed, tuple(txs))
+        res = chain.add_block(block)
+        assert res.status.value == "accepted", res
+        raws.append(block.serialize())
+    return chain, raws
+
+
+def bench_ingest(raws: list[bytes], difficulty: int, repeats: int) -> float:
+    """Best-of-N blocks/s: deserialize -> full-validation add_block."""
+    from p1_tpu.chain.chain import AddStatus, Chain
+    from p1_tpu.core.block import Block
+
+    best = 0.0
+    for _ in range(repeats):
+        chain = Chain(difficulty)
+        t0 = time.perf_counter()
+        for raw in raws:
+            res = chain.add_block(Block.deserialize(raw))
+            assert res.status is AddStatus.ACCEPTED
+        dt = time.perf_counter() - t0
+        best = max(best, len(raws) / dt)
+    return best
+
+
+def bench_resume(
+    raws: list[bytes], difficulty: int, repeats: int, tmpdir: str
+) -> float:
+    """Best-of-N blocks/s through the trusted-resume path from disk."""
+    from p1_tpu.chain.store import ChainStore
+    from p1_tpu.core.block import Block
+
+    path = Path(tmpdir) / "ingest_bench.chain"
+    store = ChainStore(path, fsync=False)
+    try:
+        for raw in raws:
+            store.append(Block.deserialize(raw))
+    finally:
+        store.close()
+    best = 0.0
+    for _ in range(repeats):
+        store = ChainStore(path, fsync=False)
+        try:
+            t0 = time.perf_counter()
+            chain = store.load_chain(difficulty, trusted=True)
+            dt = time.perf_counter() - t0
+        finally:
+            store.close()
+        assert chain.height == len(raws)
+        best = max(best, len(raws) / dt)
+    return best
+
+
+def bench_replay(n_headers: int, repeats: int) -> dict:
+    """Headers/s from objects (replay_fast), the hashlib oracle, and the
+    pre-packed native ceiling (when the native engine builds)."""
+    from p1_tpu.chain.replay import generate_headers, replay_fast, replay_host
+
+    headers = generate_headers(n_headers, difficulty=1)
+    for h in headers:  # warm encodings: the as-held-by-a-node plane
+        h.serialize()
+    out: dict = {"replay_n": n_headers}
+    best = 0.0
+    for _ in range(repeats):
+        report = replay_fast(headers)
+        assert report.valid
+        best = max(best, report.headers_per_sec)
+    out["replay_object_hps"] = round(best)
+    out["replay_method"] = report.method
+    best = 0.0
+    for _ in range(repeats):
+        host = replay_host(headers)
+        assert host.valid
+        best = max(best, host.headers_per_sec)
+    out["replay_host_hps"] = round(best)
+    try:
+        from p1_tpu.hashx.native_backend import verify_header_chain
+
+        raw = b"".join(h.serialize() for h in headers)
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            assert verify_header_chain(raw, len(headers), 1) is None
+            dt = time.perf_counter() - t0
+            best = max(best, len(headers) / dt)
+        out["replay_native_raw_hps"] = round(best)
+    except Exception:  # no toolchain: the ceiling row is simply absent
+        pass
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--blocks", type=int, default=1000)
+    ap.add_argument("--txs", type=int, default=2, help="transfers per block")
+    ap.add_argument("--replay-n", type=int, default=20_000)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from p1_tpu.core import keys
+
+    difficulty = 1
+    chain, raws = build_blocks(args.blocks, args.txs, difficulty)
+    # Warm the signature memo (the mempool-admission state a block meets).
+    for block in chain.main_chain():
+        for tx in block.txs:
+            assert tx.verify_signature()
+
+    ingest_bps = bench_ingest(raws, difficulty, args.repeats)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        resume_bps = bench_resume(raws, difficulty, args.repeats, tmpdir)
+    replay = bench_replay(args.replay_n, args.repeats)
+
+    from p1_tpu.hashx.perf_record import RECORDED_HOST_INGEST_BPS
+
+    print(
+        json.dumps(
+            {
+                "metric": "host_ingest_blocks_per_sec",
+                "value": round(ingest_bps, 1),
+                "unit": "blocks/s",
+                "vs_recorded": round(
+                    ingest_bps / RECORDED_HOST_INGEST_BPS, 2
+                ),
+                "n_blocks": args.blocks,
+                "txs_per_block": args.txs,
+                "resume_bps": round(resume_bps, 1),
+                "sig_backend": (
+                    "cryptography" if keys.HAVE_CRYPTOGRAPHY else "rfc8032-py"
+                ),
+                **replay,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,10 +4,13 @@ from p1_tpu.chain.proof import SPVError, TxProof, verify_tx_proof
 from p1_tpu.chain.replay import (
     ReplayReport,
     generate_headers,
+    pack_headers,
+    parse_headers,
     replay_device,
     replay_fast,
     replay_host,
     replay_native,
+    replay_packed,
 )
 from p1_tpu.chain.store import ChainStore, save_chain
 from p1_tpu.chain.validate import ValidationError, check_block
@@ -25,9 +28,12 @@ __all__ = [
     "balances",
     "check_block",
     "generate_headers",
+    "pack_headers",
+    "parse_headers",
     "replay_device",
     "replay_fast",
     "replay_host",
     "replay_native",
+    "replay_packed",
     "save_chain",
 ]

@@ -360,15 +360,24 @@ class Chain:
         (difficulty schedule, timestamp bounds) and the connect-time
         ledger/nonce validation still run, so the rebuilt state is
         byte-identical to a full revalidation — tested both ways.
+
+        Hashing discipline: this method (and everything it calls —
+        validation, the tx index, reorg paths) asks for ``block_hash()``
+        and ``txid()`` freely; both are memoized on the frozen core types
+        (core/header.py's cache notes), so the whole add costs ONE header
+        digest and ONE digest per transaction regardless of how many
+        sites re-ask — for wire/disk-ingested blocks, computed directly
+        over the arrival bytes.
         """
         old_tip = self._tip_hash
+        bhash = block.block_hash()
         status, reason = self._insert(block, prevalidated=trusted)
         if status is not AddStatus.ACCEPTED:
             return AddResult(status, reason=reason)
 
         # A newly indexed block may be the missing parent of parked orphans.
         connected = [block]
-        pending = [block.block_hash()]
+        pending = [bhash]
         while pending:
             for orphan in self._orphans.pop(pending.pop(), []):
                 self._orphan_hashes.discard(orphan.block_hash())
@@ -400,7 +409,6 @@ class Chain:
             bh = b.block_hash()
             for tx in b.txs:
                 self._tx_index[tx.txid()] = bh
-        bhash = block.block_hash()
         if bhash in self._invalid:
             # Indexed but contextually invalid (its transfers overdraw
             # somewhere on its branch) — callers see a rejection, and the
@@ -426,6 +434,19 @@ class Chain:
         block permanently invalid, and ``old_tip`` itself (whose state the
         ledger currently holds) is always a valid fallback.
         """
+        # Fast path — the overwhelmingly common case on the ingest hot
+        # loop: the new tip is old tip's direct child (plain extension,
+        # no reorg walk needed).  Same semantics as the general loop
+        # below for this shape, including the invalid-branch fallback.
+        if self._tip_hash != old_tip:
+            candidate = self._index[self._tip_hash].block
+            if candidate.header.prev_hash == old_tip:
+                try:
+                    self._ledger.apply_block(candidate)
+                    return (), (candidate,)
+                except LedgerError as e:
+                    self._mark_invalid_subtree(self._tip_hash, str(e))
+                    self._tip_hash = self._best_valid_tip()
         while self._tip_hash != old_tip:
             removed, added = self._reorg_paths(old_tip, self._tip_hash)
             for b in removed:

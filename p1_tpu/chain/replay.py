@@ -12,12 +12,13 @@ provides the oracle and the CPU baseline.
 from __future__ import annotations
 
 import dataclasses
+import operator
 import time
 
 import numpy as np
 
 from p1_tpu.core.hashutil import sha256d
-from p1_tpu.core.header import BlockHeader, meets_target
+from p1_tpu.core.header import HEADER_SIZE, BlockHeader, meets_target
 from p1_tpu.core.genesis import make_genesis
 
 
@@ -68,9 +69,40 @@ def generate_headers(
     return headers
 
 
+def pack_headers(headers: list[BlockHeader]) -> bytes:
+    """The contiguous (N*80)-byte buffer of the headers' canonical
+    encodings — ONE packer shared by the native, device, and export
+    planes.  ``BlockHeader.serialize`` memoizes, so for headers a node
+    already holds (ingested off the wire, or serialized once before)
+    this is a join of cached buffers: no per-header struct packing, which
+    is what closes replay-from-objects toward the raw-bytes rate
+    (docs/PERF.md "host ingest plane")."""
+    try:
+        # C-level gather of the memoized encodings (the cache slot is a
+        # plain instance attribute) — the join is the whole cost.
+        return b"".join(map(operator.attrgetter("_raw"), headers))
+    except AttributeError:
+        # Some header not yet encoded: pay its one-time pack.
+        return b"".join([h.serialize() for h in headers])
+
+
+def parse_headers(raw: bytes) -> list[BlockHeader]:
+    """Batch-parse a packed header buffer (the inverse of
+    ``pack_headers``).  Each header's encoding cache is seeded with its
+    exact 80-byte slice, so a subsequent verify/export never repacks."""
+    if len(raw) % HEADER_SIZE:
+        raise ValueError(
+            f"packed header buffer must be a multiple of {HEADER_SIZE} bytes"
+        )
+    return [
+        BlockHeader.deserialize(raw[off : off + HEADER_SIZE])
+        for off in range(0, len(raw), HEADER_SIZE)
+    ]
+
+
 def headers_to_words(headers: list[BlockHeader]) -> np.ndarray:
     """(N, 20) big-endian uint32 view of serialized headers."""
-    raw = b"".join(h.serialize() for h in headers)
+    raw = pack_headers(headers)
     return np.frombuffer(raw, dtype=">u4").astype(np.uint32).reshape(-1, 20)
 
 
@@ -152,10 +184,12 @@ def replay_native(
 
     difficulty = headers[0].difficulty if headers else 0
     # Packing is inside the timer: replay_host pays per-header serialize
-    # in ITS timer too, so the reported rates compare end-to-end (the
-    # Python join costs about as much as the C verify itself).
+    # in ITS timer too, so the reported rates compare end-to-end.  With
+    # the encoding cache this is a join of already-canonical buffers for
+    # any header the process has serialized or ingested before — ONE
+    # contiguous buffer, ONE ctypes call, no per-header Python.
     t0 = time.perf_counter()
-    raw = b"".join(h.serialize() for h in headers)
+    raw = pack_headers(headers)
     if retarget is None:
         first_invalid = verify_header_chain(raw, len(headers), difficulty)
     else:
@@ -187,6 +221,45 @@ def replay_fast(
         # No compiler / unloadable .so / stale symbol table: the host
         # path is always available and equally correct, just slower.
         return replay_host(headers, retarget=retarget)
+
+
+def replay_packed(raw: bytes, retarget=None) -> ReplayReport:
+    """Verify a header chain straight from its packed wire/disk buffer —
+    the zero-repack entry for callers that hold raw bytes (header files,
+    store exports): the buffer goes to the native verifier in one ctypes
+    call with NO object parse at all; only the no-toolchain fallback
+    pays a batch parse before the hashlib oracle."""
+    n = len(raw) // HEADER_SIZE
+    if len(raw) != n * HEADER_SIZE or n == 0:
+        raise ValueError(
+            f"packed header buffer must be a non-empty multiple of "
+            f"{HEADER_SIZE} bytes"
+        )
+    from p1_tpu.hashx.native_build import NativeBuildError
+
+    try:
+        from p1_tpu.hashx.native_backend import (
+            verify_header_chain,
+            verify_header_chain_retarget,
+        )
+
+        difficulty = raw[72:76]
+        t0 = time.perf_counter()
+        if retarget is None:
+            first_invalid = verify_header_chain(
+                raw, n, int.from_bytes(difficulty, "big")
+            )
+        else:
+            first_invalid = verify_header_chain_retarget(raw, n, retarget)
+        return ReplayReport(
+            n,
+            first_invalid is None,
+            first_invalid,
+            time.perf_counter() - t0,
+            "native",
+        )
+    except (NativeBuildError, OSError, AttributeError):
+        return replay_host(parse_headers(raw), retarget=retarget)
 
 
 def replay_device(

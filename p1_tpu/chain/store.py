@@ -16,9 +16,11 @@ import io
 import os
 import struct
 from pathlib import Path
+from typing import Iterator
 
 from p1_tpu.chain.chain import AddStatus, Chain
 from p1_tpu.core.block import Block
+from p1_tpu.core.header import HEADER_SIZE
 
 _LEN = struct.Struct(">I")
 #: Format tag, versioned with the RECORD layout, not just the framing:
@@ -89,6 +91,9 @@ class ChainStore:
 
     def append(self, block: Block) -> None:
         self.acquire()
+        # ``serialize`` is memoized on the block: for a block that arrived
+        # off the wire these are the exact gossip bytes — ingest appends
+        # with zero re-packing (docs/PERF.md "host ingest plane").
         raw = block.serialize()
         self._fh.write(_LEN.pack(len(raw)))
         self._fh.write(raw)
@@ -107,48 +112,81 @@ class ChainStore:
             os.fsync(self._fh.fileno())
 
     @staticmethod
-    def _scan_good_end(data: bytes) -> int:
-        """Byte offset just past the last whole record."""
+    def _check_magic(data: bytes, label: str = "") -> None:
+        prefix = f"{label} " if label else ""
         if not data.startswith(MAGIC):
             if any(data.startswith(m) for m in _OLD_MAGICS):
                 raise ValueError(
-                    "chain store written by an older p1-tpu version "
+                    f"{prefix}written by an older p1-tpu version "
                     "(incompatible transaction format); re-mine or discard it"
                 )
-            raise ValueError("not a chain store")
+            raise ValueError(f"{prefix}not a chain store")
+
+    @staticmethod
+    def _record_spans(data: bytes) -> Iterator[tuple[int, int]]:
+        """(offset, length) of every whole record's block bytes — the ONE
+        walk of the framing, shared by the tail scan, the batch parse,
+        and the packed-header extraction, so the three can't drift.
+        Stops cleanly at a truncated tail."""
         off = len(MAGIC)
         while off + _LEN.size <= len(data):
             (n,) = _LEN.unpack_from(data, off)
             if off + _LEN.size + n > len(data):
                 break
+            yield off + _LEN.size, n
             off += _LEN.size + n
-        return off
+
+    @classmethod
+    def _scan_good_end(cls, data: bytes) -> int:
+        """Byte offset just past the last whole record."""
+        cls._check_magic(data)
+        end = len(MAGIC)
+        for off, n in cls._record_spans(data):
+            end = off + n
+        return end
 
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
 
+    def _read_checked(self) -> bytes:
+        data = self.path.read_bytes()
+        self._check_magic(data, str(self.path))
+        return data
+
     def load_blocks(self) -> list[Block]:
-        """All decodable records, stopping cleanly at a truncated tail."""
+        """All decodable records, stopping cleanly at a truncated tail.
+
+        Batch parse on the packed-bytes plane: each ``Block.deserialize``
+        seeds the block's (and its header's and transactions') encoding
+        caches with the record's exact bytes, so resume never re-packs —
+        ``add_block``'s hashing, the ledger's txids, and any later relay
+        all reuse the disk bytes (docs/PERF.md "Restart at scale")."""
         if not self.path.exists():
             return []
-        data = self.path.read_bytes()
-        if not data.startswith(MAGIC):
-            if any(data.startswith(m) for m in _OLD_MAGICS):
-                raise ValueError(
-                    f"{self.path} was written by an older p1-tpu version "
-                    "(incompatible transaction format); re-mine or discard it"
-                )
-            raise ValueError(f"{self.path} is not a chain store")
-        out = []
-        off = len(MAGIC)
-        end = self._scan_good_end(data)  # truncated tail: keep what's whole
-        while off < end:
-            (n,) = _LEN.unpack_from(data, off)
-            out.append(Block.deserialize(data[off + _LEN.size : off + _LEN.size + n]))
-            off += _LEN.size + n
-        return out
+        data = self._read_checked()
+        return [
+            Block.deserialize(data[off : off + n])
+            for off, n in self._record_spans(data)
+        ]
+
+    def packed_headers(self) -> tuple[bytes, int]:
+        """(buffer, count): every record's 80-byte header, contiguous, cut
+        straight from the record framing with NO object parse — the exact
+        shape ``replay_packed``/the native verifier take in one ctypes
+        call.  For a linear store (a ``save_chain`` snapshot or compacted
+        log — main branch only, append order = height order) this is the
+        whole-chain PoW + linkage check at the raw-bytes rate; stores
+        carrying side branches fail linkage at the first out-of-line
+        record, by construction."""
+        if not self.path.exists():
+            return b"", 0
+        data = self._read_checked()
+        parts = [
+            data[off : off + HEADER_SIZE] for off, _ in self._record_spans(data)
+        ]
+        return b"".join(parts), len(parts)
 
     def load_chain(
         self,
@@ -182,7 +220,14 @@ class ChainStore:
         callers (``p1 compact`` would rewrite the store as a genesis-only
         snapshot of the wrong chain).  The guard lives here, once, so no
         call site can forget it; a partially-connecting store (corrupt
-        tail) still loads what it can."""
+        tail) still loads what it can.
+
+        Resume operates on the packed-bytes plane end to end: the batch
+        parse (``load_blocks``) seeds every block's encoding caches from
+        the record bytes, so the per-block hashing that ``add_block`` and
+        the ledger need digests the disk bytes directly — no
+        re-serialization anywhere in the resume loop (measured in
+        benchmarks/host_ingest.py, recorded in docs/PERF.md)."""
         chain = Chain(difficulty, retarget=retarget)
         ghash = chain.genesis.block_hash()
         saw_record = False
@@ -202,7 +247,11 @@ class ChainStore:
 
 def save_chain(chain: Chain, path: str | os.PathLike) -> None:
     """Snapshot a chain's main branch to a fresh store (tooling aid; nodes
-    normally append incrementally as blocks arrive)."""
+    normally append incrementally as blocks arrive).  The snapshot is
+    LINEAR by construction — genesis-first main branch — so its
+    ``packed_headers`` buffer verifies in one native call
+    (``replay_packed``), which is how ``p1 compact`` proves a snapshot
+    before replacing the original log."""
     p = Path(path)
     if p.exists():
         p.unlink()

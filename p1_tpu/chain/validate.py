@@ -8,7 +8,7 @@ block index is.
 
 from __future__ import annotations
 
-from p1_tpu.core.block import Block
+from p1_tpu.core.block import Block, merkle_root
 from p1_tpu.core.genesis import genesis_hash
 from p1_tpu.core.header import meets_target
 from p1_tpu.core.tx import BLOCK_REWARD
@@ -37,6 +37,9 @@ def check_block(
     every transfer carries a valid Ed25519 ownership proof
     (``Transaction.verify_signature`` — only the key holder can spend).
     """
+    # Digest costs here are one-time per object: block_hash/txid/merkle
+    # are memoized on the frozen types, and for a wire-ingested block
+    # they digest the arrival bytes — validation adds no packing.
     header = block.header
     if header.difficulty != expected_difficulty:
         raise ValidationError(
@@ -49,8 +52,10 @@ def check_block(
         raise ValidationError("duplicate txid in block")
     # Structure before signatures (cheap hash checks gate the ~100 µs/tx
     # Ed25519 verifies): the root must commit to these exact transactions
-    # before their ownership proofs are worth checking.
-    if block.compute_merkle_root() != header.merkle_root:
+    # before their ownership proofs are worth checking.  The root is
+    # recombined from the txid list already in hand (one digest pass per
+    # transaction for the whole check).
+    if merkle_root(txids) != header.merkle_root:
         raise ValidationError("merkle root mismatch")
     # A coinbase (block-reward tx) is optional, but if present it must be
     # the first transaction and unique — any coinbase at index > 0 covers

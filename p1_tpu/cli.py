@@ -1029,33 +1029,35 @@ def _load_header_file(path: str, difficulty: int, rule):
     Returns the genesis-first header list; raises SystemExit on any
     failure (wrong chain, bad PoW/linkage/schedule) — a light client must
     never proceed on unverified headers."""
-    from p1_tpu.chain import replay_fast
+    from p1_tpu.chain import parse_headers, replay_packed
     from p1_tpu.core.genesis import make_genesis
-    from p1_tpu.core.header import HEADER_SIZE, BlockHeader
+    from p1_tpu.core.hashutil import sha256d
+    from p1_tpu.core.header import HEADER_SIZE
 
     raw = open(path, "rb").read()
     if not raw or len(raw) % HEADER_SIZE:
         print(f"{path}: not a header file", file=sys.stderr)
         raise SystemExit(2)
-    headers = [
-        BlockHeader.deserialize(raw[i : i + HEADER_SIZE])
-        for i in range(0, len(raw), HEADER_SIZE)
-    ]
-    if headers[0].block_hash() != make_genesis(difficulty, rule).block_hash():
+    # Packed-bytes plane end to end: genesis pinning hashes the first 80
+    # bytes directly, verification hands the whole file to the native
+    # engine in one call (replay_packed), and the object parse happens
+    # once, after the chain has proven itself — seeding each header's
+    # encoding cache with the file's exact bytes.
+    if sha256d(raw[:HEADER_SIZE]) != make_genesis(difficulty, rule).block_hash():
         print(
             f"{path}: does not start at this chain's genesis "
             "(check --difficulty / retarget flags)",
             file=sys.stderr,
         )
         raise SystemExit(2)
-    report = replay_fast(headers, retarget=rule)
+    report = replay_packed(raw, retarget=rule)
     if not report.valid:
         print(
             f"{path}: header chain INVALID at index {report.first_invalid}",
             file=sys.stderr,
         )
         raise SystemExit(4)
-    return headers
+    return parse_headers(raw)
 
 
 def cmd_headers(args) -> int:
@@ -1454,6 +1456,24 @@ def cmd_compact(args) -> int:
             # truncated.
             tmp = f"{out}.compact.{os.getpid()}"
             save_chain(chain, tmp)
+            # Prove the snapshot BEFORE it replaces the original: the
+            # main branch is linear, so its packed headers verify (PoW +
+            # linkage + difficulty) in one native call straight off the
+            # bytes just written — a torn or miswritten snapshot can
+            # never clobber a good log.
+            from p1_tpu.chain import replay_packed
+
+            raw_headers, n_headers = ChainStore(tmp).packed_headers()
+            snap = replay_packed(raw_headers, retarget=_retarget_rule(args))
+            if not snap.valid:
+                os.unlink(tmp)
+                print(
+                    f"snapshot self-check failed at record "
+                    f"{snap.first_invalid} of {n_headers} — original store "
+                    "left untouched",
+                    file=sys.stderr,
+                )
+                return 3
             os.replace(tmp, out)
         finally:
             if dst is not None:

@@ -1,0 +1,157 @@
+"""Pure-Python RFC 8032 Ed25519 — the no-wheel fallback for core/keys.py.
+
+The ``cryptography`` package is an *optional* accelerator: some
+deployment images (including CI sandboxes with no egress) don't carry
+the wheel, and a missing optional dependency must never make the core
+package unimportable.  This module is the slow-but-correct substitute:
+a direct transcription of RFC 8032 §5.1 (edwards25519, SHA-512,
+cofactored equation checked in the cofactorless form ``[S]B = R + [k]A``
+that both OpenSSL and the RFC test vectors accept), producing
+byte-identical keys and signatures to the wheel — Ed25519 signing is
+fully deterministic, so the two backends are interchangeable per key.
+
+Performance: a few milliseconds per sign/verify (extended-coordinate
+double-and-add over Python ints) vs ~100 µs native.  That is fine where
+this runs: ``keys.verify`` memoizes verification per (pubkey, sig,
+message), so each transaction pays the cost once per process no matter
+how many times gossip, block validation, and reorg resurrection
+re-check it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_P = 2**255 - 19  # field prime
+_Q = 2**252 + 27742317777372353535851937790883648493  # group order
+_D = (-121665 * pow(121666, _P - 2, _P)) % _P  # curve constant
+
+# Base point B (RFC 8032 §5.1), extended homogeneous (X, Y, Z, T).
+_BY = (4 * pow(5, _P - 2, _P)) % _P
+_BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+_B = (_BX, _BY, 1, (_BX * _BY) % _P)
+_IDENT = (0, 1, 1, 0)
+
+# sqrt(-1) mod p, for point decompression (p ≡ 5 mod 8).
+_SQRT_M1 = pow(2, (_P - 1) // 4, _P)
+
+
+def _sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+def _pt_add(a, b):
+    x1, y1, z1, t1 = a
+    x2, y2, z2, t2 = b
+    aa = (y1 - x1) * (y2 - x2) % _P
+    bb = (y1 + x1) * (y2 + x2) % _P
+    cc = 2 * t1 * t2 * _D % _P
+    dd = 2 * z1 * z2 % _P
+    e, f, g, h = bb - aa, dd - cc, dd + cc, bb + aa
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _pt_double(a):
+    x1, y1, z1, _ = a
+    aa = x1 * x1 % _P
+    bb = y1 * y1 % _P
+    cc = 2 * z1 * z1 % _P
+    h = aa + bb
+    e = (h - (x1 + y1) * (x1 + y1)) % _P
+    g = aa - bb
+    f = cc + g
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _pt_mul(s: int, pt):
+    out = _IDENT
+    while s > 0:
+        if s & 1:
+            out = _pt_add(out, pt)
+        pt = _pt_double(pt)
+        s >>= 1
+    return out
+
+
+def _pt_equal(a, b) -> bool:
+    # Cross-multiply to compare projective points without inversions.
+    x1, y1, z1, _ = a
+    x2, y2, z2, _ = b
+    return (x1 * z2 - x2 * z1) % _P == 0 and (y1 * z2 - y2 * z1) % _P == 0
+
+
+def _pt_compress(pt) -> bytes:
+    x, y, z, _ = pt
+    zinv = pow(z, _P - 2, _P)
+    x, y = x * zinv % _P, y * zinv % _P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    if y >= _P:
+        return None
+    x2 = (y * y - 1) * pow(_D * y * y + 1, _P - 2, _P) % _P
+    if x2 == 0:
+        return None if sign else 0
+    x = pow(x2, (_P + 3) // 8, _P)
+    if (x * x - x2) % _P != 0:
+        x = x * _SQRT_M1 % _P
+    if (x * x - x2) % _P != 0:
+        return None
+    if (x & 1) != sign:
+        x = _P - x
+    return x
+
+
+def _pt_decompress(raw: bytes):
+    if len(raw) != 32:
+        return None
+    y = int.from_bytes(raw, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % _P)
+
+
+def _secret_expand(seed: bytes) -> tuple[int, bytes]:
+    h = _sha512(seed)
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(seed: bytes) -> bytes:
+    """The 32-byte public key for a 32-byte private seed."""
+    a, _ = _secret_expand(seed)
+    return _pt_compress(_pt_mul(a, _B))
+
+
+def sign(seed: bytes, message: bytes) -> bytes:
+    """Deterministic RFC 8032 signature (64 bytes) over ``message``."""
+    a, prefix = _secret_expand(seed)
+    pub = _pt_compress(_pt_mul(a, _B))
+    r = int.from_bytes(_sha512(prefix + message), "little") % _Q
+    big_r = _pt_compress(_pt_mul(r, _B))
+    k = int.from_bytes(_sha512(big_r + pub + message), "little") % _Q
+    s = (r + k * a) % _Q
+    return big_r + s.to_bytes(32, "little")
+
+
+def verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
+    """True iff ``sig`` is ``pubkey``'s valid signature over ``message``."""
+    if len(pubkey) != 32 or len(sig) != 64:
+        return False
+    a_pt = _pt_decompress(pubkey)
+    if a_pt is None:
+        return False
+    r_pt = _pt_decompress(sig[:32])
+    if r_pt is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= _Q:
+        return False
+    k = int.from_bytes(_sha512(sig[:32] + pubkey + message), "little") % _Q
+    return _pt_equal(_pt_mul(s, _B), _pt_add(r_pt, _pt_mul(k, a_pt)))

@@ -15,6 +15,7 @@ from p1_tpu.core.header import HEADER_SIZE, BlockHeader
 from p1_tpu.core.tx import Transaction
 
 EMPTY_MERKLE_ROOT = bytes(32)
+_U32 = struct.Struct(">I")
 
 
 def merkle_root(txids: list[bytes]) -> bytes:
@@ -91,6 +92,18 @@ def verify_merkle_branch(
 
 @dataclasses.dataclass(frozen=True)
 class Block:
+    """Header + transactions.
+
+    Canonical-encoding cache: ``serialize()`` memoizes its wire form and
+    ``compute_merkle_root()`` its root (non-field slots via
+    ``object.__setattr__`` — see BlockHeader's cache notes for why
+    equality and ``dataclasses.replace`` stay unaffected).
+    ``deserialize`` seeds the block's, header's, and every transaction's
+    caches with the exact wire slices: one gossip frame is parsed once
+    and its bytes then flow unchanged through validation digests, the
+    store append, and relay re-encode — the zero-repack pipeline.
+    """
+
     header: BlockHeader
     txs: tuple[Transaction, ...] = ()
 
@@ -98,36 +111,50 @@ class Block:
         return self.header.block_hash()
 
     def compute_merkle_root(self) -> bytes:
-        return merkle_root([tx.txid() for tx in self.txs])
+        root = self.__dict__.get("_merkle")
+        if root is None:
+            root = merkle_root([tx.txid() for tx in self.txs])
+            object.__setattr__(self, "_merkle", root)
+        return root
 
     def merkle_ok(self) -> bool:
         return self.header.merkle_root == self.compute_merkle_root()
 
     def serialize(self) -> bytes:
-        parts = [self.header.serialize(), struct.pack(">I", len(self.txs))]
-        for tx in self.txs:
-            raw = tx.serialize()
-            parts.append(struct.pack(">I", len(raw)))
-            parts.append(raw)
-        return b"".join(parts)
+        raw = self.__dict__.get("_raw")
+        if raw is None:
+            parts = [self.header.serialize(), _U32.pack(len(self.txs))]
+            for tx in self.txs:
+                tx_raw = tx.serialize()
+                parts.append(_U32.pack(len(tx_raw)))
+                parts.append(tx_raw)
+            raw = b"".join(parts)
+            object.__setattr__(self, "_raw", raw)
+        return raw
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Block":
         if len(data) < HEADER_SIZE + 4:
             raise ValueError("truncated block")
         header = BlockHeader.deserialize(data[:HEADER_SIZE])
-        (ntx,) = struct.unpack(">I", data[HEADER_SIZE : HEADER_SIZE + 4])
+        (ntx,) = _U32.unpack_from(data, HEADER_SIZE)
         off = HEADER_SIZE + 4
+        total = len(data)
         txs = []
         for _ in range(ntx):
-            if len(data) < off + 4:
+            if total < off + 4:
                 raise ValueError("truncated block tx table")
-            (txlen,) = struct.unpack(">I", data[off : off + 4])
+            (txlen,) = _U32.unpack_from(data, off)
             off += 4
-            if len(data) < off + txlen:
+            if total < off + txlen:
                 raise ValueError("truncated block tx")
             txs.append(Transaction.deserialize(data[off : off + txlen]))
             off += txlen
-        if off != len(data):
-            raise ValueError(f"{len(data) - off} trailing bytes after block")
-        return cls(header, tuple(txs))
+        if off != total:
+            raise ValueError(f"{total - off} trailing bytes after block")
+        # Direct construction (Block has no __post_init__ to honor); the
+        # parse consumed data exactly (strict framing, per-field
+        # round-trip identity), so these bytes are the canonical encoding.
+        block = object.__new__(cls)
+        block.__dict__.update(header=header, txs=tuple(txs), _raw=bytes(data))
+        return block

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import hashlib
 
+_sha256 = hashlib.sha256  # bound once: this runs several times per block
+
 
 def sha256d(data: bytes) -> bytes:
-    return hashlib.sha256(hashlib.sha256(data).digest()).digest()
+    return _sha256(_sha256(data).digest()).digest()

@@ -22,6 +22,19 @@ Difficulty convention: an integer ``d`` meaning the block hash, read as a
 big-endian 256-bit integer, must be strictly less than ``2**(256-d)`` —
 i.e. it has at least ``d`` leading zero bits.  ``BASELINE.json:6-12`` sweeps
 ``d`` in 16..28.
+
+Canonical-encoding cache: the header is frozen, so its 80-byte wire form
+and SHA-256d digest are constants of the instance — ``serialize()`` and
+``block_hash()`` compute each once and memoize via ``object.__setattr__``
+(cache slots are NOT dataclass fields: equality/hash ignore them, and
+``dataclasses.replace`` — hence ``with_nonce``/``with_timestamp`` — builds
+instances through ``__init__``, so derived headers start with *fresh,
+empty* caches and can never inherit a stale encoding).  ``deserialize``
+seeds the cache with the exact wire bytes, which is what makes the ingest
+pipeline zero-repack: a header that arrived off the wire or disk is never
+packed again for hashing, storing, or relay (docs/PERF.md "host ingest
+plane").  The encoding is canonical — fixed-width fields — so the seeded
+bytes are byte-identical to a recomputation (tested).
 """
 
 from __future__ import annotations
@@ -59,14 +72,18 @@ class BlockHeader:
             raise ValueError(f"difficulty={self.difficulty} out of range (0..255)")
 
     def serialize(self) -> bytes:
-        return _PACK.pack(
-            self.version,
-            self.prev_hash,
-            self.merkle_root,
-            self.timestamp,
-            self.difficulty,
-            self.nonce,
-        )
+        raw = self.__dict__.get("_raw")
+        if raw is None:
+            raw = _PACK.pack(
+                self.version,
+                self.prev_hash,
+                self.merkle_root,
+                self.timestamp,
+                self.difficulty,
+                self.nonce,
+            )
+            object.__setattr__(self, "_raw", raw)
+        return raw
 
     @classmethod
     def deserialize(cls, data: bytes) -> "BlockHeader":
@@ -75,7 +92,28 @@ class BlockHeader:
         version, prev_hash, merkle_root, timestamp, difficulty, nonce = _PACK.unpack(
             data
         )
-        return cls(version, prev_hash, merkle_root, timestamp, difficulty, nonce)
+        # The fixed-width unpack structurally guarantees every
+        # ``__post_init__`` range rule (``>I`` yields uint32, ``32s``
+        # yields 32 bytes) except the difficulty ceiling — check that one
+        # and build the instance directly: this is the gossip/resume hot
+        # path, and re-validating what the wire format already proves is
+        # pure overhead.
+        if difficulty > 255:
+            raise ValueError(f"difficulty={difficulty} out of range (0..255)")
+        header = object.__new__(cls)
+        header.__dict__.update(
+            version=version,
+            prev_hash=prev_hash,
+            merkle_root=merkle_root,
+            timestamp=timestamp,
+            difficulty=difficulty,
+            nonce=nonce,
+            # Seed the encoding cache with the exact wire bytes:
+            # fixed-width fields make re-packing byte-identical, so these
+            # ARE the canonical encoding and the header never repacks.
+            _raw=bytes(data),
+        )
+        return header
 
     def with_nonce(self, nonce: int) -> "BlockHeader":
         return dataclasses.replace(self, nonce=nonce)
@@ -88,10 +126,15 @@ class BlockHeader:
         return self.serialize()[:NONCE_OFFSET]
 
     def block_hash(self) -> bytes:
-        """SHA-256d of the serialized header (the block id)."""
-        from p1_tpu.core.hashutil import sha256d
+        """SHA-256d of the serialized header (the block id) — computed
+        once; gossip ingest, fork choice, and store resume all re-ask."""
+        digest = self.__dict__.get("_hash")
+        if digest is None:
+            from p1_tpu.core.hashutil import sha256d
 
-        return sha256d(self.serialize())
+            digest = sha256d(self.serialize())
+            object.__setattr__(self, "_hash", digest)
+        return digest
 
 
 def target_from_difficulty(difficulty: int) -> int:

@@ -10,15 +10,20 @@ a ledger mean anything.  Design (tpu rebuild, round 4):
   but only a transaction carrying the matching public key and a valid
   Ed25519 signature can *spend* from a fingerprint account — enforced at
   mempool admission AND block validation (p1_tpu/chain/validate.py).
-- Ed25519 via the ``cryptography`` package (present in this image; no
-  network egress to fetch anything else).  Signatures are 64 bytes,
-  public keys 32 — both fit the transaction's length-prefixed layout.
+- Ed25519 via the ``cryptography`` package **when the wheel is present**,
+  else the vendored pure-Python RFC 8032 implementation
+  (core/_ed25519.py).  The wheel is an optional accelerator, never an
+  import-time requirement: images without it (no egress to fetch one)
+  still import, sign, and verify — byte-identically, just slower.
+  Signatures are 64 bytes, public keys 32 — both fit the transaction's
+  length-prefixed layout.
 - Deterministic from a 32-byte seed, so tests can use fixed keys and the
   CLI can persist one JSON file per identity (``p1 keygen``).
 
 Verification is memoized (bounded LRU): a transaction is typically seen
 several times (gossip admission, block validation, reorg resurrection) and
-Ed25519 verify costs ~100 µs — the cache makes every re-check O(1).
+Ed25519 verify costs ~100 µs native (a few ms pure-Python) — the cache
+makes every re-check O(1).
 """
 
 from __future__ import annotations
@@ -28,8 +33,15 @@ import hashlib
 import json
 import os
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ed25519
+try:  # pragma: no cover - exercised implicitly by whichever env runs
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # the wheel is optional; fall back to pure Python
+    HAVE_CRYPTOGRAPHY = False
+
+from p1_tpu.core import _ed25519 as _py_ed25519
 
 #: Account-id prefix: distinguishes spendable (key-backed) accounts from
 #: free-form receive-only ids at a glance.
@@ -41,8 +53,12 @@ SIG_SIZE = 64
 SEED_SIZE = 32
 
 
+@functools.lru_cache(maxsize=65_536)
 def account_id(pubkey: bytes) -> str:
-    """The spendable account id owned by ``pubkey``."""
+    """The spendable account id owned by ``pubkey``.  Memoized: every
+    ``verify_signature`` call derives the sender's fingerprint, and a
+    node re-checks the same few senders' keys across gossip admission,
+    block validation, and reorgs — pure function, bounded cache."""
     if len(pubkey) != PUBKEY_SIZE:
         raise ValueError(f"public key must be {PUBKEY_SIZE} bytes")
     return ACCOUNT_PREFIX + hashlib.sha256(pubkey).hexdigest()[:_FINGERPRINT_HEX]
@@ -61,8 +77,12 @@ class Keypair:
         if len(seed) != SEED_SIZE:
             raise ValueError(f"seed must be {SEED_SIZE} bytes")
         self._seed = seed
-        self._private = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
-        self.pubkey: bytes = self._private.public_key().public_bytes_raw()
+        if HAVE_CRYPTOGRAPHY:
+            self._private = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
+            self.pubkey: bytes = self._private.public_key().public_bytes_raw()
+        else:
+            self._private = None
+            self.pubkey = _py_ed25519.public_key(seed)
         self.account: str = account_id(self.pubkey)
 
     @classmethod
@@ -76,7 +96,11 @@ class Keypair:
         return cls(hashlib.sha256(text.encode("utf-8")).digest())
 
     def sign(self, message: bytes) -> bytes:
-        return self._private.sign(message)
+        if self._private is not None:
+            return self._private.sign(message)
+        # Ed25519 signing is deterministic (RFC 8032): the fallback
+        # produces the exact bytes the wheel would.
+        return _py_ed25519.sign(self._seed, message)
 
     # -- persistence (p1 keygen / p1 tx --key) ---------------------------
 
@@ -121,6 +145,8 @@ class Keypair:
 
 @functools.lru_cache(maxsize=65_536)
 def _verify_cached(pubkey: bytes, sig: bytes, message: bytes) -> bool:
+    if not HAVE_CRYPTOGRAPHY:
+        return _py_ed25519.verify(pubkey, sig, message)
     try:
         ed25519.Ed25519PublicKey.from_public_bytes(pubkey).verify(sig, message)
         return True

@@ -22,6 +22,16 @@ are minted by consensus per chain, not spent by an owner) and MUST carry
 empty pubkey/sig/chain.  The txid commits to the signature too (like a
 pre-segwit Bitcoin txid commits to scriptSig); Ed25519 signing is
 deterministic, so an honest signer produces one txid per transaction.
+
+Canonical-encoding cache: like ``BlockHeader``, the frozen instance
+memoizes ``serialize()``, ``signing_bytes()`` and ``txid()`` via
+``object.__setattr__`` (non-field slots — equality, hashing, and
+``dataclasses.replace`` ignore them, so ``transfer()``'s replace-with-sig
+starts clean), and ``deserialize``/``deserialize_prefix`` seed the cache
+with the exact wire bytes.  The layout round-trips byte-identically
+(length-prefixed fields, fixed-width integers — tested), so a transaction
+is packed at most once per process no matter how many times gossip,
+block assembly, persistence, and relay re-serialize it.
 """
 
 from __future__ import annotations
@@ -32,6 +42,7 @@ import struct
 from p1_tpu.core import keys as _keys
 
 _MAX_ID_LEN = 255
+_NUMS = struct.Struct(">QQQ")
 
 #: Reserved sender id marking a block-reward (coinbase) transaction.  A
 #: coinbase is what gives each miner's candidate block a distinct identity:
@@ -70,30 +81,38 @@ class Transaction:
     def signing_bytes(self) -> bytes:
         """What the sender signs: the five core fields plus the chain tag
         (everything except the proof itself) — signatures are chain-bound."""
-        s = self.sender.encode("utf-8")
-        r = self.recipient.encode("utf-8")
-        return b"".join(
-            (
-                struct.pack(">B", len(s)),
-                s,
-                struct.pack(">B", len(r)),
-                r,
-                struct.pack(">QQQ", self.amount, self.fee, self.seq),
-                struct.pack(">B", len(self.chain)),
-                self.chain,
+        raw = self.__dict__.get("_signing")
+        if raw is None:
+            s = self.sender.encode("utf-8")
+            r = self.recipient.encode("utf-8")
+            raw = b"".join(
+                (
+                    struct.pack(">B", len(s)),
+                    s,
+                    struct.pack(">B", len(r)),
+                    r,
+                    struct.pack(">QQQ", self.amount, self.fee, self.seq),
+                    struct.pack(">B", len(self.chain)),
+                    self.chain,
+                )
             )
-        )
+            object.__setattr__(self, "_signing", raw)
+        return raw
 
     def serialize(self) -> bytes:
-        return b"".join(
-            (
-                self.signing_bytes(),
-                struct.pack(">B", len(self.pubkey)),
-                self.pubkey,
-                struct.pack(">B", len(self.sig)),
-                self.sig,
+        raw = self.__dict__.get("_raw")
+        if raw is None:
+            raw = b"".join(
+                (
+                    self.signing_bytes(),
+                    struct.pack(">B", len(self.pubkey)),
+                    self.pubkey,
+                    struct.pack(">B", len(self.sig)),
+                    self.sig,
+                )
             )
-        )
+            object.__setattr__(self, "_raw", raw)
+        return raw
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Transaction":
@@ -104,43 +123,76 @@ class Transaction:
 
     @classmethod
     def deserialize_prefix(cls, data: bytes) -> tuple["Transaction", bytes]:
-        """Parse one transaction off the front of ``data``; return (tx, rest)."""
+        """Parse one transaction off the front of ``data``; return (tx, rest).
 
-        def take(buf: bytes, n: int) -> tuple[bytes, bytes]:
-            if len(buf) < n:
-                raise ValueError("truncated transaction")
-            return buf[:n], buf[n:]
-
-        lb, data = take(data, 1)
-        s, data = take(data, lb[0])
-        lb, data = take(data, 1)
-        r, data = take(data, lb[0])
-        nums, data = take(data, 24)
-        amount, fee, seq = struct.unpack(">QQQ", nums)
-        lb, data = take(data, 1)
-        chain, data = take(data, lb[0])
-        lb, data = take(data, 1)
-        pubkey, data = take(data, lb[0])
-        lb, data = take(data, 1)
-        sig, data = take(data, lb[0])
-        return (
-            cls(
-                s.decode("utf-8"),
-                r.decode("utf-8"),
-                amount,
-                fee,
-                seq,
-                pubkey,
-                sig,
-                chain,
-            ),
-            data,
+        Offset-walking hot path that builds the instance directly: the
+        wire format structurally guarantees every ``__post_init__``
+        constraint (one-byte length prefixes cap the variable fields at
+        255, ``>QQQ`` caps the integers at uint64, utf-8 decode/encode
+        round-trips byte-identically) except non-empty ids, which are
+        checked explicitly — so gossip ingest never re-validates what
+        the framing already proves.
+        """
+        buf = bytes(data)
+        total = len(buf)
+        off = 0
+        try:
+            n = buf[off]
+            s = buf[off + 1 : off + 1 + n]
+            off += 1 + n
+            n = buf[off]
+            r = buf[off + 1 : off + 1 + n]
+            off += 1 + n
+            amount, fee, seq = _NUMS.unpack_from(buf, off)
+            off += 24
+            n = buf[off]
+            chain = buf[off + 1 : off + 1 + n]
+            off += 1 + n
+            signing_end = off
+            n = buf[off]
+            pubkey = buf[off + 1 : off + 1 + n]
+            off += 1 + n
+            n = buf[off]
+            sig = buf[off + 1 : off + 1 + n]
+            off += 1 + n
+        except (IndexError, struct.error):
+            raise ValueError("truncated transaction") from None
+        if off > total:
+            # A short final slice advances ``off`` past the end without
+            # tripping the index probes above.
+            raise ValueError("truncated transaction")
+        if not s:
+            raise ValueError("sender must encode to 1..255 bytes")
+        if not r:
+            raise ValueError("recipient must encode to 1..255 bytes")
+        tx = object.__new__(cls)
+        tx.__dict__.update(
+            sender=s.decode("utf-8"),
+            recipient=r.decode("utf-8"),
+            amount=amount,
+            fee=fee,
+            seq=seq,
+            pubkey=pubkey,
+            sig=sig,
+            chain=chain,
+            # Seed the encoding caches with exactly the bytes consumed:
+            # the layout round-trips byte-identically, so they ARE
+            # canonical — and ``signing_bytes`` is by construction the
+            # wire prefix through the chain tag, so signature checks on
+            # ingested transactions never re-pack either.
+            _raw=buf[:off] if off < total else buf,
+            _signing=buf[:signing_end],
         )
+        return tx, buf[off:]
 
     def txid(self) -> bytes:
-        from p1_tpu.core.hashutil import sha256d
+        digest = self.__dict__.get("_txid")
+        if digest is None:
+            from p1_tpu.core.hashutil import sha256d
 
-        return sha256d(self.serialize())
+            digest = sha256d(self.serialize())
+            object.__setattr__(self, "_txid", digest)
+        return digest
 
     @property
     def is_coinbase(self) -> bool:

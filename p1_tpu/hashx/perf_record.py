@@ -18,3 +18,16 @@ RECORDED_V5E_PALLAS_HPS = 750e6
 #: as the relay's known transient ~25× degradation (observed 2026-07-30)
 #: rather than a real kernel change, and re-measured after a wait.
 DEGRADED_FRACTION = 0.3
+
+#: Host ingest plane (benchmarks/host_ingest.py, default config: 1000
+#: blocks × 2 signed transfers, difficulty 1, signature memo warm) —
+#: blocks/s through deserialize → check_block → add_block on the
+#: zero-repack pipeline, measured 2026-08-04 on the 1-vCPU bench host
+#: (docs/PERF.md "host ingest plane").  ``bench.py`` reports degradation
+#: against it; update HERE when the host pipeline moves.
+RECORDED_HOST_INGEST_BPS = 22_000.0
+
+#: Same-session fraction below which ``bench.py`` flags the host ingest
+#: measurement as a regression in its JSON output.  Looser than the TPU
+#: guard: host rates on the shared 1-vCPU box wobble with co-tenants.
+HOST_INGEST_DEGRADED_FRACTION = 0.5

@@ -39,6 +39,27 @@ from p1_tpu.hashx.jax_sha256 import default_unroll, search_step
 _U32 = jnp.uint32
 AXIS = "chips"
 
+# shard_map moved to the jax top level (and check_rep became check_vma,
+# with lax.pcast the promotion API) in newer JAX; resolve whichever this
+# environment carries so the mesh backend runs on both sides of the move.
+if hasattr(jax, "shard_map"):
+    _SHARD_MAP_KW = "check_vma"
+    _shard_map = jax.shard_map
+else:  # pre-move JAX: experimental module, check_rep, no pcast
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = "check_rep"
+
+
+def _pcast_varying(x, axis):
+    """``lax.pcast(x, axis, to="varying")`` where it exists, identity
+    where the old check_rep machinery infers replication itself."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis)
+    return x
+
 
 def make_mesh(
     n_devices: int | None = None, platform: str | None = None
@@ -107,11 +128,11 @@ def jit_sharded_step(
 
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(), P()),
         out_specs=P(),
-        check_vma=check_vma,
+        **{_SHARD_MAP_KW: check_vma},
     )
     def step(midstate, tail, target, nonce_base):
         d = lax.axis_index(AXIS).astype(_U32)
@@ -122,8 +143,7 @@ def jit_sharded_step(
             # match, or the fori_loop carry in the compression rejects the
             # mixed types.
             midstate, tail, target = (
-                lax.pcast(x, AXIS, to="varying")
-                for x in (midstate, tail, target)
+                _pcast_varying(x, AXIS) for x in (midstate, tail, target)
             )
         off = device_search(midstate, tail, target, base)
         hit = off < _U32(batch_per_device)

@@ -55,7 +55,9 @@ def dump_mempool(rows: list[tuple[Transaction, float]]) -> bytes:
     MAGIC + u32 count + per tx (f64 age_s + u32 len + wire bytes).
     Split from the file write so the node can take the snapshot on the
     event loop (where the pool is mutated) and do the encoding + disk
-    I/O in a worker thread."""
+    I/O in a worker thread.  ``tx.serialize()`` is memoized (core/tx.py),
+    so the periodic checkpoint re-emits each pending transaction's
+    gossip bytes rather than re-packing the pool every interval."""
     import struct as _struct
 
     parts = [MEMPOOL_MAGIC, _struct.pack(">I", len(rows))]

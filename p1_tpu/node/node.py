@@ -1025,7 +1025,10 @@ class Node:
                     payload = await asyncio.wait_for(
                         frames.read(), timeout=timeout
                     )
-                except TimeoutError:
+                except (TimeoutError, asyncio.TimeoutError):
+                    # Both spellings: asyncio.TimeoutError only became the
+                    # builtin in Python 3.11; on 3.10 a bare TimeoutError
+                    # would miss it and the probe path would never run.
                     grace = (
                         self.config.ping_interval_s
                         + self.config.pong_timeout_s
@@ -1054,6 +1057,7 @@ class Node:
                 await self._dispatch(peer, payload)
         except (
             asyncio.IncompleteReadError,
+            asyncio.TimeoutError,  # pre-3.11: not an OSError subclass
             ConnectionError,
             ValueError,
             OSError,
@@ -1407,6 +1411,13 @@ class Node:
         gossip: bool = True,
         sent_ts: float | None = None,
     ):
+        # Zero-repack pipeline: a block decoded off the wire carries its
+        # exact frame bytes in its encoding cache (core/block.py), so the
+        # hashing below (add_block's validation), the store append, and
+        # the re-relay encode all reuse them — the block is packed at
+        # most once per process lifetime (docs/PERF.md "host ingest
+        # plane").  Only mempool-reconstructed compact blocks serialize
+        # fresh, once, on first use (their full frame never arrived).
         res = self.chain.add_block(block)
         if res.status is AddStatus.ACCEPTED:
             if sent_ts is not None:

@@ -218,6 +218,9 @@ def encode_hello(h: Hello) -> bytes:
 
 
 def encode_block(block: Block, sent_ts: float | None = None) -> bytes:
+    # ``serialize`` is memoized on the block (core/block.py): relaying a
+    # block that arrived by gossip re-frames the SAME wire bytes — the
+    # zero-repack pipeline's relay leg.
     ts = time.time() if sent_ts is None else sent_ts
     return bytes([MsgType.BLOCK]) + struct.pack(">d", ts) + block.serialize()
 

@@ -1,0 +1,53 @@
+"""Tier-0 import health: every p1_tpu module must import, period.
+
+The seed round's entire test suite silently collapsed to ZERO collected
+tests because one module (core/keys.py) hard-imported an optional wheel
+(``cryptography``) at module scope — every test module importing the
+core package died at collection, and nothing failed loudly enough to
+say why.  This file makes that class of regression impossible to miss:
+each module is a separate parametrized case, so the report names the
+exact module that stopped importing, and a collection-killing import
+shows up as a failing TEST rather than a mysteriously smaller suite.
+
+Optional dependencies must be guarded (lazy import, try/except, vendored
+fallback) — see core/keys.py's cryptography/_ed25519 split for the
+house pattern.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pkgutil
+
+import pytest
+
+import p1_tpu
+
+
+def _all_modules() -> list[str]:
+    names = ["p1_tpu"]
+    for mod in pkgutil.walk_packages(p1_tpu.__path__, prefix="p1_tpu."):
+        if mod.name.endswith("__main__"):
+            continue  # entry point: importing it RUNS the CLI
+        names.append(mod.name)
+    return sorted(names)
+
+
+@pytest.mark.parametrize("name", _all_modules())
+def test_module_imports(name):
+    importlib.import_module(name)
+
+
+def test_walk_found_the_tree():
+    # Guard the guard: if the walk itself breaks (layout change, namespace
+    # confusion), an empty parametrization would vacuously "pass".
+    names = _all_modules()
+    assert len(names) > 30, names
+    for expected in (
+        "p1_tpu.core.keys",
+        "p1_tpu.core._ed25519",
+        "p1_tpu.chain.replay",
+        "p1_tpu.node.node",
+        "p1_tpu.hashx.pallas_backend",
+    ):
+        assert expected in names

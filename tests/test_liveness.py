@@ -418,7 +418,8 @@ class TestFrameReaderFuzz:
                     payload = await asyncio.wait_for(
                         fr.read(), timeout=rng.choice((0.0005, 0.002, 0.5))
                     )
-                except TimeoutError:
+                except (TimeoutError, asyncio.TimeoutError):
+                    # Both spellings: only unified in Python 3.11.
                     continue  # retry exactly as the session loop does
                 frames_out.append(payload)
             assert frames_out == frames  # byte-identical, in order
