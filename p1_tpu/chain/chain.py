@@ -201,7 +201,8 @@ class Chain:
         the honest branch makes progress instead of re-mining one
         candidate forever.  O(index); only called in that rare mode.
         Genesis always qualifies (its stamp is a fixed past constant)."""
-        best = self._index[self.genesis.block_hash()]
+        best_hash = self.genesis.block_hash()
+        best = self._index[best_hash]
         for bhash, entry in self._index.items():
             if (
                 entry.block.header.timestamp > ts_bound
@@ -211,11 +212,14 @@ class Chain:
                 # rejection memory) but nothing may mine on them — the
                 # same exclusion _best_valid_tip applies.
                 continue
+            # Work tie-break on the hash — compared via the index KEYS,
+            # which already are the hashes: re-deriving block_hash() per
+            # entry would put a redundant sha256d inside this O(index)
+            # scan (ADVICE r5).
             if entry.work > best.work or (
-                entry.work == best.work
-                and entry.block.block_hash() < best.block.block_hash()
+                entry.work == best.work and bhash < best_hash
             ):
-                best = entry
+                best, best_hash = entry, bhash
         return best.block
 
     def balance(self, account: str) -> int:

@@ -205,6 +205,18 @@ def replay_native(
     )
 
 
+def _probe_native() -> None:
+    """Force the C library load NOW: ``native_backend._lib()`` builds
+    (or finds) the .so and binds every symbol the wrapper uses, so all
+    environment failure modes — no toolchain (``NativeBuildError``),
+    unloadable .so (``OSError``), stale symbol table (``AttributeError``
+    from ctypes, surfacing deliberately) — fire here, in a scope where
+    the caller knows exactly what it is excusing."""
+    from p1_tpu.hashx import native_backend
+
+    native_backend._lib()
+
+
 def replay_fast(
     headers: list[BlockHeader], retarget=None
 ) -> ReplayReport:
@@ -212,15 +224,23 @@ def replay_fast(
     host oracle end-to-end, rule-for-rule parity-tested on fixed and
     retargeting chains alike), falling back to the hashlib oracle when
     the native library cannot build (no toolchain).  The light-client
-    entry point (`p1 headers`, `p1 proof --headers`)."""
+    entry point (`p1 headers`, `p1 proof --headers`).
+
+    The fallback excuses ENVIRONMENT failures only, and only from the
+    probe: ``replay_native`` itself runs outside any except scope, so a
+    genuine wrapper bug (bad argtypes, a broken ``ReplayReport``
+    construction, an AttributeError anywhere past the load) crashes
+    loudly instead of silently degrading every light-client
+    verification to the slow host path forever (ADVICE r5)."""
     from p1_tpu.hashx.native_build import NativeBuildError
 
     try:
-        return replay_native(headers, retarget=retarget)
-    except (NativeBuildError, OSError, AttributeError):
-        # No compiler / unloadable .so / stale symbol table: the host
-        # path is always available and equally correct, just slower.
+        _probe_native()
+    except (NativeBuildError, OSError):
+        # No compiler / unloadable .so: the host path is always
+        # available and equally correct, just slower.
         return replay_host(headers, retarget=retarget)
+    return replay_native(headers, retarget=retarget)
 
 
 def replay_packed(raw: bytes, retarget=None) -> ReplayReport:

@@ -210,6 +210,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds of continued silence after a PING probe before the "
         "peer is evicted and its slot reused",
     )
+    p.add_argument(
+        "--sync-stall-timeout",
+        type=float,
+        default=10.0,
+        help="progress deadline on an in-flight chain/mempool sync: a "
+        "peer that advances nothing (blocks accepted, pages consumed — "
+        "not mere liveness) within this window is demoted and the "
+        "request re-issued to another peer (0 disables supervision)",
+    )
+    p.add_argument(
+        "--sync-attempts",
+        type=int,
+        default=8,
+        help="failover budget per catch-up episode: consecutive "
+        "no-progress re-issues before the node stops chasing and waits "
+        "for a fresh sync trigger (progress resets the budget)",
+    )
     _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
@@ -306,6 +323,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--out", default=None, help="write the verified headers here "
         "(80 bytes each; feeds `p1 replay --verify` and `p1 proof --headers`)"
+    )
+    p.add_argument(
+        "--stall-timeout",
+        type=float,
+        default=15.0,
+        help="per-round progress deadline: a GETHEADERS round that grows "
+        "nothing within this window abandons the session and retries "
+        "(against --fallback peers, round-robin, when given)",
+    )
+    p.add_argument(
+        "--fallback",
+        nargs="*",
+        default=[],
+        help="host:port alternates to fail over to when the primary "
+        "stalls mid-sync (accumulated headers are kept)",
     )
     _add_retarget(p)
 
@@ -679,6 +711,8 @@ async def _run_node(args, miner=None) -> int:
         handshake_timeout_s=getattr(args, "handshake_timeout", 10.0),
         ping_interval_s=getattr(args, "ping_interval", 60.0),
         pong_timeout_s=getattr(args, "pong_timeout", 20.0),
+        sync_stall_timeout_s=getattr(args, "sync_stall_timeout", 10.0),
+        sync_attempts_max=getattr(args, "sync_attempts", 8),
         revalidate_store=getattr(args, "revalidate_store", False),
     )
     node = Node(config, miner=miner)
@@ -1068,10 +1102,20 @@ def cmd_headers(args) -> int:
     from p1_tpu.node.client import get_headers
 
     rule = _retarget_rule(args)
+
+    def _addr(spec: str) -> tuple[str, int]:
+        host, _, port = spec.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
     try:
         headers = asyncio.run(
             get_headers(
-                args.host, args.port, args.difficulty, retarget=rule
+                args.host,
+                args.port,
+                args.difficulty,
+                retarget=rule,
+                stall_timeout_s=args.stall_timeout,
+                fallback_peers=[_addr(s) for s in args.fallback],
             )
         )
     except (
@@ -1851,6 +1895,12 @@ def cmd_net(args) -> int:
         # show the keepalive layer actually firing.  Honest miners
         # gossip constantly and never get probed.
         cmd += ["--ping-interval", "10", "--pong-timeout", "5"]
+        # Tight sync supervision to match: a localhost batch turns
+        # around in milliseconds, so a 5 s no-progress window on a
+        # catch-up is decisively a stall — soak statuses surface the
+        # failover layer under byzantine serve-and-starve peers while
+        # honest syncs (progress resets the deadline) never trip it.
+        cmd += ["--sync-stall-timeout", "5"]
         if net_rule is not None:
             cmd += [
                 "--retarget-window", str(net_rule.window),

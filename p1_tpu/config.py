@@ -55,6 +55,21 @@ class NodeConfig:
     handshake_timeout_s: float = 10.0
     ping_interval_s: float = 60.0
     pong_timeout_s: float = 20.0
+    #: Request supervision (node/supervision.py).  An in-flight multi-round
+    #: fetch (locator block sync, compact-block GETBLOCKTXN round, paged
+    #: mempool sync) must show *progress* — blocks accepted, pages
+    #: consumed, not mere liveness — within ``sync_stall_timeout_s`` or
+    #: the node re-issues the request to a different eligible peer and
+    #: demotes (never bans) the staller.  Failovers back off with jitter
+    #: from ``sync_backoff_base_s`` doubling up to ``sync_backoff_max_s``,
+    #: and at most ``sync_attempts_max`` consecutive stalls are chased per
+    #: catch-up episode (progress resets the budget).  The deadline is
+    #: deliberately far above any honest batch turnaround: a slow peer
+    #: that keeps landing blocks is never demoted.
+    sync_stall_timeout_s: float = 10.0
+    sync_attempts_max: int = 8
+    sync_backoff_base_s: float = 0.25
+    sync_backoff_max_s: float = 5.0
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

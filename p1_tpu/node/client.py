@@ -21,25 +21,56 @@ from p1_tpu.node.protocol import Hello, MsgType
 
 
 @contextlib.asynccontextmanager
-async def _session(host: str, port: int, difficulty: int, retarget=None):
+async def _session(
+    host: str,
+    port: int,
+    difficulty: int,
+    retarget=None,
+    handshake_timeout: float | None = None,
+):
     """Connect + HELLO-validate against the chain selected by
     ``difficulty`` (+ optional ``RetargetRule`` — part of chain identity);
     yields (reader, writer, peer_hello).  The ONE copy of the handshake
-    all clients share — a protocol change lands here once."""
-    reader, writer = await asyncio.open_connection(host, port)
-    try:
-        genesis_hash = make_genesis(difficulty, retarget).block_hash()
-        await protocol.write_frame(
-            writer, protocol.encode_hello(Hello(genesis_hash, 0, 0))
-        )
-        mtype, hello = protocol.decode(await protocol.read_frame(reader))
-        if mtype is not MsgType.HELLO:
-            raise ValueError("node did not HELLO")
-        if hello.genesis_hash != genesis_hash:
-            raise ValueError(
-                "genesis mismatch: node runs a different chain "
-                "(check --difficulty / retarget flags)"
+    all clients share — a protocol change lands here once.
+
+    ``handshake_timeout`` bounds connect + HELLO exchange with its own
+    deadline: a half-open peer (accepts TCP, never answers — a dead
+    process behind a live listen backlog) must cost a supervised caller
+    one stall, not its entire overall timeout.  None keeps the caller's
+    outer ``wait_for`` as the only bound (the one-shot clients, whose
+    whole round is already a single short timeout)."""
+
+    async def _connect():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            genesis_hash = make_genesis(difficulty, retarget).block_hash()
+            await protocol.write_frame(
+                writer, protocol.encode_hello(Hello(genesis_hash, 0, 0))
             )
+            mtype, hello = protocol.decode(
+                await protocol.read_frame(reader)
+            )
+            if mtype is not MsgType.HELLO:
+                raise ValueError("node did not HELLO")
+            if hello.genesis_hash != genesis_hash:
+                raise ValueError(
+                    "genesis mismatch: node runs a different chain "
+                    "(check --difficulty / retarget flags)"
+                )
+            return reader, writer, hello
+        except BaseException:
+            # Incl. the cancellation a handshake timeout injects: the
+            # socket must not outlive the abandoned attempt.
+            writer.close()
+            raise
+
+    if handshake_timeout is None:
+        reader, writer, hello = await _connect()
+    else:
+        reader, writer, hello = await asyncio.wait_for(
+            _connect(), handshake_timeout
+        )
+    try:
         yield reader, writer, hello
     finally:
         writer.close()
@@ -123,64 +154,134 @@ async def get_headers(
     timeout: float = 60.0,
     retarget=None,
     max_headers: int = 1_000_000,
+    stall_timeout_s: float = 15.0,
+    fallback_peers=(),
+    attempts_max: int = 4,
 ):
     """Headers-first light-client sync: the node's full main-chain header
     list, genesis-first, ~80 B per block.  Fetches until a reply adds
     nothing new; the CALLER must then verify the chain itself with
     ``p1_tpu.chain.replay_host`` (PoW, linkage, difficulty schedule) —
     this function moves bytes, it does not bless them.  ``max_headers``
-    bounds memory against a responder that streams garbage forever."""
+    bounds memory against a responder that streams garbage forever.
+
+    Supervised (node/supervision.py, the same layer the node runs over
+    its own locator sync): each GETHEADERS round must land a reply that
+    grows the chain within ``stall_timeout_s``, or the session is
+    abandoned and the fetch resumes — accumulated headers kept — against
+    the next address in ``[primary, *fallback_peers]`` after a jittered
+    backoff.  The locator is rebuilt from what we already hold, so a
+    failover re-fetches at most one batch, and the link-point truncation
+    below already handles a fallback peer on a different (heavier-tip)
+    branch.  ``attempts_max`` consecutive stalls raise ``SyncStalled``;
+    progress resets the budget, so an honest-slow peer that keeps
+    serving batches is never abandoned.  Protocol violations (unlinked
+    or non-contiguous batches) still raise ``ValueError`` immediately —
+    a lying peer is not retried, only a stalled one."""
+    from p1_tpu.node.supervision import RequestSupervisor, SyncStalled
 
     async def _run():
-        async with _session(host, port, difficulty, retarget) as (
-            reader,
-            writer,
-            _,
-        ):
-            genesis = make_genesis(difficulty, retarget)
-            headers = [genesis.header]
-            hashes = [genesis.block_hash()]
-            pos = {hashes[0]: 0}
-            from p1_tpu.chain.chain import locator_hashes
+        genesis = make_genesis(difficulty, retarget)
+        headers = [genesis.header]
+        hashes = [genesis.block_hash()]
+        pos = {hashes[0]: 0}
+        from p1_tpu.chain.chain import locator_hashes
 
-            while True:
-                await protocol.write_frame(
-                    writer, protocol.encode_getheaders(locator_hashes(hashes))
-                )
-                while True:
-                    mtype, body = await _read_msg(reader, writer)
-                    if mtype is MsgType.HEADERS:
-                        break
-                new = [h for h in body if h.block_hash() not in pos]
-                if not new:
-                    return headers
-                # A live peer can reorg between batches: the next reply
-                # then restarts below our tip.  Each batch must link to a
-                # header we hold — truncate back to that link point (the
-                # stale branch tail is no longer the peer's main chain)
-                # and extend contiguously; anything that links nowhere is
-                # a protocol violation, not something to append and let
-                # verification blame on an honest peer later.
-                at = pos.get(new[0].prev_hash)
-                if at is None:
-                    raise ValueError(
-                        "HEADERS reply does not link to the known chain"
-                    )
-                if at != len(headers) - 1:
-                    for h in hashes[at + 1 :]:
-                        del pos[h]
-                    del headers[at + 1 :]
-                    del hashes[at + 1 :]
-                for h in new:
-                    if h.prev_hash != hashes[-1]:
-                        raise ValueError("HEADERS batch is not contiguous")
-                    headers.append(h)
-                    hashes.append(h.block_hash())
-                    pos[hashes[-1]] = len(hashes) - 1
-                if len(headers) > max_headers:
-                    raise ValueError(
-                        f"peer served more than {max_headers} headers"
-                    )
+        sup = RequestSupervisor(
+            stall_timeout_s=stall_timeout_s, attempts_max=attempts_max
+        )
+        targets = [(host, port), *(tuple(p) for p in fallback_peers)]
+        ti = 0
+        while True:
+            t_host, t_port = targets[ti]
+            try:
+                async with _session(
+                    t_host,
+                    t_port,
+                    difficulty,
+                    retarget,
+                    # The handshake is a round too: a half-open target
+                    # costs one stall, then the fetch rotates on.
+                    handshake_timeout=stall_timeout_s,
+                ) as (
+                    reader,
+                    writer,
+                    _,
+                ):
+                    while True:
+                        await protocol.write_frame(
+                            writer,
+                            protocol.encode_getheaders(
+                                locator_hashes(hashes)
+                            ),
+                        )
+                        sup.begin(targets[ti])
+
+                        async def _reply():
+                            while True:
+                                mtype, body = await _read_msg(reader, writer)
+                                if mtype is MsgType.HEADERS:
+                                    return body
+
+                        body = await asyncio.wait_for(
+                            _reply(), stall_timeout_s
+                        )
+                        new = [h for h in body if h.block_hash() not in pos]
+                        if not new:
+                            return headers
+                        sup.progress()
+                        # A live peer can reorg between batches (and a
+                        # failover peer may follow a different branch):
+                        # the next reply then restarts below our tip.
+                        # Each batch must link to a header we hold —
+                        # truncate back to that link point (the stale
+                        # branch tail is no longer the serving peer's
+                        # main chain) and extend contiguously; anything
+                        # that links nowhere is a protocol violation,
+                        # not something to append and let verification
+                        # blame on an honest peer later.
+                        at = pos.get(new[0].prev_hash)
+                        if at is None:
+                            raise ValueError(
+                                "HEADERS reply does not link to the "
+                                "known chain"
+                            )
+                        if at != len(headers) - 1:
+                            for h in hashes[at + 1 :]:
+                                del pos[h]
+                            del headers[at + 1 :]
+                            del hashes[at + 1 :]
+                        for h in new:
+                            if h.prev_hash != hashes[-1]:
+                                raise ValueError(
+                                    "HEADERS batch is not contiguous"
+                                )
+                            headers.append(h)
+                            hashes.append(h.block_hash())
+                            pos[hashes[-1]] = len(hashes) - 1
+                        if len(headers) > max_headers:
+                            raise ValueError(
+                                f"peer served more than {max_headers} "
+                                "headers"
+                            )
+            except (
+                ConnectionError,
+                OSError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,  # pre-3.11 spelling of the builtin
+                TimeoutError,
+            ) as e:
+                # Stalled round or dead session — never a protocol
+                # violation (those raise above).  Rotate to the next
+                # target and resume from the headers already held.
+                if sup.exhausted():
+                    raise SyncStalled(
+                        f"headers sync exhausted {attempts_max} failover "
+                        f"attempts; last peer {t_host}:{t_port} ({e!r})"
+                    ) from e
+                delay = sup.record_stall()
+                ti = (ti + 1) % len(targets)
+                await asyncio.sleep(delay)
 
     return await asyncio.wait_for(_run(), timeout)
 

@@ -36,6 +36,7 @@ from p1_tpu.mempool import Mempool
 from p1_tpu.miner import Miner
 from p1_tpu.node import protocol
 from p1_tpu.node.protocol import Hello, MsgType
+from p1_tpu.node.supervision import RequestSupervisor
 
 log = logging.getLogger("p1_tpu.node")
 
@@ -143,6 +144,21 @@ class NodeMetrics:
     #: peers, and peers evicted for staying silent through one.
     pings_sent: int = 0
     peers_evicted_idle: int = 0
+    #: Request supervision (node/supervision.py): progress deadlines on
+    #: multi-round fetches.  ``sync_stalls`` counts locator syncs that
+    #: advanced nothing within the deadline; ``sync_failovers`` the
+    #: locator re-issues to a different peer; ``sync_demotions`` the
+    #: sync-priority demotions charged to stallers (never bans — slowness
+    #: is not a violation); ``sync_exhausted`` catch-up episodes that
+    #: spent their whole failover budget.  The compact-block GETBLOCKTXN
+    #: round and paged mempool sync are supervised under the same
+    #: deadline with their own stall counters.
+    sync_stalls: int = 0
+    sync_failovers: int = 0
+    sync_demotions: int = 0
+    sync_exhausted: int = 0
+    cblock_fetch_stalls: int = 0
+    mempool_sync_stalls: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -174,6 +190,12 @@ class _PendingCompact:
     txs: list  # block-order slots; None where a tx is still missing
     want: dict  # index -> advertised txid (what GETBLOCKTXN asked for)
     sent_ts: float  # original sender's timestamp (propagation telemetry)
+    #: When the GETBLOCKTXN round trip was issued (monotonic).  The
+    #: supervision loop abandons reconstructions older than the sync
+    #: stall deadline and recovers the block via locator sync instead of
+    #: waiting on the FIFO cap — a peer that never answers must not be
+    #: able to delay a pushed block by squatting the pending slot.
+    asked_at: float = 0.0
 
 
 class _Peer:
@@ -215,6 +237,20 @@ class _Peer:
         #: must strictly advance in key order or the sync stops (hostile
         #: responders can't loop us).
         self.mempool_cursor: tuple[int, bytes] | None = None
+        #: When a GETMEMPOOL page request to this peer went out and no
+        #: MEMPOOL reply has landed yet (None = nothing outstanding).
+        #: The supervision loop treats an aged entry as a stalled sync.
+        self.mempool_inflight_since: float | None = None
+        #: True once the peer's HELLO carried a nonzero instance nonce —
+        #: a real node, not a one-shot tooling client.  Only nodes are
+        #: eligible targets for sync failover (a wallet ignores
+        #: GETBLOCKS).
+        self.is_node = False
+        #: Sync-priority demerits: one per supervised fetch this peer
+        #: stalled.  A demotion, never a ban — the peer keeps its
+        #: connection and its gossip, it just sorts last when the node
+        #: picks who to re-ask (supervision.py's design note).
+        self.sync_demerits = 0
         #: Remote host (peername IP), for per-HOST accounting such as the
         #: ADDR budget — per-connection state would reset on reconnect.
         self.host: str | None = (
@@ -272,6 +308,20 @@ class Node:
                 backend=get_backend(config.backend, **kwargs), chunk=config.chunk
             )
         self._peers: dict[asyncio.StreamWriter, _Peer] = {}
+        #: Supervision of the node-wide locator catch-up job: ONE
+        #: progress deadline over "is this chain still advancing toward
+        #: what peers advertised", targeting whichever peer was asked
+        #: last.  Any accepted block is progress (the serving peer does
+        #: not matter — catch-up converges on the same chain from
+        #: anyone), so an honest-slow peer that keeps landing batches
+        #: never trips it; a peer that answers PINGs but starves the
+        #: sync does, and the locator fails over (_check_block_sync).
+        self._sync = RequestSupervisor(
+            stall_timeout_s=config.sync_stall_timeout_s or 10.0,
+            attempts_max=config.sync_attempts_max,
+            backoff_base_s=config.sync_backoff_base_s,
+            backoff_max_s=config.sync_backoff_max_s,
+        )
         #: Discovery dials in flight (dedup against the next tick).
         self._dialing: set[tuple[str, int]] = set()
         #: Misbehavior scoring: host -> recent violation times / ban expiry.
@@ -536,6 +586,11 @@ class Node:
             # TTL expiry and/or the crash checkpoint: a persistent node
             # with expiry disabled still checkpoints its pool.
             self._tasks.append(asyncio.create_task(self._housekeeping_loop()))
+        if self.config.sync_stall_timeout_s > 0:
+            # Request supervision: progress deadlines + failover for
+            # every multi-round fetch (0 disables, e.g. single-peer
+            # tooling rigs that want no background re-requests).
+            self._tasks.append(asyncio.create_task(self._supervision_loop()))
         if self.config.mine:
             self.start_mining()
 
@@ -824,6 +879,190 @@ class Node:
             # loses at most one interval's worth of admissions.
             await self._checkpoint_mempool()
 
+    # -- request supervision (sync-stall failover) -----------------------
+
+    async def _request_blocks(self, peer: _Peer) -> None:
+        """Issue a supervised locator sync request to ``peer``: the
+        progress deadline (re)arms and the supervisor records who to
+        blame if nothing lands.  Every GETBLOCKS the node sends to a
+        single chosen peer goes through here — the quiesce-time
+        ``request_sync`` broadcast is the one exception (it asks
+        everyone at once, so there is no staller to supervise)."""
+        self._sync.begin(peer)
+        await self._send_guarded(
+            peer, protocol.encode_getblocks(self.chain.locator())
+        )
+
+    async def _request_mempool(
+        self, peer: _Peer, cursor: tuple[int, bytes] | None = None
+    ) -> None:
+        """Issue a supervised mempool (page) request to ``peer``."""
+        peer.mempool_requested = True
+        peer.mempool_inflight_since = time.monotonic()
+        await self._send_guarded(peer, protocol.encode_getmempool(cursor))
+
+    def _pick_sync_peer(self, exclude: _Peer | None = None) -> _Peer | None:
+        """The best peer to re-ask: node peers only (a tooling client
+        ignores GETBLOCKS), fewest demerits first, taller advertised
+        tips breaking ties.  Falls back to the excluded staller itself
+        when it is the only peer left — with jittered backoff and a
+        bounded attempt budget, retrying the sole source beats giving
+        up."""
+        candidates = [
+            p
+            for p in self._peers.values()
+            if p.is_node and p is not exclude
+        ]
+        if not candidates:
+            if exclude is not None and exclude.writer in self._peers:
+                return exclude
+            return None
+        return min(
+            candidates, key=lambda p: (p.sync_demerits, -p.hello_height)
+        )
+
+    async def _supervision_loop(self) -> None:
+        """Progress deadlines for every supervised fetch (supervision.py).
+        One poll loop rather than a timer per request: all request state
+        lives on the event loop anyway, and a tick at a quarter of the
+        stall deadline bounds detection latency at ~1.25x the deadline
+        without growing a task per in-flight fetch."""
+        interval = max(0.05, self.config.sync_stall_timeout_s / 4)
+        while self._running:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            try:
+                await self._check_block_sync()
+                await self._check_pending_cblocks(now)
+                await self._check_mempool_sync(now)
+            except Exception:
+                # The supervisor must never die of one bad tick — it is
+                # the layer that un-wedges everything else.
+                log.exception("request supervision tick failed")
+
+    async def _check_block_sync(self) -> None:
+        """The tentpole deadline: an in-flight locator sync that has
+        advanced the chain by nothing within ``sync_stall_timeout_s``
+        (or whose serving peer disconnected outright) is re-issued to a
+        different eligible peer; the staller is demoted, never banned."""
+        sup = self._sync
+        if not sup.active:
+            return
+        staller = sup.target
+        gone = staller.writer not in self._peers
+        if not (gone or sup.stalled()):
+            return
+        self.metrics.sync_stalls += 1
+        if not gone:
+            staller.sync_demerits += 1
+            self.metrics.sync_demotions += 1
+            log.warning(
+                "sync stall: %s advanced nothing in %.1fs — demoting "
+                "and failing over",
+                staller.label,
+                sup.stall_timeout_s,
+            )
+        if sup.exhausted():
+            # Budget spent on consecutive no-progress failovers: stop
+            # chasing until something new triggers a sync (fresh HELLO,
+            # orphan, compact push) — which also starts a fresh budget.
+            self.metrics.sync_exhausted += 1
+            sup.attempts = 0
+            sup.idle()
+            log.warning(
+                "sync failover budget exhausted (%d attempts); waiting "
+                "for a fresh trigger",
+                sup.attempts_max,
+            )
+            return
+        delay = sup.record_stall()
+        task = asyncio.create_task(self._failover_blocks(staller, delay))
+        self._sessions.add(task)
+        task.add_done_callback(self._sessions.discard)
+
+    async def _failover_blocks(self, staller: _Peer, delay: float) -> None:
+        """After the jittered backoff, re-issue the locator to the best
+        non-stalling peer (selection deferred to AFTER the sleep — the
+        peer set may have changed meanwhile)."""
+        await asyncio.sleep(delay)
+        if not self._running:
+            return
+        candidate = self._pick_sync_peer(exclude=staller)
+        if candidate is None:
+            # Nobody connected to ask: the dial/discovery loops own
+            # reconnection, and a fresh handshake restarts the sync.
+            return
+        self.metrics.sync_failovers += 1
+        log.info(
+            "sync failover: re-issuing locator to %s", candidate.label
+        )
+        await self._request_blocks(candidate)
+
+    async def _check_pending_cblocks(self, now: float) -> None:
+        """A GETBLOCKTXN round trip that outlives the stall deadline is
+        abandoned: the reconstruction is dropped, the silent peer
+        demoted, and the block recovered through ordinary supervised
+        locator sync — a compact push must never be the only way a
+        block can arrive (the FIFO cap alone left stranded entries
+        squatting until MAX_PENDING_CBLOCKS newer pushes evicted
+        them)."""
+        deadline = self.config.sync_stall_timeout_s
+        stale = [
+            key
+            for key, pending in self._pending_cblocks.items()
+            if now - pending.asked_at > deadline
+        ]
+        if not stale:
+            return
+        last_staller = None
+        for key in stale:
+            del self._pending_cblocks[key]
+            bhash, peer = key
+            self.metrics.cblock_fetch_stalls += 1
+            if peer.writer in self._peers:
+                peer.sync_demerits += 1
+                self.metrics.sync_demotions += 1
+            last_staller = peer
+            log.warning(
+                "GETBLOCKTXN to %s stalled %.1fs — dropping "
+                "reconstruction of %s, recovering via locator sync",
+                peer.label,
+                deadline,
+                bhash.hex()[:16],
+            )
+        candidate = self._pick_sync_peer(exclude=last_staller)
+        if candidate is not None:
+            self.metrics.sync_failovers += 1
+            await self._request_blocks(candidate)
+
+    async def _check_mempool_sync(self, now: float) -> None:
+        """A mempool page request with no MEMPOOL reply inside the
+        deadline: stop waiting on that peer (demote) and solicit the
+        pool from one other idle peer — pools overlap heavily, so any
+        honest peer recovers most of what the staller withheld."""
+        deadline = self.config.sync_stall_timeout_s
+        for peer in list(self._peers.values()):
+            since = peer.mempool_inflight_since
+            if since is None or now - since <= deadline:
+                continue
+            peer.mempool_inflight_since = None
+            self.metrics.mempool_sync_stalls += 1
+            peer.sync_demerits += 1
+            self.metrics.sync_demotions += 1
+            log.warning(
+                "mempool sync with %s stalled %.1fs — asking another "
+                "peer",
+                peer.label,
+                deadline,
+            )
+            other = self._pick_sync_peer(exclude=peer)
+            if (
+                other is not None
+                and other is not peer
+                and other.mempool_inflight_since is None
+            ):
+                await self._request_mempool(other)
+
     def _learn_addr(self, addr: tuple[str, int], tried: bool = False) -> None:
         """Merge one address into the bounded book (refreshes recency).
         ``tried`` promotes it to the handshake-verified bucket, where
@@ -872,13 +1111,22 @@ class Node:
         if bucket is None:
             bucket = self._addr_budgets[host] = [ADDR_TOKENS_MAX, now]
             if len(self._addr_budgets) > MAX_TRACKED_HOSTS:
-                # Fully-refilled entries carry no state worth keeping.
+                # Drop only buckets that are BOTH stale and sitting at
+                # exactly the base refill — those provably carry no
+                # state (they equal what a fresh create would mint).
+                # Everything else is information: recent activity, spent
+                # budget mid-window, and above all tokens ABOVE the cap,
+                # which are solicited-reply credit granted to an
+                # outbound peer — clawing that back mid-reply would
+                # silently ignore part of an ADDR answer we asked for
+                # (ADVICE r5: the old `< ADDR_TOKENS_MAX` filter did
+                # exactly that).
                 refill_s = ADDR_TOKENS_MAX / ADDR_TOKENS_RATE
                 cutoff = now - refill_s
                 self._addr_budgets = {
                     h: b
                     for h, b in self._addr_budgets.items()
-                    if b[1] >= cutoff and b[0] < ADDR_TOKENS_MAX
+                    if b[1] >= cutoff or b[0] != ADDR_TOKENS_MAX
                 }
                 self._addr_budgets.setdefault(host, bucket)
                 while len(self._addr_budgets) > MAX_TRACKED_HOSTS:
@@ -962,6 +1210,7 @@ class Node:
                 inbound = False  # the finally below must not double-count
             log.info("peer %s connected (their height %d)", label, hello.tip_height)
             peer.hello_height = hello.tip_height
+            peer.is_node = bool(hello.nonce)  # 0 = one-shot tooling client
             if hello.listen_port:
                 # The peer's claimed reachable address: its socket host +
                 # the listen port it advertised.  NOT promoted to tried —
@@ -997,14 +1246,15 @@ class Node:
                 # Blocks first, mempool after: the BLOCKS handler requests
                 # the pool once our chain reaches the advertised height,
                 # so admission's affordability check runs against a
-                # caught-up ledger.
-                await peer.send(protocol.encode_getblocks(self.chain.locator()))
+                # caught-up ledger.  Supervised: a peer that advertises a
+                # taller tip and then starves the sync is failed over
+                # within the progress deadline (_check_block_sync).
+                await self._request_blocks(peer)
             else:
                 # Learn the peer's pending transactions too: block sync
                 # alone would leave a late joiner's pool empty until fresh
                 # gossip.
-                peer.mempool_requested = True
-                await peer.send(protocol.encode_getmempool())
+                await self._request_mempool(peer)
             ping_pending = False
             while self._running:
                 # Idle probing: wait ping_interval_s for traffic; on
@@ -1127,19 +1377,31 @@ class Node:
             # Progress was made and the batch was non-empty: there may be
             # more behind it (an empty/duplicate reply ends the loop).
             if accepted_any and body:
-                await self._send_guarded(
-                    peer, protocol.encode_getblocks(self.chain.locator())
-                )
-            elif (
-                not peer.mempool_requested
-                and self.chain.height >= peer.hello_height
-            ):
-                # Block sync with this peer quiesced AND our chain reached
-                # what it advertised: NOW ask for its pool, with our ledger
-                # caught up (one-shot per peer).  If another peer's sync is
-                # still filling the gap, the next quiesced batch re-checks.
-                peer.mempool_requested = True
-                await self._send_guarded(peer, protocol.encode_getmempool())
+                await self._request_blocks(peer)
+            else:
+                if (
+                    self._sync.target is peer
+                    and self.chain.height >= peer.hello_height
+                ):
+                    # The supervised sync quiesced AND delivered what the
+                    # peer advertised: a completed episode, not a stall.
+                    # A non-advancing reply BELOW the advertised height
+                    # (empty frames, re-served stale batches) leaves the
+                    # deadline armed instead — chatty uselessness must
+                    # read as a stall, or it would be the cheapest way
+                    # to defeat the failover.  (A different peer's sync
+                    # stays armed either way.)
+                    self._sync.idle()
+                if (
+                    not peer.mempool_requested
+                    and self.chain.height >= peer.hello_height
+                ):
+                    # Block sync with this peer quiesced AND our chain
+                    # reached what it advertised: NOW ask for its pool,
+                    # with our ledger caught up (one-shot per peer).  If
+                    # another peer's sync is still filling the gap, the
+                    # next quiesced batch re-checks.
+                    await self._request_mempool(peer)
         elif mtype is MsgType.GETMEMPOOL:
             page, more = self.mempool.sync_page(body, MEMPOOL_SYNC_TXS)
             raws, total = [], 0
@@ -1153,6 +1415,7 @@ class Node:
             await self._send_guarded(peer, protocol.encode_mempool(raws, more))
         elif mtype is MsgType.MEMPOOL:
             more, txs = body
+            peer.mempool_inflight_since = None  # page landed: not stalled
             for tx in txs:
                 await self._handle_tx(tx, origin=peer)
             if more and txs:
@@ -1166,9 +1429,7 @@ class Node:
                 prev = peer.mempool_cursor
                 if prev is None or sync_key(*cursor) > sync_key(*prev):
                     peer.mempool_cursor = cursor
-                    await self._send_guarded(
-                        peer, protocol.encode_getmempool(cursor)
-                    )
+                    await self._request_mempool(peer, cursor)
         elif mtype is MsgType.GETACCOUNT:
             # Wallet/CLI query: consensus state at OUR tip plus the next
             # usable seq net of our pending pool (p1 tx auto-seq).
@@ -1341,9 +1602,7 @@ class Node:
             return  # duplicate push
         expected = self.chain.required_difficulty(header.prev_hash)
         if expected is None:
-            await self._send_guarded(
-                peer, protocol.encode_getblocks(self.chain.locator())
-            )
+            await self._request_blocks(peer)
             return
         if header.difficulty != expected or not meets_target(
             bhash, header.difficulty
@@ -1370,7 +1629,7 @@ class Node:
             )
             return
         self._pending_cblocks[(bhash, peer)] = _PendingCompact(
-            header, txs, want, cb.sent_ts
+            header, txs, want, cb.sent_ts, asked_at=time.monotonic()
         )
         while len(self._pending_cblocks) > MAX_PENDING_CBLOCKS:
             self._pending_cblocks.popitem(last=False)
@@ -1420,6 +1679,10 @@ class Node:
         # fresh, once, on first use (their full frame never arrived).
         res = self.chain.add_block(block)
         if res.status is AddStatus.ACCEPTED:
+            # Any accepted block is catch-up progress no matter who
+            # served it: the supervised sync's deadline and attempt
+            # budget reset (supervision.py — the honest-slow guarantee).
+            self._sync.progress()
             if sent_ts is not None:
                 # Push-gossip propagation delay (send -> accept), recorded
                 # only for blocks that actually connected: duplicates and
@@ -1455,9 +1718,7 @@ class Node:
                     self.metrics.cblocks_sent += n
                     self.metrics.cblock_bytes_saved += saved_per_peer * n
         elif res.status is AddStatus.ORPHAN and origin is not None:
-            await self._send_guarded(
-                origin, protocol.encode_getblocks(self.chain.locator())
-            )
+            await self._request_blocks(origin)
         elif res.status is AddStatus.REJECTED:
             self.metrics.blocks_rejected += 1
             log.warning(
@@ -1652,6 +1913,17 @@ class Node:
             "liveness": {
                 "pings_sent": self.metrics.pings_sent,
                 "peers_evicted_idle": self.metrics.peers_evicted_idle,
+            },
+            # Request supervision: sync-stall detection and failover
+            # (node/supervision.py) — how often catch-up was rescued
+            # from a non-serving peer.
+            "sync": {
+                "stalls": self.metrics.sync_stalls,
+                "failovers": self.metrics.sync_failovers,
+                "demotions": self.metrics.sync_demotions,
+                "exhausted": self.metrics.sync_exhausted,
+                "cblock_fetch_stalls": self.metrics.cblock_fetch_stalls,
+                "mempool_stalls": self.metrics.mempool_sync_stalls,
             },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly

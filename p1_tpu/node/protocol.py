@@ -21,6 +21,14 @@ Messages:
              is zero by construction (SURVEY §5 gossip round-trip timing).
 - TX:        one serialized transaction (push gossip).
 - GETBLOCKS: u16 count + count * 32-byte locator hashes (sync request).
+             Requester-side contract (not wire-visible): every
+             multi-round fetch — this, GETBLOCKTXN, paged GETMEMPOOL,
+             and the light client's GETHEADERS loop — runs under
+             request supervision (node/supervision.py): the requester
+             holds a *progress* deadline over the round and re-issues
+             to a different peer when nothing advances, so serving
+             slowly-but-surely is always safe while serving nothing
+             (however chattily) forfeits the sync to someone else.
 - BLOCKS:    u16 count + count * (u32 len + serialized block) (sync reply).
 - GETMEMPOOL: empty body (start of sync) or u64 fee + 32-byte txid — the
              stable cursor of the last transaction already received; the

@@ -1,0 +1,362 @@
+"""Fault-injecting hostile-peer harness: scripted bad network behavior.
+
+The liveness layer grew out of hand-rolled raw-socket attackers
+(tests/test_liveness.py) and the compact-relay hostile cases out of
+in-process fake peers (tests/test_compact.py) — each test rebuilding its
+own adversary.  This module is the reusable adversary: a ``HostilePeer``
+listens (or dials) like a real node, completes the HELLO exchange, serves
+a scripted chain — and injects exactly one family of delivery faults, per
+a declarative ``FaultPlan``:
+
+- **stall**: swallow chosen request types silently while answering PINGs,
+  staying comfortably under the liveness layer's bar (the sync-stall
+  attack supervision exists to beat);
+- **trickle**: deliver reply bytes at N bytes/s (the honest-slow peer —
+  the false-demotion control case);
+- **truncate**: send half of one reply frame, then wedge (mid-frame
+  stall: byte progress happened, the frame never completes);
+- **drop**: close the socket the instant a chosen request arrives;
+- **stale/empty replies**: syntactically perfect BLOCKS frames that never
+  advance the requester (the chatty-useless attack).
+
+Faults can be deferred (``serve_before_fault``) so a peer serves the
+first N rounds honestly and stalls *mid*-IBD.  The harness counts every
+request it sees (``requests``) so tests assert what the victim actually
+asked, not just what state it reached.
+
+Test infrastructure, not product: nothing in the node imports this.  It
+lives in the package (rather than tests/) so external integration rigs
+and future soak drivers can script delivery faults against real nodes
+without vendoring test helpers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import secrets
+import struct
+
+from p1_tpu.core.block import Block, merkle_root
+from p1_tpu.core.header import BlockHeader
+from p1_tpu.core.tx import Transaction
+from p1_tpu.node import protocol
+from p1_tpu.node.protocol import Hello, MsgType
+
+__all__ = ["FaultPlan", "HostilePeer", "make_blocks"]
+
+#: Request types whose replies the fault machinery can intercept — the
+#: multi-round fetches request supervision covers, exactly.
+_FAULTABLE = frozenset(
+    {
+        MsgType.GETBLOCKS,
+        MsgType.GETHEADERS,
+        MsgType.GETBLOCKTXN,
+        MsgType.GETMEMPOOL,
+    }
+)
+
+
+def make_blocks(
+    n: int,
+    difficulty: int = 12,
+    miner_id: str = "hostile",
+    txs_at: dict[int, tuple] | None = None,
+) -> list[Block]:
+    """Genesis plus ``n`` mined blocks at ``difficulty`` (fixed-rule
+    chain, cpu backend — a few ms per block at difficulty 12).  Each
+    block carries its height's coinbase plus any extra transactions from
+    ``txs_at[height]`` (the caller funds and signs those; validation on
+    the victim is the full consensus check, so they must be real)."""
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    miner = Miner(backend=get_backend("cpu"))
+    blocks = [make_genesis(difficulty)]
+    for height in range(1, n + 1):
+        parent = blocks[-1]
+        txs = (
+            Transaction.coinbase(miner_id, height),
+            *(txs_at or {}).get(height, ()),
+        )
+        draft = BlockHeader(
+            version=1,
+            prev_hash=parent.block_hash(),
+            merkle_root=merkle_root([tx.txid() for tx in txs]),
+            timestamp=parent.header.timestamp + 1,
+            difficulty=difficulty,
+            nonce=0,
+        )
+        sealed = miner.search_nonce(draft)
+        assert sealed is not None
+        blocks.append(Block(sealed, txs))
+    return blocks
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One scripted delivery pathology.  Default = a fully honest peer."""
+
+    #: Request types to swallow silently (the stall: liveness-visible,
+    #: progress-invisible).
+    swallow: frozenset = frozenset()
+    #: Close the socket the moment this request type arrives.
+    drop_at: MsgType | None = None
+    #: Answer this request type with HALF its reply frame, then wedge the
+    #: session (no further sends — the stream is desynced by design).
+    truncate_at: MsgType | None = None
+    #: Deliver reply bytes at this rate (None = full speed).  An honest
+    #: slow link, not an attack — the false-demotion control.
+    trickle_bps: float | None = None
+    #: Sleep this long before every reply (coarse honest-slow knob).
+    reply_delay_s: float = 0.0
+    #: Blocks (or headers) per sync reply — small values force many
+    #: rounds, exercising the per-round progress deadline.
+    batch_limit: int = 500
+    #: Serve this many faultable requests honestly BEFORE the configured
+    #: fault engages — stalls *mid*-IBD instead of at the first ask.
+    serve_before_fault: int = 0
+    #: Answer sync requests with zero-entry (yet well-formed) replies.
+    empty_replies: bool = False
+    #: Ignore the locator and re-serve the chain from genesis forever:
+    #: non-empty replies that stop advancing the requester after one
+    #: round (the stale-branch / chatty-useless attack).
+    stale_replies: bool = False
+    #: Keep answering keepalive probes (True = stay under the liveness
+    #: bar while any of the faults above starve the actual sync).
+    answer_pings: bool = True
+    #: Advertised HELLO tip height (None = the served chain's real tip).
+    #: 0 makes the victim skip the handshake-time sync ask — the
+    #: "connected but never triggered" second peer a failover discovers.
+    hello_height: int | None = None
+    #: MEMPOOL reply shape: the ``more`` flag on served pages.
+    mempool_more: bool = False
+
+
+class _Session:
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        #: True after a deliberate mid-frame truncation: any further
+        #: frame would desync the stream, so sends are suppressed.
+        self.wedged = False
+
+
+class HostilePeer:
+    """A scriptable peer serving ``blocks`` under a ``FaultPlan``.
+
+    Usage::
+
+        peer = HostilePeer(make_blocks(30), plan=FaultPlan(
+            swallow=frozenset({MsgType.GETBLOCKS})))
+        await peer.start()            # victim dials 127.0.0.1:peer.port
+        ...
+        assert peer.requests[MsgType.GETBLOCKS] >= 1
+        await peer.stop()
+
+    ``requests`` counts every decoded frame by type; ``sessions`` counts
+    connections accepted or dialed.  ``push`` sends a raw frame to every
+    live session (e.g. an unsolicited CBLOCK); ``dial`` connects OUT to
+    a victim, covering the inbound-attacker profiles of the liveness
+    tests with the same machinery.
+    """
+
+    def __init__(
+        self,
+        blocks: list[Block],
+        plan: FaultPlan = FaultPlan(),
+        mempool_txs: tuple = (),
+    ):
+        assert blocks, "need at least a genesis block"
+        self.blocks = list(blocks)
+        self.plan = plan
+        self.mempool_txs = tuple(mempool_txs)
+        self._pos = {b.block_hash(): i for i, b in enumerate(self.blocks)}
+        self.nonce = secrets.randbits(64) | 1
+        self.port: int | None = None
+        self.requests: collections.Counter = collections.Counter()
+        self.sessions = 0
+        self._server: asyncio.Server | None = None
+        self._tasks: set[asyncio.Task] = set()
+        self._live: set[_Session] = set()
+        self._fault_hits = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_conn, "127.0.0.1", 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        for sess in list(self._live):
+            sess.writer.close()
+        self._live.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def dial(self, host: str, port: int) -> None:
+        """Connect OUT to a victim (the inbound-attacker profile) and run
+        the same scripted session over that socket."""
+        reader, writer = await asyncio.open_connection(host, port)
+        task = asyncio.create_task(self._session(reader, writer))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _on_conn(self, reader, writer) -> None:
+        await self._session(reader, writer)
+
+    # -- the scripted session --------------------------------------------
+
+    def _hello(self) -> bytes:
+        height = (
+            self.plan.hello_height
+            if self.plan.hello_height is not None
+            else len(self.blocks) - 1
+        )
+        return protocol.encode_hello(
+            Hello(
+                self.blocks[0].block_hash(), height, self.port or 0, self.nonce
+            )
+        )
+
+    async def _session(self, reader, writer) -> None:
+        self.sessions += 1
+        sess = _Session(reader, writer)
+        self._live.add(sess)
+        try:
+            await self._send(sess, self._hello())
+            while True:
+                mtype, body = protocol.decode(
+                    await protocol.read_frame(reader)
+                )
+                self.requests[mtype] += 1
+                await self._handle(sess, mtype, body)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            ValueError,
+        ):
+            pass  # victim hung up (or stop() closed us) — session over
+        finally:
+            self._live.discard(sess)
+            writer.close()
+
+    async def _handle(self, sess: _Session, mtype: MsgType, body) -> None:
+        plan = self.plan
+        if mtype is MsgType.PING:
+            if plan.answer_pings:
+                await self._send(sess, protocol.encode_pong(body))
+            return
+        if mtype is MsgType.GETADDR:
+            await self._send(sess, protocol.encode_addr([]))
+            return
+        if mtype not in _FAULTABLE:
+            return  # pushes (BLOCK/TX/...) and late HELLOs: just counted
+        fault = self._fault_for(mtype)
+        if fault == "swallow":
+            return
+        if fault == "drop":
+            sess.writer.close()
+            return
+        payload = self._answer(mtype, body)
+        if payload is not None:
+            await self._send(sess, payload, fault=fault)
+
+    def _fault_for(self, mtype: MsgType) -> str | None:
+        plan = self.plan
+        if mtype in plan.swallow:
+            hit = "swallow"
+        elif plan.drop_at is mtype:
+            hit = "drop"
+        elif plan.truncate_at is mtype:
+            hit = "truncate"
+        else:
+            return None
+        self._fault_hits += 1
+        if self._fault_hits <= plan.serve_before_fault:
+            return None  # still in the honest prefix: stall mid-IBD later
+        return hit
+
+    def _after(self, locator: list[bytes]) -> list[Block]:
+        start = 0
+        if self.plan.stale_replies:
+            start = 1  # ignore the locator: re-serve from genesis forever
+        else:
+            for h in locator:
+                i = self._pos.get(h)
+                if i is not None:
+                    start = i + 1
+                    break
+        return self.blocks[start : start + self.plan.batch_limit]
+
+    def _answer(self, mtype: MsgType, body) -> bytes | None:
+        plan = self.plan
+        if mtype is MsgType.GETBLOCKS:
+            blocks = [] if plan.empty_replies else self._after(body)
+            return protocol.encode_blocks(blocks)
+        if mtype is MsgType.GETHEADERS:
+            blocks = [] if plan.empty_replies else self._after(body)
+            return protocol.encode_headers([b.header for b in blocks])
+        if mtype is MsgType.GETMEMPOOL:
+            raws = [tx.serialize() for tx in self.mempool_txs]
+            return protocol.encode_mempool(raws, more=plan.mempool_more)
+        if mtype is MsgType.GETBLOCKTXN:
+            bhash, indices = body
+            i = self._pos.get(bhash)
+            block = self.blocks[i] if i is not None else None
+            if block is None or indices[-1] >= len(block.txs):
+                return None
+            return protocol.encode_blocktxn(
+                bhash, [block.txs[j].serialize() for j in indices]
+            )
+        return None
+
+    # -- delivery --------------------------------------------------------
+
+    async def push(self, payload: bytes) -> int:
+        """Send one raw frame to every live session (unsolicited pushes:
+        CBLOCK, BLOCK, TX...).  Returns the number of sessions reached."""
+        n = 0
+        for sess in list(self._live):
+            try:
+                await self._send(sess, payload)
+                n += 1
+            except (ConnectionError, OSError):
+                pass
+        return n
+
+    async def _send(
+        self, sess: _Session, payload: bytes, fault: str | None = None
+    ) -> None:
+        if sess.wedged:
+            return
+        plan = self.plan
+        if plan.reply_delay_s:
+            await asyncio.sleep(plan.reply_delay_s)
+        frame = struct.pack(">I", len(payload)) + payload
+        if fault == "truncate":
+            sess.wedged = True
+            sess.writer.write(frame[: max(1, len(frame) // 2)])
+            await sess.writer.drain()
+            return
+        if plan.trickle_bps:
+            # ~20 writes/s at the configured byte rate.
+            chunk = max(1, int(plan.trickle_bps * 0.05))
+            for off in range(0, len(frame), chunk):
+                sess.writer.write(frame[off : off + chunk])
+                await sess.writer.drain()
+                await asyncio.sleep(0.05)
+            return
+        sess.writer.write(frame)
+        await sess.writer.drain()

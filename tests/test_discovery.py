@@ -474,6 +474,39 @@ class TestAddrBudgetPerHost:
 
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
 
+    def test_overflow_prune_keeps_granted_solicited_credit(self, monkeypatch):
+        """ADVICE r5 regression: the MAX_TRACKED_HOSTS overflow prune
+        kept only buckets `fresh AND below the base cap`, which dropped
+        exactly the buckets holding ABOVE-cap solicited-reply credit —
+        so an address-cycling flood arriving right after our own GETADDR
+        grant could reset an outbound peer's budget mid-reply and
+        silently ignore part of an ADDR answer we asked for.  The prune
+        must drop only stale buckets sitting at exactly the base refill
+        (provably stateless) and keep grant credit intact."""
+        from p1_tpu.node import node as node_mod
+
+        monkeypatch.setattr(node_mod, "MAX_TRACKED_HOSTS", 4)
+        n = Node(_config())
+        # An outbound peer we just solicited: grant stacks a reply's
+        # credit on top of the base bucket (above the cap).
+        n._addr_budget("10.9.0.1")
+        n._addr_budget("10.9.0.1", grant=True)
+        granted = n._addr_budgets["10.9.0.1"][0]
+        assert granted > node_mod.ADDR_TOKENS_MAX
+        # Stale, untouched buckets — the prunable kind.
+        import time as _time
+
+        for i in range(3):
+            n._addr_budget(f"10.9.1.{i}")
+            n._addr_budgets[f"10.9.1.{i}"][1] = _time.monotonic() - 1e4
+        # A new host pushes the table past the cap and triggers the prune.
+        n._addr_budget("10.9.2.99")
+        assert "10.9.0.1" in n._addr_budgets, "granted bucket was pruned"
+        assert n._addr_budgets["10.9.0.1"][0] == granted
+        assert all(
+            f"10.9.1.{i}" not in n._addr_budgets for i in range(3)
+        ), "stale base-cap buckets should be the ones dropped"
+
     def test_tried_survives_one_failed_dial_as_rumor(self):
         """A tried (handshake-verified) address whose node is briefly
         down is demoted to the gossip book on a failed dial — not erased,

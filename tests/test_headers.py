@@ -261,19 +261,36 @@ class TestLightClientSync:
 class TestReplayFastFallback:
     def test_falls_back_to_host_without_toolchain(self, monkeypatch):
         """replay_fast must keep working on machines that cannot build
-        the C++ engine — the host oracle serves, same verdicts."""
+        the C++ engine — the host oracle serves, same verdicts.  The
+        environment check is the separate load PROBE (ADVICE r5), so
+        that is what a toolchain-less host is simulated through."""
         from p1_tpu.chain import generate_headers, replay_fast
         from p1_tpu.chain import replay as replay_mod
         from p1_tpu.hashx.native_build import NativeBuildError
 
         headers = generate_headers(8, 8)
 
-        def no_native(*a, **k):
+        def no_native():
             raise NativeBuildError("no compiler on this host")
 
-        monkeypatch.setattr(replay_mod, "replay_native", no_native)
+        monkeypatch.setattr(replay_mod, "_probe_native", no_native)
         report = replay_fast(headers)
         assert report.valid and report.method == "host"
+
+    def test_wrapper_bug_surfaces_instead_of_degrading(self, monkeypatch):
+        """The ADVICE r5 regression: a genuine bug past the load probe
+        (here: an AttributeError inside replay_native itself) must crash
+        loudly, not silently demote every light-client verification to
+        the host path for the life of the process."""
+        from p1_tpu.chain import generate_headers, replay_fast
+        from p1_tpu.chain import replay as replay_mod
+
+        def buggy_native(*a, **k):
+            raise AttributeError("wrapper typo: no such attribute")
+
+        monkeypatch.setattr(replay_mod, "replay_native", buggy_native)
+        with pytest.raises(AttributeError):
+            replay_fast(generate_headers(8, 8))
 
     def test_prefers_native_when_available(self):
         from p1_tpu.chain import generate_headers, replay_fast
