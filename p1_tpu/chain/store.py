@@ -7,14 +7,35 @@ and orphan handling included — so a corrupt or truncated tail degrades to
 "resume from the last good block" rather than a poisoned index.  Records
 keep insertion order, which preserves first-seen tie-breaks and means
 side branches survive restarts too.
+
+Round 7 — the durability layer.  v3 framing carries a CRC32 trailer per
+record (over the length prefix AND the payload), which splits on-disk
+damage into two cases the recovery paths treat differently:
+
+- **torn tail** — the file ends inside a record (crash mid-append).  The
+  expected crash artifact: the partial record is silently truncated
+  under the writer lock, exactly as before.
+- **mid-log corruption** — a record whose bytes are all present but fail
+  their checksum (bit-rot, a flipped length prefix, a bad sector).
+  Pre-v3 framing could not tell this from a torn tail, so one flipped
+  bit in a mid-log length prefix silently truncated every good record
+  behind it.  v3 *resyncs*: scan forward for the next checksum-valid
+  record boundary, quarantine the bad span to a ``.quarantine`` sidecar,
+  keep everything else, and surface counts (``ChainStore.healed``).
+
+v2 stores (``P1TPUCH2``) stay readable — every read path accepts both
+framings — but a writer refuses them with an upgrade hint (``p1 fsck``
+or ``p1 compact`` rewrite the log as v3).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import fcntl
 import io
 import os
 import struct
+import zlib
 from pathlib import Path
 from typing import Iterator
 
@@ -23,12 +44,60 @@ from p1_tpu.core.block import Block
 from p1_tpu.core.header import HEADER_SIZE
 
 _LEN = struct.Struct(">I")
+_CRC = struct.Struct(">I")
+#: Quarantine sidecar entry header: original byte offset (u64) + span
+#: length (u32), followed by the raw quarantined bytes.  Append-only, so
+#: repeated heals accumulate evidence instead of overwriting it.
+_QREC = struct.Struct(">QI")
 #: Format tag, versioned with the RECORD layout, not just the framing:
-#: round 4 extended the transaction wire format (Ed25519 pubkey + sig
-#: fields), so "2" refuses round-3 stores with a clean message instead of
-#: crashing mid-parse with a raw "truncated transaction".
-MAGIC = b"P1TPUCH2"
+#: "3" adds the per-record CRC32 trailer (corruption containment); "2"
+#: extended the transaction wire format (Ed25519 fields) over round 3's
+#: original layout.  Older tags are refused with a clean message instead
+#: of crashing mid-parse with a raw "truncated transaction".
+MAGIC = b"P1TPUCH3"
+V2_MAGIC = b"P1TPUCH2"
 _OLD_MAGICS = (b"P1TPUCHN",)
+
+
+def fsync_dir(path: str | os.PathLike) -> None:
+    """fsync a DIRECTORY, making a just-created or just-renamed entry
+    durable: on journaling filesystems the rename/create lives in the
+    directory's metadata, and a crash after the data fsync but before
+    the metadata journal commits can lose the entry — the file's bytes
+    were safe, the *name* wasn't."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+@dataclasses.dataclass
+class StoreScan:
+    """One framing walk's verdict over a store's raw bytes."""
+
+    #: Record-layout version the magic declared (2 = pre-checksum).
+    version: int
+    #: (payload offset, payload length) of every checksum-valid record
+    #: (v2: every whole record — no checksums to check), in file order.
+    spans: list[tuple[int, int]]
+    #: [start, end) byte ranges that fail their checksum but are fully
+    #: present — mid-log corruption, quarantinable.  Always empty for v2
+    #: (undetectable without checksums: pre-v3 behavior was truncation).
+    bad_spans: list[tuple[int, int]]
+    #: Offset where an INCOMPLETE trailing record starts (crash
+    #: mid-append), or None.  Truncated by the writer, never quarantined.
+    torn_tail: int | None
+    #: Total file size scanned.
+    size: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.bad_spans and self.torn_tail is None
+
+    @property
+    def quarantined_bytes(self) -> int:
+        return sum(e - s for s, e in self.bad_spans)
 
 
 class ChainStore:
@@ -41,53 +110,145 @@ class ChainStore:
     on this VM's fs vs ≥120 ms blocks; see docs/PERF.md).  ``fsync=False`` keeps only the
     process-crash guarantee (the flush + torn-tail truncation story) for
     workloads that prefer raw append throughput, e.g. bulk ``save_chain``
-    snapshots, which are re-derivable."""
+    snapshots, which are re-derivable.
+
+    The file layer is routed through four overridable seams
+    (``_open_fh``/``_fsync_file``/``_fsync_dir``/``_read_bytes``) so the
+    fault-injection harness (``chain/testing.py`` ``FaultStore``) can
+    script disk pathologies without monkeypatching."""
 
     def __init__(self, path: str | os.PathLike, fsync: bool = True):
         self.path = Path(path)
         self.fsync = fsync
         self._fh: io.BufferedWriter | None = None
+        #: The pre-heal scan ``acquire`` ran (None until then) and what
+        #: the heal did about it — surfaced by ``Node.status()["storage"]``
+        #: and ``p1 fsck``.
+        self.last_scan: StoreScan | None = None
+        self.healed = {
+            "quarantined_records": 0,
+            "quarantined_bytes": 0,
+            "truncated_bytes": 0,
+        }
 
-    def acquire(self) -> None:
+    # -- file-layer seams (FaultStore overrides these) --------------------
+
+    def _open_fh(self):
+        return open(self.path, "a+b")  # "a": every write appends
+
+    def _fsync_file(self, fh) -> None:
+        os.fsync(fh.fileno())
+
+    def _fsync_dir(self) -> None:
+        fsync_dir(self.path.parent)
+
+    def _read_bytes(self) -> bytes:
+        return self.path.read_bytes()
+
+    # -- writer lifecycle -------------------------------------------------
+
+    def acquire(self, allow_v2: bool = False, heal: bool = True) -> None:
         """Open + exclusively lock the store for this writer's lifetime
         (idempotent; released by ``close``).  Raises RuntimeError when
         another process holds the lock — two nodes appending to one store,
         or a compaction racing a live node, would corrupt or silently
         orphan records.
 
-        Lock ordering matters: the torn-tail truncation runs strictly
-        UNDER the lock, so a refused second writer can never truncate a
-        live writer's in-flight record on its way to the refusal.
-        """
+        Lock ordering matters: the torn-tail truncation and the
+        corruption heal run strictly UNDER the lock, so a refused second
+        writer can never mutate a live writer's in-flight record on its
+        way to the refusal.
+
+        ``allow_v2`` admits a pre-checksum v2 store (read/maintenance
+        tooling: ``p1 compact`` / ``p1 fsck`` lock before rewriting);
+        plain writers refuse v2 with an upgrade hint — appending
+        unchecksummed records forever would defeat the containment.
+        ``heal=False`` locks and scans without mutating (``p1 fsck``'s
+        report pass owns its own salvage decisions)."""
         if self._fh is not None:
             return
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        fh = open(self.path, "a+b")  # "a": every write appends
-        try:
-            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError as e:
-            fh.close()
-            raise RuntimeError(
-                f"{self.path} is locked by another process (a running node?)"
-            ) from e
-        try:
-            if self.path.stat().st_size == 0:
-                fh.write(MAGIC)
-                fh.flush()
-            else:
-                # Drop any truncated tail record (crash mid-append) before
-                # writing behind it, or its stale length prefix would point
-                # into the new records and corrupt the whole log.
-                good_end = self._scan_good_end(self.path.read_bytes())
-                if good_end < self.path.stat().st_size:
-                    os.truncate(self.path, good_end)
-        except ValueError as e:
-            # e.g. "not a chain store": release the lock + handle instead
-            # of leaking an exclusively-flocked fd, and surface the same
-            # clean error type as the lock conflict.
-            fh.close()
-            raise RuntimeError(str(e)) from e
+        # At most one rebuild round: the first pass may replace the file
+        # (quarantine heal), after which the fresh inode is re-locked and
+        # re-verified; a clean store locks on the first pass.
+        for attempt in (0, 1):
+            fh = self._open_fh()
+            try:
+                fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError as e:
+                fh.close()
+                raise RuntimeError(
+                    f"{self.path} is locked by another process (a running node?)"
+                ) from e
+            try:
+                if self.path.stat().st_size == 0:
+                    fh.write(MAGIC)
+                    fh.flush()
+                    break
+                data = self._read_bytes()
+                scan = self.scan(data)
+                self.last_scan = scan
+                if scan.version == 2 and not allow_v2:
+                    raise ValueError(
+                        f"{self.path}: v2 chain store (records carry no "
+                        "checksums) — run `p1 fsck` or `p1 compact` to "
+                        "upgrade before writing"
+                    )
+                if not heal or scan.clean:
+                    break
+                if scan.bad_spans and attempt == 0:
+                    # Mid-log corruption: quarantine + rebuild replaces
+                    # the inode, so loop to re-lock and re-verify it.
+                    self._heal_rebuild(data, scan)
+                    fh.close()
+                    continue
+                if scan.torn_tail is not None:
+                    # Drop the truncated tail record (crash mid-append)
+                    # before writing behind it, or its stale length
+                    # prefix would point into the new records and corrupt
+                    # the whole log.
+                    self.healed["truncated_bytes"] += len(data) - scan.torn_tail
+                    os.truncate(self.path, scan.torn_tail)
+                break
+            except ValueError as e:
+                # e.g. "not a chain store": release the lock + handle
+                # instead of leaking an exclusively-flocked fd, and
+                # surface the same clean error type as the lock conflict.
+                fh.close()
+                raise RuntimeError(str(e)) from e
         self._fh = fh
+
+    def _heal_rebuild(self, data: bytes, scan: StoreScan) -> None:
+        """Quarantine ``scan.bad_spans`` to the sidecar, then atomically
+        rewrite the store as magic + every valid record (and NO torn
+        tail).  Sidecar first, fsynced: the evidence must be durable
+        before the original bytes stop existing.  The rebuild goes
+        through tmp + rename + directory fsync, so a crash at any point
+        leaves either the old corrupt file (re-healed next start) or the
+        complete new one — never a half-rebuilt log."""
+        qpath = self.quarantine_path()
+        with open(qpath, "ab") as qf:
+            for s, e in scan.bad_spans:
+                qf.write(_QREC.pack(s, e - s))
+                qf.write(data[s:e])
+            qf.flush()
+            os.fsync(qf.fileno())
+        tmp = self.path.with_name(f"{self.path.name}.heal.{os.getpid()}")
+        with open(tmp, "wb") as out:
+            out.write(MAGIC)
+            for off, n in scan.spans:
+                out.write(data[off - _LEN.size : off + n + _CRC.size])
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, self.path)
+        self._fsync_dir()
+        self.healed["quarantined_records"] += len(scan.bad_spans)
+        self.healed["quarantined_bytes"] += scan.quarantined_bytes
+        if scan.torn_tail is not None:
+            self.healed["truncated_bytes"] += scan.size - scan.torn_tail
+
+    def quarantine_path(self) -> Path:
+        return self.path.with_name(self.path.name + ".quarantine")
 
     def append(self, block: Block) -> None:
         self.acquire()
@@ -95,11 +256,14 @@ class ChainStore:
         # off the wire these are the exact gossip bytes — ingest appends
         # with zero re-packing (docs/PERF.md "host ingest plane").
         raw = block.serialize()
-        self._fh.write(_LEN.pack(len(raw)))
-        self._fh.write(raw)
+        prefix = _LEN.pack(len(raw))
+        crc = zlib.crc32(raw, zlib.crc32(prefix))
+        # One write per record: a torn append (crash, ENOSPC mid-write)
+        # can tear at most THIS record, never desync an earlier one.
+        self._fh.write(prefix + raw + _CRC.pack(crc))
         self._fh.flush()
         if self.fsync:
-            os.fsync(self._fh.fileno())
+            self._fsync_file(self._fh)
 
     def sync(self) -> None:
         """Flush + fsync now — the batch closer for callers that toggle
@@ -109,12 +273,19 @@ class ChainStore:
         eats the window)."""
         if self._fh is not None:
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            self._fsync_file(self._fh)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- the framing walk -------------------------------------------------
 
     @staticmethod
     def _check_magic(data: bytes, label: str = "") -> None:
         prefix = f"{label} " if label else ""
-        if not data.startswith(MAGIC):
+        if not data.startswith(MAGIC) and not data.startswith(V2_MAGIC):
             if any(data.startswith(m) for m in _OLD_MAGICS):
                 raise ValueError(
                     f"{prefix}written by an older p1-tpu version "
@@ -123,40 +294,105 @@ class ChainStore:
             raise ValueError(f"{prefix}not a chain store")
 
     @staticmethod
-    def _record_spans(data: bytes) -> Iterator[tuple[int, int]]:
-        """(offset, length) of every whole record's block bytes — the ONE
-        walk of the framing, shared by the tail scan, the batch parse,
-        and the packed-header extraction, so the three can't drift.
-        Stops cleanly at a truncated tail."""
-        off = len(MAGIC)
-        while off + _LEN.size <= len(data):
-            (n,) = _LEN.unpack_from(data, off)
-            if off + _LEN.size + n > len(data):
-                break
-            yield off + _LEN.size, n
-            off += _LEN.size + n
-
-    @classmethod
-    def _scan_good_end(cls, data: bytes) -> int:
-        """Byte offset just past the last whole record."""
-        cls._check_magic(data)
-        end = len(MAGIC)
-        for off, n in cls._record_spans(data):
-            end = off + n
+    def _v3_record_at(data: bytes, off: int) -> int | None:
+        """End offset of a checksum-valid v3 record starting at ``off``,
+        or None (incomplete frame / checksum mismatch)."""
+        if off + _LEN.size + _CRC.size > len(data):
+            return None
+        (n,) = _LEN.unpack_from(data, off)
+        end = off + _LEN.size + n + _CRC.size
+        if end > len(data):
+            return None
+        body_end = end - _CRC.size
+        if zlib.crc32(data[off:body_end]) != _CRC.unpack_from(data, body_end)[0]:
+            return None
         return end
 
-    def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+    @classmethod
+    def _resync(cls, data: bytes, start: int) -> int | None:
+        """First offset >= ``start`` where a checksum-valid record begins
+        — how the scan recovers framing past a corrupt span.  A false
+        positive needs a 32-bit CRC collision at a byte offset whose
+        length field also happens to land exactly inside the file
+        (~2^-32 per candidate): negligible against whole-log loss."""
+        for cand in range(start, len(data) - (_LEN.size + _CRC.size) + 1):
+            if cls._v3_record_at(data, cand) is not None:
+                return cand
+        return None
+
+    @classmethod
+    def scan(cls, data: bytes) -> StoreScan:
+        """The ONE walk of the framing, shared by the writer's heal, the
+        batch parse, the packed-header extraction, and ``p1 fsck`` — so
+        none of them can drift."""
+        cls._check_magic(data)
+        if data.startswith(V2_MAGIC):
+            # Pre-checksum framing: whole records up to the first one the
+            # file ends inside.  Corruption is UNDETECTABLE here (that is
+            # what v3 fixes); a bad length prefix reads as a torn tail.
+            spans: list[tuple[int, int]] = []
+            off = len(V2_MAGIC)
+            while off + _LEN.size <= len(data):
+                (n,) = _LEN.unpack_from(data, off)
+                if off + _LEN.size + n > len(data):
+                    break
+                spans.append((off + _LEN.size, n))
+                off += _LEN.size + n
+            return StoreScan(
+                version=2,
+                spans=spans,
+                bad_spans=[],
+                torn_tail=off if off < len(data) else None,
+                size=len(data),
+            )
+        spans = []
+        bad: list[tuple[int, int]] = []
+        torn: int | None = None
+        off = len(MAGIC)
+        while off < len(data):
+            end = cls._v3_record_at(data, off)
+            if end is not None:
+                spans.append((off + _LEN.size, end - off - _LEN.size - _CRC.size))
+                off = end
+                continue
+            nxt = cls._resync(data, off + 1)
+            if nxt is not None:
+                bad.append((off, nxt))
+                off = nxt
+                continue
+            # Nothing checksum-valid ahead.  A fully-present frame that
+            # failed its CRC is trailing corruption (quarantinable);
+            # anything the file ends inside is a torn tail.
+            if off + _LEN.size <= len(data):
+                (n,) = _LEN.unpack_from(data, off)
+                end = off + _LEN.size + n + _CRC.size
+                if end <= len(data):
+                    bad.append((off, end))
+                    if end < len(data):
+                        torn = end
+                    break
+            torn = off
+            break
+        return StoreScan(
+            version=3, spans=spans, bad_spans=bad, torn_tail=torn, size=len(data)
+        )
+
+    @classmethod
+    def _record_spans(cls, data: bytes) -> Iterator[tuple[int, int]]:
+        """(offset, length) of every checksum-valid record's block bytes.
+        Skips quarantinable spans and stops cleanly at a torn tail."""
+        yield from cls.scan(data).spans
+
+    # -- readers ----------------------------------------------------------
 
     def _read_checked(self) -> bytes:
-        data = self.path.read_bytes()
+        data = self._read_bytes()
         self._check_magic(data, str(self.path))
         return data
 
     def load_blocks(self) -> list[Block]:
-        """All decodable records, stopping cleanly at a truncated tail.
+        """All decodable records: checksum-valid (v3), stopping cleanly at
+        a truncated tail, SKIPPING — not trusting — corrupt spans.
 
         Batch parse on the packed-bytes plane: each ``Block.deserialize``
         seeds the block's (and its header's and transactions') encoding
@@ -208,11 +444,12 @@ class ChainStore:
         above all — are skipped while the contextual rules and the
         connect-time ledger still rebuild identical state (measured ~3x
         end-to-end at 100k blocks — 4.6 s vs 14.0 s, docs/PERF.md;
-        equivalence is tested).  The cost:
-        on-disk bit-rot inside a record body goes undetected until it
-        disagrees with the network — ``p1 node --revalidate-store`` is
-        the remedy when disk integrity is in question (header-only
-        tools like ``p1 replay`` check PoW/linkage, not bodies).
+        equivalence is tested).  The v3 record checksum bounds what
+        trust costs: bit-rot inside a record body now fails the CRC and
+        the record is quarantined at ``acquire`` rather than trusted
+        through — ``p1 node --revalidate-store`` remains the remedy for
+        corruption *with* a fixed-up checksum (i.e. a hostile editor,
+        not a disk).
 
         Raises ValueError when records exist but NONE connect — that is a
         store from a chain with different parameters (wrong difficulty /
@@ -245,22 +482,30 @@ class ChainStore:
         return chain
 
 
-def save_chain(chain: Chain, path: str | os.PathLike) -> None:
+def save_chain(
+    chain: Chain, path: str | os.PathLike, store_cls: type[ChainStore] = ChainStore
+) -> None:
     """Snapshot a chain's main branch to a fresh store (tooling aid; nodes
     normally append incrementally as blocks arrive).  The snapshot is
     LINEAR by construction — genesis-first main branch — so its
     ``packed_headers`` buffer verifies in one native call
     (``replay_packed``), which is how ``p1 compact`` proves a snapshot
-    before replacing the original log."""
+    before replacing the original log.
+
+    Durability: one data fsync at the end (bulk snapshot; the source
+    chain still exists in memory if the write is lost), then a PARENT
+    DIRECTORY fsync — a freshly created file whose directory entry only
+    lives in an uncommitted metadata journal vanishes wholesale on power
+    loss, data fsync or not.  ``store_cls`` is the fault-injection seam
+    (tests pass ``FaultStore``)."""
     p = Path(path)
     if p.exists():
         p.unlink()
-    # Bulk snapshot: one fsync at the end (via close -> OS) is enough; the
-    # source chain still exists in memory if the write is lost.
-    store = ChainStore(p, fsync=False)
+    store = store_cls(p, fsync=False)
     try:
         for block in chain.main_chain():
             store.append(block)
-        os.fsync(store._fh.fileno())
+        store.sync()
+        store._fsync_dir()
     finally:
         store.close()

@@ -157,6 +157,14 @@ def build_parser() -> argparse.ArgumentParser:
         "over the stored chain at boot instead of the trusted fast "
         "resume (the store is this node's own validated, flocked log)",
     )
+    p.add_argument(
+        "--store-degraded-exit",
+        action="store_true",
+        help="exit (code 4) on the first store write failure instead of "
+        "the default degraded serve-only mode (which keeps answering "
+        "headers/blocks/proof queries while retrying the disk with "
+        "backoff) — for operators who prefer a supervisor restart",
+    )
     p.add_argument("--duration", type=float, default=None, help="exit after N s")
     p.add_argument(
         "--deadline",
@@ -416,6 +424,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="write here instead of replacing the store in place",
     )
     _add_retarget(p)
+
+    p = sub.add_parser(
+        "fsck",
+        help="scan a chain store offline: report per-record integrity and "
+        "salvage every checksum-valid record into a fresh verified store "
+        "(also upgrades v2 stores to the checksummed v3 framing); exit 0 "
+        "= clean, 1 = salvaged with losses, 2 = unrecoverable",
+    )
+    p.add_argument("--store", required=True, help="chain persistence path")
+    p.add_argument(
+        "--out",
+        default=None,
+        help="write the salvaged store here instead of replacing in place",
+    )
 
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
@@ -714,9 +736,15 @@ async def _run_node(args, miner=None) -> int:
         sync_stall_timeout_s=getattr(args, "sync_stall_timeout", 10.0),
         sync_attempts_max=getattr(args, "sync_attempts", 8),
         revalidate_store=getattr(args, "revalidate_store", False),
+        store_degraded_exit=getattr(args, "store_degraded_exit", False),
     )
     node = Node(config, miner=miner)
     await node.start()
+    # --store-degraded-exit watch: the node signals instead of exiting
+    # itself so teardown (final status line, mempool save, store close)
+    # still runs through the one path below.  Exit code 4.
+    fatal = asyncio.ensure_future(node.store_fatal.wait())
+    rc = 0
     try:
         if args.deadline is not None or args.duration is not None:
             if args.deadline == "stdin":
@@ -730,34 +758,45 @@ async def _run_node(args, miner=None) -> int:
                 deadline = time.time() + args.duration
             window = max(0.0, deadline - time.time())
             logging.info("mining window: %.2fs until deadline", window)
-            await asyncio.sleep(window)
-            # Quiesce: stop producing, then wait for the gossip backlog to
-            # drain (GIL-bound mining starves the event loop, so a fixed
-            # sleep can undershoot): exit once the chain has been stable
-            # for a full second, or after 20s regardless.
-            await node.stop_mining()
-            await node.request_sync()
-            t_end = time.monotonic() + 20.0
-            stable = (node.chain.tip_hash, node.metrics.blocks_accepted)
-            stable_since = time.monotonic()
-            while time.monotonic() < t_end:
-                await asyncio.sleep(0.1)
-                now_state = (node.chain.tip_hash, node.metrics.blocks_accepted)
-                if now_state != stable:
-                    stable, stable_since = now_state, time.monotonic()
-                    await node.request_sync()
-                elif time.monotonic() - stable_since >= 1.0:
-                    break
+            await asyncio.wait({fatal}, timeout=window)
+            if fatal.done():
+                rc = 4
+            else:
+                # Quiesce: stop producing, then wait for the gossip
+                # backlog to drain (GIL-bound mining starves the event
+                # loop, so a fixed sleep can undershoot): exit once the
+                # chain has been stable for a full second, or after 20s
+                # regardless.
+                await node.stop_mining()
+                await node.request_sync()
+                t_end = time.monotonic() + 20.0
+                stable = (node.chain.tip_hash, node.metrics.blocks_accepted)
+                stable_since = time.monotonic()
+                while time.monotonic() < t_end:
+                    await asyncio.sleep(0.1)
+                    now_state = (
+                        node.chain.tip_hash,
+                        node.metrics.blocks_accepted,
+                    )
+                    if now_state != stable:
+                        stable, stable_since = now_state, time.monotonic()
+                        await node.request_sync()
+                    elif time.monotonic() - stable_since >= 1.0:
+                        break
         else:
             while True:
-                await asyncio.sleep(args.status_interval)
+                await asyncio.wait({fatal}, timeout=args.status_interval)
+                if fatal.done():
+                    rc = 4
+                    break
                 print(json.dumps(node.status()), flush=True)
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        fatal.cancel()
         print(json.dumps(node.status()), flush=True)
         await node.stop()
-    return 0
+    return rc
 
 
 def cmd_node(args) -> int:
@@ -1460,7 +1499,9 @@ def cmd_compact(args) -> int:
     src = ChainStore(args.store)
     try:
         try:
-            src.acquire()
+            # allow_v2: compaction IS the upgrade path for pre-checksum
+            # stores (the snapshot below is written in v3 framing).
+            src.acquire(allow_v2=True)
         except RuntimeError as e:
             print(f"{e} — stop it before compacting", file=sys.stderr)
             return 2
@@ -1519,6 +1560,12 @@ def cmd_compact(args) -> int:
                 )
                 return 3
             os.replace(tmp, out)
+            # The rename itself must survive a metadata-journal loss:
+            # save_chain fsynced the tmp's data and directory entry, but
+            # the replace is a second directory mutation.
+            from p1_tpu.chain.store import fsync_dir
+
+            fsync_dir(os.path.dirname(os.path.abspath(out)))
         finally:
             if dst is not None:
                 dst.close()
@@ -1538,6 +1585,142 @@ def cmd_compact(args) -> int:
         )
     )
     return 0
+
+
+# -- fsck ----------------------------------------------------------------
+
+
+def cmd_fsck(args) -> int:
+    """Offline store integrity scan + salvage (the disk counterpart of
+    Bitcoin's -checkblocks/salvagewallet tooling).  Exit contract:
+
+    - **0 clean** — every record checksum-valid, nothing rewritten (a
+      lossless v2→v3 upgrade also exits 0: no information was lost);
+    - **1 salvaged** — corruption or a torn tail was found; every
+      checksum-valid record was rewritten into a fresh verified store,
+      bad spans quarantined to the ``.quarantine`` sidecar;
+    - **2 unrecoverable** — missing/empty/locked store, unrecognizable
+      magic, or zero salvageable records.
+
+    Unlike ``p1 compact`` this preserves insertion order and side
+    branches (it salvages the LOG, not the main branch), so the
+    self-check is framing-level — every salvaged record re-reads
+    checksum-valid and byte-identical — rather than the linear-chain
+    ``replay_packed`` proof compaction can afford."""
+    import os
+
+    from p1_tpu.chain import ChainStore
+    from p1_tpu.chain.store import fsync_dir
+    from p1_tpu.core.block import Block
+
+    if not os.path.exists(args.store) or os.path.getsize(args.store) == 0:
+        print(f"{args.store}: empty or missing chain store", file=sys.stderr)
+        return 2
+    store = ChainStore(args.store)
+    try:
+        try:
+            # Lock first (a live node's in-flight appends must not race
+            # the rewrite), scan without healing: fsck owns the salvage
+            # decision and must report BEFORE mutating.
+            store.acquire(allow_v2=True, heal=False)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        data = store._read_bytes()
+        scan = store.scan(data)
+        report = {
+            "config": "fsck",
+            "store": args.store,
+            "version": scan.version,
+            "records_valid": len(scan.spans),
+            "bad_spans": len(scan.bad_spans),
+            "bytes_quarantined": scan.quarantined_bytes,
+            "torn_tail_bytes": (
+                scan.size - scan.torn_tail if scan.torn_tail is not None else 0
+            ),
+        }
+        if scan.version == 3 and scan.clean:
+            print(json.dumps({**report, "status": "clean"}))
+            return 0
+
+        # Salvage: every checksum-valid record that still parses as a
+        # block, in original insertion order, into a fresh v3 store.
+        blocks, parse_failures = [], 0
+        for off, n in scan.spans:
+            try:
+                blocks.append(Block.deserialize(data[off : off + n]))
+            except ValueError:
+                parse_failures += 1
+        report["parse_failures"] = parse_failures
+        if not blocks:
+            print(
+                json.dumps({**report, "status": "unrecoverable"}),
+            )
+            print(
+                f"{args.store}: no salvageable records", file=sys.stderr
+            )
+            return 2
+        if scan.bad_spans:
+            # Evidence first, durably, before the original bytes go away.
+            qpath = store.quarantine_path()
+            import struct as _struct
+
+            with open(qpath, "ab") as qf:
+                for s, e in scan.bad_spans:
+                    qf.write(_struct.pack(">QI", s, e - s))
+                    qf.write(data[s:e])
+                qf.flush()
+                os.fsync(qf.fileno())
+            report["quarantine"] = str(qpath)
+        out = args.out or args.store
+        tmp = f"{out}.fsck.{os.getpid()}"
+        dst = ChainStore(tmp, fsync=False)
+        try:
+            for block in blocks:
+                dst.append(block)
+            dst.sync()
+            dst._fsync_dir()
+        finally:
+            dst.close()
+        # Self-check BEFORE the replace: the fresh store must re-scan
+        # clean with every record byte-identical to what was salvaged —
+        # a miswritten salvage must never clobber the evidence.
+        vdata = ChainStore(tmp)._read_checked()
+        vscan = ChainStore.scan(vdata)
+        ok = (
+            vscan.version == 3
+            and vscan.clean
+            and len(vscan.spans) == len(blocks)
+            and all(
+                vdata[off : off + n] == block.serialize()
+                for (off, n), block in zip(vscan.spans, blocks)
+            )
+        )
+        if not ok:
+            os.unlink(tmp)
+            print(
+                "salvage self-check failed — original store left untouched",
+                file=sys.stderr,
+            )
+            return 2
+        os.replace(tmp, out)
+        fsync_dir(os.path.dirname(os.path.abspath(out)))
+        lossless = (
+            not scan.bad_spans
+            and scan.torn_tail is None
+            and not parse_failures
+        )
+        report.update(
+            {
+                "records_salvaged": len(blocks),
+                "out": out,
+                "status": "upgraded" if lossless else "salvaged",
+            }
+        )
+        print(json.dumps(report))
+        return 0 if lossless else 1
+    finally:
+        store.close()
 
 
 # -- net -----------------------------------------------------------------
@@ -2096,6 +2279,7 @@ def main(argv=None) -> int:
         "headers": cmd_headers,
         "balances": cmd_balances,
         "compact": cmd_compact,
+        "fsck": cmd_fsck,
         "pod": cmd_pod,
         "net": cmd_net,
         "bench": cmd_bench,

@@ -70,6 +70,12 @@ class NodeConfig:
     sync_attempts_max: int = 8
     sync_backoff_base_s: float = 0.25
     sync_backoff_max_s: float = 5.0
+    #: Escape hatch for the storage durability layer: by default a store
+    #: write failure (ENOSPC, EIO, fsync error) degrades the node into a
+    #: serve-only mode that retries the disk with backoff and recovers
+    #: in place; with this set the node signals fatal instead (the CLI
+    #: exits 4) for operators who prefer a supervisor restart.
+    store_degraded_exit: bool = False
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

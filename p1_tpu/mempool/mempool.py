@@ -69,13 +69,27 @@ def dump_mempool(rows: list[tuple[Transaction, float]]) -> bytes:
 
 
 def write_mempool_file(data: bytes, path) -> None:
-    """Atomic tmp+replace write (like the address book — never torn)."""
+    """Atomic tmp+replace write (like the address book — never torn),
+    DURABLE both sides of the rename: the tmp's data is fsynced before
+    ``replace`` publishes it (or a power cut could commit the rename's
+    metadata while the data pages were still dirty — a complete rename
+    pointing at an empty/torn file), and the directory is fsynced after,
+    so the rename itself survives a metadata-journal loss."""
+    import os
     import pathlib
 
     path = pathlib.Path(path)
     tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_bytes(data)
-    tmp.replace(path)
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(path.parent, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def save_mempool(pool: "Mempool", path) -> int:

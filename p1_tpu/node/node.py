@@ -27,7 +27,7 @@ import logging
 import time
 from pathlib import Path
 
-from p1_tpu.chain import AddStatus, Chain, ChainStore
+from p1_tpu.chain import AddResult, AddStatus, Chain, ChainStore
 from p1_tpu.config import NodeConfig
 from p1_tpu.core.block import Block, merkle_root
 from p1_tpu.core.header import BlockHeader
@@ -159,6 +159,15 @@ class NodeMetrics:
     sync_exhausted: int = 0
     cblock_fetch_stalls: int = 0
     mempool_sync_stalls: int = 0
+    #: Storage durability layer (chain/store.py + _store_append): store
+    #: write/fsync failures observed (ENOSPC, EIO...), recovery-loop
+    #: retry attempts, successful recoveries (degraded -> normal), and
+    #: blocks refused at the door while degraded (serve-only mode — the
+    #: peers re-serve them after recovery via the locator sync).
+    store_errors: int = 0
+    store_retries: int = 0
+    store_recoveries: int = 0
+    store_blocks_deferred: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -271,7 +280,12 @@ class _Peer:
 class Node:
     """One blockchain node: chain + mempool + p2p + (optionally) a miner."""
 
-    def __init__(self, config: NodeConfig, miner: Miner | None = None):
+    def __init__(
+        self,
+        config: NodeConfig,
+        miner: Miner | None = None,
+        store: ChainStore | None = None,
+    ):
         import secrets
 
         self.config = config
@@ -297,7 +311,36 @@ class Node:
             chain_tag=self.chain.genesis.block_hash(),
         )
         self.metrics = NodeMetrics()
-        self.store = ChainStore(config.store_path) if config.store_path else None
+        #: ``store`` is injectable (tests pass a fault-injecting
+        #: ``chain/testing.py`` FaultStore); by default the config path
+        #: decides persistence.
+        if store is not None:
+            self.store = store
+        else:
+            self.store = (
+                ChainStore(config.store_path) if config.store_path else None
+            )
+        #: Storage degradation state (the disk analog of sync-stall
+        #: failover): a failed append/fsync flips the node into a
+        #: degraded SERVE-ONLY mode — it stops accepting/persisting new
+        #: blocks and stops mining, but keeps answering headers/blocks/
+        #: proof/account queries from the chain it already holds — while
+        #: ``_store_recovery_loop`` retries the disk under the same
+        #: jittered-backoff policy the sync supervisor uses.  Blocks
+        #: accepted in the failing instant wait in ``_store_pending`` so
+        #: recovery persists them in order before new ones.
+        self._store_degraded = False
+        self._store_last_error: str | None = None
+        self._store_pending: list[Block] = []
+        self._store_sup = RequestSupervisor(
+            stall_timeout_s=1.0,  # unused: only the backoff math is
+            attempts_max=1 << 30,  # borrowed, and retries never exhaust
+            backoff_base_s=config.sync_backoff_base_s,
+            backoff_max_s=config.sync_backoff_max_s,
+        )
+        #: Set when a store failure should end the process instead of
+        #: degrading (``--store-degraded-exit``); the CLI watches it.
+        self.store_fatal = asyncio.Event()
         if miner is not None:
             self.miner = miner
         else:
@@ -625,6 +668,11 @@ class Node:
             await asyncio.gather(self._mempool_io, return_exceptions=True)
         self._save_mempool()
         if self.store is not None:
+            if self._store_pending:
+                # Last chance: the disk may have recovered since the
+                # failure; anything still unwritable is re-fetchable
+                # from peers on the next start.
+                self._store_flush()
             self.store.close()
 
     def start_mining(self) -> None:
@@ -669,6 +717,108 @@ class Node:
                     # Nothing else can surface a failure on this path (the
                     # mine loop is already gone) — don't lose it.
                     log.error("post-seal block handling failed: %r", r)
+
+    # -- storage durability (degraded serve-only mode) --------------------
+
+    def _store_append(self, blocks) -> None:
+        """Persist freshly accepted blocks.  A failing disk (ENOSPC, EIO,
+        fsync error) degrades the NODE instead of unwinding the
+        connection handler that happened to deliver the block — the
+        fault is the disk's, never the peer's, and dropping the session
+        would punish a healthy peer and reconnect-loop forever against
+        the same full disk."""
+        if self.store is None:
+            return
+        self._store_pending.extend(blocks)
+        if not self._store_degraded:
+            self._store_flush()
+
+    def _store_flush(self) -> bool:
+        """Write every pending record in order; True when caught up."""
+        while self._store_pending:
+            try:
+                self.store.append(self._store_pending[0])
+            except OSError as e:
+                self._store_fail(e)
+                return False
+            self._store_pending.pop(0)
+        return True
+
+    def _store_sync(self) -> None:
+        """Guarded batch-close fsync (the BLOCKS resync path)."""
+        if self.store is None or self._store_degraded:
+            return
+        try:
+            self.store.sync()
+        except OSError as e:
+            self._store_fail(e)
+
+    def _store_fail(self, exc: OSError) -> None:
+        self.metrics.store_errors += 1
+        self._store_last_error = f"{type(exc).__name__}: {exc}"
+        if self._store_degraded:
+            return
+        self._store_degraded = True
+        log.error(
+            "store write failed (%s) — entering degraded serve-only mode "
+            "(%d records pending)",
+            exc,
+            len(self._store_pending),
+        )
+        # Stop chasing blocks we would only refuse: the in-flight sync
+        # episode ends, the in-flight nonce search aborts (the mining
+        # loop pauses itself while degraded).
+        self._sync.idle()
+        self._abort_inflight_search()
+        if self.config.store_degraded_exit:
+            # Escape hatch for operators who prefer a supervisor restart
+            # to a degraded node: signal the CLI runner and stand down.
+            log.critical(
+                "store failed and --store-degraded-exit is set — "
+                "signaling shutdown"
+            )
+            self.store_fatal.set()
+            return
+        if self._running:
+            task = asyncio.create_task(self._store_recovery_loop())
+            self._sessions.add(task)
+            task.add_done_callback(self._sessions.discard)
+
+    async def _store_recovery_loop(self) -> None:
+        """Retry the store under the RequestSupervisor backoff policy
+        (jittered exponential, same knobs as sync failover) until writes
+        succeed again, then leave degraded mode and backfill: the blocks
+        refused at the door are re-fetched from peers via an ordinary
+        locator sync — nothing was acknowledged, so nothing is owed."""
+        sup = self._store_sup
+        while self._running and self._store_degraded:
+            await asyncio.sleep(sup.record_stall())
+            if not (self._running and self._store_degraded):
+                return
+            self.metrics.store_retries += 1
+            if not self._store_flush():
+                continue  # still failing: _store_fail counted it, back off
+            try:
+                # Prove durability, not just a buffered write.  (With an
+                # empty pending list this can pass while the disk is
+                # still full — the next real append re-degrades, which
+                # is self-correcting.)
+                self.store.sync()
+            except OSError as e:
+                self.metrics.store_errors += 1
+                self._store_last_error = f"{type(e).__name__}: {e}"
+                continue
+            self._store_degraded = False
+            self._store_last_error = None
+            self.metrics.store_recoveries += 1
+            sup.attempts = 0
+            sup.idle()
+            log.warning(
+                "store recovered — leaving degraded mode, backfilling "
+                "blocks refused meanwhile"
+            )
+            await self.request_sync()
+            return
 
     # -- p2p ------------------------------------------------------------
 
@@ -888,6 +1038,8 @@ class Node:
         single chosen peer goes through here — the quiesce-time
         ``request_sync`` broadcast is the one exception (it asks
         everyone at once, so there is no staller to supervise)."""
+        if self._store_degraded:
+            return  # serve-only: don't solicit blocks we would refuse
         self._sync.begin(peer)
         await self._send_guarded(
             peer, protocol.encode_getblocks(self.chain.locator())
@@ -1373,7 +1525,7 @@ class Node:
             finally:
                 if batch_fsync:
                     self.store.fsync = True
-                    self.store.sync()
+                    self._store_sync()
             # Progress was made and the batch was non-empty: there may be
             # more behind it (an empty/duplicate reply ends the loop).
             if accepted_any and body:
@@ -1598,6 +1750,11 @@ class Node:
 
         header = cb.header
         bhash = header.block_hash()
+        if self._store_degraded:
+            # Serve-only: don't spend a GETBLOCKTXN round trip on a
+            # block the door will refuse; recovery re-fetches it.
+            self.metrics.store_blocks_deferred += 1
+            return
         if bhash in self.chain or (bhash, peer) in self._pending_cblocks:
             return  # duplicate push
         expected = self.chain.required_difficulty(header.prev_hash)
@@ -1670,6 +1827,15 @@ class Node:
         gossip: bool = True,
         sent_ts: float | None = None,
     ):
+        if self._store_degraded and block.block_hash() not in self.chain:
+            # Degraded serve-only mode: a block we cannot persist is a
+            # block we must not acknowledge — accepting it would let the
+            # in-memory chain run ahead of a disk that will lose it.
+            # Peers keep it; recovery re-fetches via locator sync.
+            self.metrics.store_blocks_deferred += 1
+            return AddResult(
+                AddStatus.REJECTED, reason="store degraded: serve-only mode"
+            )
         # Zero-repack pipeline: a block decoded off the wire carries its
         # exact frame bytes in its encoding cache (core/block.py), so the
         # hashing below (add_block's validation), the store append, and
@@ -1691,9 +1857,9 @@ class Node:
                     max(0.0, time.time() - sent_ts)
                 )
             self.metrics.blocks_accepted += 1
-            if self.store is not None:
-                for connected in res.connected:  # incl. cascaded orphans
-                    self.store.append(connected)
+            # incl. cascaded orphans; a failing disk degrades, never
+            # unwinds this handler (_store_append).
+            self._store_append(res.connected)
             if res.tip_changed:
                 if res.removed:
                     self.metrics.reorgs += 1
@@ -1742,6 +1908,8 @@ class Node:
         push dropped in the final instant (send timeout, reconnect window)
         leaves no descendant to trigger an orphan backfill, so tips could
         stay split on a same-height tie without this pull."""
+        if self._store_degraded:
+            return  # serve-only: don't solicit blocks we would refuse
         if self._peers:
             await self._gossip(protocol.encode_getblocks(self.chain.locator()))
 
@@ -1836,6 +2004,13 @@ class Node:
 
         loop = asyncio.get_running_loop()
         while self._running:
+            if self._store_degraded:
+                # Serve-only: a sealed block would be refused at the
+                # door (it cannot be persisted), so don't burn the CPU
+                # sealing it.  Mining resumes the moment recovery clears
+                # the flag.
+                await asyncio.sleep(0.25)
+                continue
             candidate = self._assemble()
             self._abort = threading.Event()
             t0 = time.perf_counter()
@@ -1924,6 +2099,23 @@ class Node:
                 "exhausted": self.metrics.sync_exhausted,
                 "cblock_fetch_stalls": self.metrics.cblock_fetch_stalls,
                 "mempool_stalls": self.metrics.mempool_sync_stalls,
+            },
+            # Storage durability: disk health (degraded = serve-only
+            # mode after ENOSPC/EIO, recovering under backoff) plus what
+            # the store's startup scan had to quarantine or truncate
+            # (chain/store.py's v3 checksum framing).
+            "storage": {
+                "persistent": self.store is not None,
+                "degraded": self._store_degraded,
+                "errors": self.metrics.store_errors,
+                "retries": self.metrics.store_retries,
+                "recoveries": self.metrics.store_recoveries,
+                "blocks_deferred": self.metrics.store_blocks_deferred,
+                "pending_records": len(self._store_pending),
+                "last_error": self._store_last_error,
+                "healed": dict(self.store.healed)
+                if self.store is not None
+                else None,
             },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly

@@ -29,6 +29,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): the marker must be
+    # registered or every slow-marked soak raises an unknown-mark warning.
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soaks excluded from the tier-1 `-m 'not slow'` run",
+    )
+
+
 def pytest_sessionstart(session):
     devices = jax.devices()
     assert devices[0].platform == "cpu", f"tests must run on CPU, got {devices}"

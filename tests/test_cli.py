@@ -513,3 +513,108 @@ class TestRetargetWalletE2E:
                 node.wait(timeout=60)
             except Exception:
                 node.kill()
+
+
+class TestFsck:
+    """`p1 fsck` exit-code contract (ISSUE r7): 0 clean, 1 salvaged,
+    2 unrecoverable — plus the v2 upgrade path and a help smoke test."""
+
+    @staticmethod
+    def _mk_store(path, n=6, difficulty=12):
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.node.testing import make_blocks
+
+        blocks = make_blocks(n, difficulty=difficulty)
+        store = ChainStore(path)
+        try:
+            for block in blocks[1:]:
+                store.append(block)
+        finally:
+            store.close()
+        return blocks
+
+    @staticmethod
+    def _fsck(*argv, timeout=110):
+        return subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "fsck", *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd="/root/repo",
+        )
+
+    def test_clean_store_exit_0(self, tmp_path):
+        store = tmp_path / "clean.dat"
+        self._mk_store(store)
+        proc = self._fsck("--store", str(store))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip())
+        assert out["status"] == "clean"
+        assert out["records_valid"] == 6 and out["bad_spans"] == 0
+
+    def test_mid_log_corruption_salvaged_exit_1(self, tmp_path):
+        from p1_tpu.chain import ChainStore
+
+        store = tmp_path / "hurt.dat"
+        blocks = self._mk_store(store)
+        data = bytearray(store.read_bytes())
+        # Flip a bit in record 3's length prefix (the headline fault).
+        off, _n = ChainStore.scan(bytes(data)).spans[2]
+        data[off - 4] ^= 0x10
+        store.write_bytes(bytes(data))
+        proc = self._fsck("--store", str(store))
+        assert proc.returncode == 1, (proc.stdout, proc.stderr[-2000:])
+        out = json.loads(proc.stdout.strip())
+        assert out["status"] == "salvaged"
+        assert out["records_salvaged"] == 5 and out["bad_spans"] == 1
+        # The salvaged store is clean v3 holding every good record, and
+        # the quarantine sidecar preserves the evidence.
+        loaded = ChainStore(store).load_blocks()
+        want = [b.block_hash() for b in blocks[1:]]
+        assert [b.block_hash() for b in loaded] == want[:2] + want[3:]
+        assert (tmp_path / "hurt.dat.quarantine").exists()
+        # Second pass over the salvaged store: clean, exit 0.
+        assert self._fsck("--store", str(store)).returncode == 0
+
+    def test_garbage_store_exit_2(self, tmp_path):
+        junk = tmp_path / "junk.dat"
+        junk.write_bytes(b"definitely not a chain store at all")
+        proc = self._fsck("--store", str(junk))
+        assert proc.returncode == 2
+        assert "not a chain store" in proc.stderr
+        missing = self._fsck("--store", str(tmp_path / "absent.dat"))
+        assert missing.returncode == 2
+
+    def test_v2_store_upgrades_lossless_exit_0(self, tmp_path):
+        import struct
+
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.chain.store import MAGIC, V2_MAGIC
+        from p1_tpu.node.testing import make_blocks
+
+        blocks = make_blocks(4, difficulty=12)
+        store = tmp_path / "v2.dat"
+        parts = [V2_MAGIC]
+        for block in blocks[1:]:
+            raw = block.serialize()
+            parts.append(struct.pack(">I", len(raw)))
+            parts.append(raw)
+        store.write_bytes(b"".join(parts))
+        proc = self._fsck("--store", str(store))
+        assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+        out = json.loads(proc.stdout.strip())
+        assert out["status"] == "upgraded" and out["version"] == 2
+        assert store.read_bytes().startswith(MAGIC)
+        loaded = ChainStore(store).load_blocks()
+        assert [b.block_hash() for b in loaded] == [
+            b.block_hash() for b in blocks[1:]
+        ]
+        # A v2 store is also writable again after the upgrade.
+        s = ChainStore(store)
+        s.acquire()
+        s.close()
+
+    def test_help_smoke(self):
+        proc = self._fsck("--help")
+        assert proc.returncode == 0
+        assert "salvage" in proc.stdout and "--store" in proc.stdout

@@ -286,14 +286,34 @@ class TestPackedPlane:
         )
         report = replay_packed(raw)
         assert report.valid, report
-        # Corrupt one header byte on disk: the packed verify pins it.
-        # (Each record is 80 header bytes + a 4-byte tx count, so the
-        # last record's prev_hash field starts 80 bytes from the end —
-        # a prev_hash flip fails linkage deterministically, unlike a
-        # nonce flip, which difficulty-1 PoW would often forgive.)
-        data = bytearray(path.read_bytes())
-        data[-80] ^= 0x01
+        # Corrupt one header byte on disk: the v3 record checksum
+        # excludes the damaged record at the framing layer, so the
+        # packed buffer shrinks by one instead of carrying a lie.
+        pristine = path.read_bytes()
+        data = bytearray(pristine)
+        # Flip a prev_hash byte of the LAST record (its payload starts
+        # 84 bytes before the 4-byte CRC trailer: 80 header + u32 count).
+        data[-84] ^= 0x01
         path.write_bytes(bytes(data))
-        raw2, _ = ChainStore(path).packed_headers()
-        bad = replay_packed(raw2)
+        raw2, n2 = ChainStore(path).packed_headers()
+        assert n2 == n - 1 and raw2 == raw[: 80 * (n - 1)]
+        # Corruption the checksum CANNOT see (a hostile editor fixes the
+        # CRC after flipping): the packed verify still pins it — the
+        # layers are complementary, not redundant.  (A prev_hash flip
+        # fails linkage deterministically, unlike a nonce flip, which
+        # difficulty-1 PoW would often forgive.)
+        import struct as _struct
+        import zlib as _zlib
+
+        from p1_tpu.chain.store import ChainStore as _CS
+
+        data = bytearray(pristine)
+        data[-84] ^= 0x01
+        last_off, last_len = _CS.scan(bytes(pristine)).spans[-1]
+        frame = bytes(data[last_off - 4 : last_off + last_len])
+        data[last_off + last_len :] = _struct.pack(">I", _zlib.crc32(frame))
+        path.write_bytes(bytes(data))
+        raw3, n3 = ChainStore(path).packed_headers()
+        assert n3 == n
+        bad = replay_packed(raw3)
         assert not bad.valid and bad.first_invalid == n - 1

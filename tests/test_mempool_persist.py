@@ -105,6 +105,44 @@ class TestSaveLoad:
         assert load_mempool(_pool(), path) == (0, 0)
 
 
+class TestAtomicWrite:
+    def test_fsync_data_before_replace_and_dir_after(
+        self, tmp_path, monkeypatch
+    ):
+        """Power-loss ordering (ISSUE r7 satellite): the tmp file's DATA
+        must be fsynced before the rename publishes it (or the journal
+        can commit a completed rename pointing at an empty/torn file),
+        and the DIRECTORY after (or the rename itself can vanish)."""
+        import os
+        import stat
+
+        from p1_tpu.mempool import write_mempool_file
+
+        events = []
+        real_fsync, real_replace = os.fsync, os.replace
+
+        def spy_fsync(fd):
+            kind = "dir" if stat.S_ISDIR(os.fstat(fd).st_mode) else "file"
+            events.append(("fsync", kind))
+            return real_fsync(fd)
+
+        def spy_replace(src, dst):
+            events.append(("replace", None))
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "fsync", spy_fsync)
+        monkeypatch.setattr(os, "replace", spy_replace)
+        path = tmp_path / "pool.mempool"
+        write_mempool_file(b"payload-bytes", path)
+        assert events == [
+            ("fsync", "file"),
+            ("replace", None),
+            ("fsync", "dir"),
+        ]
+        assert path.read_bytes() == b"payload-bytes"
+        assert not path.with_suffix(".mempool.tmp").exists()
+
+
 class TestNodeRestart:
     def test_pending_txs_survive_restart(self, tmp_path):
         async def scenario():

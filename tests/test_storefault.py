@@ -1,0 +1,591 @@
+"""Storage durability layer: checksummed v3 record framing, corruption
+salvage, disk-fault injection (FaultStore), and graceful node degradation
+under ENOSPC/EIO.
+
+The acceptance pair (ISSUE r7):
+
+- a single bit flipped in a mid-log LENGTH PREFIX loses zero
+  checksum-valid records on restart (pre-v3 framing silently truncated
+  everything behind it);
+- a flipped BODY byte is detected at resume — quarantined, never trusted
+  through the fast-resume path.
+
+Plus the node plane: a store failing with ENOSPC degrades the node into
+serve-only mode (peers still get headers/blocks), and persistence resumes
+end-to-end once the fault clears.
+"""
+
+import errno
+import os
+import signal
+import struct
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from test_node import DIFF, _config, run, wait_until
+
+from p1_tpu.chain import ChainStore, save_chain
+from p1_tpu.chain.chain import Chain
+from p1_tpu.chain.store import MAGIC, V2_MAGIC, fsync_dir
+from p1_tpu.chain.testing import FaultStore, StoreFaultPlan
+from p1_tpu.node import Node
+from p1_tpu.node.testing import FaultPlan, HostilePeer, make_blocks
+
+_LEN = struct.Struct(">I")
+_CRC = struct.Struct(">I")
+
+
+@pytest.fixture(scope="module")
+def blocks():
+    """Genesis + 8 mined blocks at DIFF (shared: mining is the only
+    expensive part of these tests)."""
+    return make_blocks(8, difficulty=DIFF)
+
+
+def _fill_store(path, blocks) -> bytes:
+    """Append every post-genesis block; return the on-disk bytes."""
+    store = ChainStore(path)
+    try:
+        for block in blocks[1:]:
+            store.append(block)
+    finally:
+        store.close()
+    return path.read_bytes()
+
+
+def _record_frames(data: bytes) -> list[tuple[int, int]]:
+    """[(frame start, frame end)] of every record, from the scan spans."""
+    return [
+        (off - _LEN.size, off + n + _CRC.size)
+        for off, n in ChainStore.scan(data).spans
+    ]
+
+
+def _write_v2_store(path, blocks) -> None:
+    """Hand-craft a pre-checksum v2 store (the old framing)."""
+    parts = [V2_MAGIC]
+    for block in blocks[1:]:
+        raw = block.serialize()
+        parts.append(_LEN.pack(len(raw)))
+        parts.append(raw)
+    path.write_bytes(b"".join(parts))
+
+
+class TestV3Framing:
+    def test_v3_magic_and_roundtrip(self, blocks, tmp_path):
+        path = tmp_path / "chain.dat"
+        _fill_store(path, blocks)
+        assert path.read_bytes().startswith(MAGIC)
+        loaded = ChainStore(path).load_blocks()
+        assert [b.block_hash() for b in loaded] == [
+            b.block_hash() for b in blocks[1:]
+        ]
+        chain = ChainStore(path).load_chain(DIFF)
+        assert chain.tip_hash == blocks[-1].block_hash()
+
+    def test_flipped_length_prefix_loses_zero_good_records(
+        self, blocks, tmp_path
+    ):
+        """THE headline guarantee: pre-v3 framing read a corrupt mid-log
+        length prefix as a truncated tail and permanently truncated the
+        entire good remainder at the next startup.  v3 resyncs past the
+        one damaged record and keeps every other one."""
+        path = tmp_path / "chain.dat"
+        data = bytearray(_fill_store(path, blocks))
+        frames = _record_frames(bytes(data))
+        # Flip one bit in record 3's length prefix (8 records total).
+        bad_start, bad_end = frames[2]
+        data[bad_start] ^= 0x10
+        path.write_bytes(bytes(data))
+
+        # Restart sequence: acquire (heal under the lock) + load.
+        store = ChainStore(path)
+        store.acquire()
+        try:
+            loaded = store.load_blocks()
+        finally:
+            store.close()
+        survivors = [b.block_hash() for b in loaded]
+        want = [b.block_hash() for b in blocks[1:]]
+        assert survivors == want[:2] + want[3:]  # ONLY the hit record gone
+        assert len(survivors) == 7
+        # The bad span is quarantined, not destroyed: sidecar holds the
+        # original bytes (offset u64 + len u32 header per entry).
+        q = store.quarantine_path().read_bytes()
+        qoff, qlen = struct.unpack_from(">QI", q, 0)
+        assert (qoff, qlen) == (bad_start, bad_end - bad_start)
+        assert q[12 : 12 + qlen] == bytes(data[bad_start:bad_end])
+        assert store.healed["quarantined_records"] == 1
+        assert store.healed["quarantined_bytes"] == bad_end - bad_start
+        # The healed file re-scans clean and still holds the 7 records.
+        rescan = ChainStore.scan(path.read_bytes())
+        assert rescan.clean and len(rescan.spans) == 7
+
+    def test_flipped_body_byte_detected_at_resume(self, blocks, tmp_path):
+        """Bit-rot inside a record body fails the record CRC: the record
+        is quarantined at resume instead of riding through the trusted
+        fast-resume path undetected (the pre-v3 docstring's admitted
+        hole)."""
+        path = tmp_path / "chain.dat"
+        data = bytearray(_fill_store(path, blocks))
+        frames = _record_frames(bytes(data))
+        s, e = frames[4]
+        data[(s + e) // 2] ^= 0x01  # mid-payload flip
+        path.write_bytes(bytes(data))
+        corrupt_hash = blocks[5].block_hash()
+
+        store = ChainStore(path)
+        store.acquire()
+        try:
+            loaded = store.load_blocks()
+            chain = store.load_chain(DIFF, loaded, trusted=True)
+        finally:
+            store.close()
+        # Detected: the damaged record never reaches the chain, trusted
+        # resume or not.
+        assert corrupt_hash not in {b.block_hash() for b in loaded}
+        assert corrupt_hash not in chain
+        assert store.healed["quarantined_records"] == 1
+        # The chain resumes to the last block BEFORE the gap (the later
+        # records survive on disk as orphans until a peer fills the gap).
+        assert chain.tip_hash == blocks[4].block_hash()
+
+    def test_torn_tail_still_truncates_silently(self, blocks, tmp_path):
+        path = tmp_path / "chain.dat"
+        data = _fill_store(path, blocks)
+        path.write_bytes(data[:-7])  # crash mid-append of the last record
+        store = ChainStore(path)
+        store.acquire()
+        try:
+            loaded = store.load_blocks()
+            # A crash artifact, not corruption: nothing quarantined.
+            assert store.healed["quarantined_records"] == 0
+            assert store.healed["truncated_bytes"] > 0
+            assert not store.quarantine_path().exists()
+            assert len(loaded) == 7
+            # And the writer can append cleanly behind the trim.
+            store.append(blocks[-1])
+        finally:
+            store.close()
+        assert ChainStore(path).load_chain(DIFF).tip_hash == blocks[
+            -1
+        ].block_hash()
+
+    def test_trailing_complete_corrupt_record_quarantined(
+        self, blocks, tmp_path
+    ):
+        # The LAST record's bytes are all present but its CRC fails:
+        # that is corruption (quarantine), not a torn tail (truncate).
+        path = tmp_path / "chain.dat"
+        data = bytearray(_fill_store(path, blocks))
+        data[-1] ^= 0x01  # flip inside the final CRC trailer
+        path.write_bytes(bytes(data))
+        store = ChainStore(path)
+        store.acquire()
+        try:
+            assert store.healed["quarantined_records"] == 1
+            assert store.quarantine_path().exists()
+            assert len(store.load_blocks()) == 7
+        finally:
+            store.close()
+
+    def test_multiple_corrupt_spans_all_quarantined(self, blocks, tmp_path):
+        path = tmp_path / "chain.dat"
+        data = bytearray(_fill_store(path, blocks))
+        frames = _record_frames(bytes(data))
+        for idx in (1, 5):
+            s, e = frames[idx]
+            data[(s + e) // 2] ^= 0x40
+        path.write_bytes(bytes(data))
+        store = ChainStore(path)
+        store.acquire()
+        try:
+            assert store.healed["quarantined_records"] == 2
+            assert len(store.load_blocks()) == 6
+        finally:
+            store.close()
+
+
+class TestV2Compat:
+    def test_v2_store_loads_read_only(self, blocks, tmp_path):
+        path = tmp_path / "v2.dat"
+        _write_v2_store(path, blocks)
+        loaded = ChainStore(path).load_blocks()
+        assert [b.block_hash() for b in loaded] == [
+            b.block_hash() for b in blocks[1:]
+        ]
+        chain = ChainStore(path).load_chain(DIFF)
+        assert chain.tip_hash == blocks[-1].block_hash()
+        raw, n = ChainStore(path).packed_headers()
+        assert n == len(blocks) - 1
+
+    def test_v2_writer_refused_with_upgrade_hint(self, blocks, tmp_path):
+        path = tmp_path / "v2.dat"
+        _write_v2_store(path, blocks)
+        with pytest.raises(RuntimeError, match="fsck"):
+            ChainStore(path).acquire()
+        # Maintenance tooling (compact/fsck) may still lock it.
+        store = ChainStore(path)
+        store.acquire(allow_v2=True)
+        store.close()
+        assert path.read_bytes().startswith(V2_MAGIC)  # untouched
+
+    def test_v2_torn_tail_truncated_under_allow_v2(self, blocks, tmp_path):
+        path = tmp_path / "v2.dat"
+        _write_v2_store(path, blocks)
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-5])
+        store = ChainStore(path)
+        store.acquire(allow_v2=True)
+        store.close()
+        assert len(ChainStore(path).load_blocks()) == 7
+
+
+class TestFaultStore:
+    def test_enospc_on_nth_write(self, blocks, tmp_path):
+        # Write #1 is the magic, each append is one write.
+        store = FaultStore(
+            tmp_path / "f.dat", plan=StoreFaultPlan(fail_write_at=3)
+        )
+        try:
+            store.append(blocks[1])
+            with pytest.raises(OSError) as exc:
+                store.append(blocks[2])
+            assert exc.value.errno == errno.ENOSPC
+        finally:
+            store.close()
+        assert len(ChainStore(tmp_path / "f.dat").load_blocks()) == 1
+
+    def test_torn_write_leaves_recoverable_prefix(self, blocks, tmp_path):
+        path = tmp_path / "f.dat"
+        store = FaultStore(
+            path, plan=StoreFaultPlan(fail_write_at=3, torn_bytes=10)
+        )
+        try:
+            store.append(blocks[1])
+            with pytest.raises(OSError):
+                store.append(blocks[2])
+        finally:
+            store.close()
+        # 10 bytes of record 2 landed: a torn tail the next writer trims.
+        fresh = ChainStore(path)
+        fresh.acquire()
+        try:
+            assert fresh.healed["truncated_bytes"] == 10
+            fresh.append(blocks[2])
+        finally:
+            fresh.close()
+        assert len(ChainStore(path).load_blocks()) == 2
+
+    def test_fsync_failure_surfaces_as_oserror(self, blocks, tmp_path):
+        store = FaultStore(
+            tmp_path / "f.dat", plan=StoreFaultPlan(fail_fsync_at=1)
+        )
+        try:
+            with pytest.raises(OSError) as exc:
+                store.append(blocks[1])
+            assert exc.value.errno == errno.EIO
+        finally:
+            store.close()
+
+    def test_bitflip_on_read_detected_without_touching_disk(
+        self, blocks, tmp_path
+    ):
+        path = tmp_path / "f.dat"
+        pristine = _fill_store(path, blocks)
+        frames = _record_frames(pristine)
+        s, e = frames[3]
+        flipped = FaultStore(
+            path, plan=StoreFaultPlan(flip_read_at=(s + e) // 2)
+        )
+        assert len(flipped.load_blocks()) == 7  # bad read: record dropped
+        assert path.read_bytes() == pristine  # platter bytes intact
+        assert len(ChainStore(path).load_blocks()) == 8  # clean reader
+
+    def test_save_chain_fsyncs_data_then_directory(self, blocks, tmp_path):
+        chain = Chain(DIFF, genesis=blocks[0])
+        for block in blocks[1:]:
+            chain.add_block(block)
+        created = []
+
+        def factory(p, fsync=True):
+            s = FaultStore(p, fsync=fsync)
+            created.append(s)
+            return s
+
+        save_chain(chain, tmp_path / "snap.dat", store_cls=factory)
+        (store,) = created
+        # The snapshot's one data fsync lands BEFORE the directory fsync
+        # (dir-entry durability is meaningless for still-dirty data).
+        assert store.events[-2:] == ["fsync", "dir_fsync"]
+        assert store.fsyncs == 1 and store.dir_fsyncs == 1
+        # A failing directory fsync is a real error, not best-effort.
+        with pytest.raises(OSError):
+            save_chain(
+                chain,
+                tmp_path / "snap2.dat",
+                store_cls=lambda p, fsync=True: FaultStore(
+                    p, plan=StoreFaultPlan(fail_dir_fsync_at=1), fsync=fsync
+                ),
+            )
+
+
+class TestCrashSoak:
+    def test_truncation_at_every_offset_recovers_prefix(
+        self, blocks, tmp_path
+    ):
+        """Deterministic tier-1 crash soak: a store cut at ANY byte
+        offset (kill-9 / power-cut shapes) must reopen to an exact
+        prefix of the appended chain — never an exception, never a
+        record past the cut, never a misparse."""
+        path = tmp_path / "soak.dat"
+        data = _fill_store(path, blocks)
+        frames = _record_frames(data)
+        want = [b.block_hash() for b in blocks[1:]]
+        for cut in range(len(MAGIC), len(data), 3):
+            path.write_bytes(data[:cut])
+            store = ChainStore(path)
+            store.acquire()
+            try:
+                got = [b.block_hash() for b in store.load_blocks()]
+            finally:
+                store.close()
+            whole = sum(1 for _, end in frames if end <= cut)
+            assert got == want[:whole], f"cut at {cut}"
+
+    def test_bitflip_at_sampled_offsets_never_loses_other_records(
+        self, blocks, tmp_path
+    ):
+        """Every single-bit flip past the magic costs AT MOST the one
+        record it hits — the containment bound the checksums buy."""
+        path = tmp_path / "flip.dat"
+        data = _fill_store(path, blocks)
+        frames = _record_frames(data)
+        want = [b.block_hash() for b in blocks[1:]]
+        for off in range(len(MAGIC), len(data), 17):
+            buf = bytearray(data)
+            buf[off] ^= 0x08
+            path.write_bytes(bytes(buf))
+            store = ChainStore(path)
+            store.acquire()
+            try:
+                got = [b.block_hash() for b in store.load_blocks()]
+            finally:
+                store.close()
+            hit = [
+                i for i, (s, e) in enumerate(frames) if s <= off < e
+            ]
+            expect = [h for i, h in enumerate(want) if i not in hit]
+            assert got == expect, f"flip at {off}"
+
+    @pytest.mark.slow
+    def test_kill9_at_random_offset_soak(self, tmp_path):
+        """The real thing: SIGKILL a subprocess mid-append at random
+        moments, reopen, assert the surviving store is an exact prefix
+        of the deterministic chain, then relaunch on the SAME store to
+        keep appending — every round exercises heal + resume + append
+        continuation."""
+        import random
+        import time
+
+        path = tmp_path / "kill.dat"
+        n_blocks, diff, delay = 24, 10, 0.08
+        from p1_tpu.node.testing import make_blocks as mk
+
+        want = [b.block_hash() for b in mk(n_blocks, difficulty=diff)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        rng = random.Random(7)
+        rounds = intermediates = 0
+        complete = False
+        while rounds < 20 and not complete:
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "p1_tpu.chain.testing",
+                    str(path),
+                    str(n_blocks),
+                    str(diff),
+                    str(delay),
+                ],
+                env=env,
+                cwd="/root/repo",
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            try:
+                time.sleep(rng.uniform(0.5, 2.2))
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+            rounds += 1
+            if not path.exists():
+                continue  # killed before the store was even created
+            store = ChainStore(path)
+            store.acquire()  # the restart heal path
+            try:
+                got = [b.block_hash() for b in store.load_blocks()]
+            finally:
+                store.close()
+            assert got == want[: len(got)], f"round {rounds}"
+            complete = len(got) >= n_blocks + 1
+            if got and not complete:
+                intermediates += 1
+        assert complete, f"never finished in {rounds} rounds"
+        # The soak must have actually observed kill-mid-append states —
+        # a run whose every kill landed after completion proves nothing.
+        assert intermediates >= 1, "no mid-append kill ever observed"
+
+
+class TestNodeDegradation:
+    def test_enospc_degrades_serves_and_recovers(self, tmp_path):
+        """End-to-end acceptance: a node whose disk fills mid-sync (a)
+        enters degraded serve-only mode without dropping the peer
+        connection, (b) still answers headers queries, and (c) resumes
+        persisting + catches back up once space returns."""
+
+        async def scenario():
+            chain_blocks = make_blocks(10, difficulty=DIFF)
+            peer = HostilePeer(chain_blocks, plan=FaultPlan(batch_limit=2))
+            await peer.start()
+            # Write #1 = magic, writes #2..4 = records: the 4th record
+            # append hits persistent ENOSPC mid-IBD.
+            store = FaultStore(
+                tmp_path / "victim.dat",
+                plan=StoreFaultPlan(fail_writes_from=5),
+            )
+            node = Node(
+                _config(
+                    peers=[f"127.0.0.1:{peer.port}"],
+                    store_path=str(tmp_path / "victim.dat"),
+                    sync_stall_timeout_s=0.5,
+                    sync_backoff_base_s=0.05,
+                    sync_backoff_max_s=0.2,
+                ),
+                store=store,
+            )
+            await node.start()
+            try:
+                # (a) the store fails on record 4; the node degrades.
+                assert await wait_until(lambda: node._store_degraded)
+                status = node.status()["storage"]
+                assert status["degraded"] is True
+                assert status["errors"] >= 1
+                assert node.metrics.store_errors >= 1
+                # The connection that delivered the fatal block is NOT
+                # unwound: the peer session survives the disk fault.
+                assert node.peer_count() == 1
+                height_frozen = node.chain.height
+                assert height_frozen < 10
+                # (b) serve-only: a light client still gets our headers.
+                from p1_tpu.node.client import get_headers
+
+                headers = await get_headers(
+                    "127.0.0.1", node.port, DIFF, timeout=10.0
+                )
+                assert len(headers) == height_frozen + 1
+                # Blocks pushed while degraded are deferred, not taken.
+                assert node.chain.height == height_frozen
+                # (c) space returns: the recovery loop flushes pending
+                # records, clears the flag, and backfills to the full
+                # advertised chain.
+                store.clear_faults()
+                assert await wait_until(
+                    lambda: not node._store_degraded, timeout=10.0
+                )
+                assert node.metrics.store_recoveries == 1
+                assert await wait_until(
+                    lambda: node.chain.height == 10, timeout=20.0
+                )
+                # Everything accepted is durably on disk, in order.
+                assert await wait_until(
+                    lambda: len(ChainStore(store.path).load_blocks()) == 10
+                )
+                assert node.status()["storage"]["pending_records"] == 0
+            finally:
+                await node.stop()
+                await peer.stop()
+            # Restart on the recovered store: full resume, nothing torn.
+            revived = Node(
+                _config(store_path=str(tmp_path / "victim.dat"))
+            )
+            await revived.start()
+            try:
+                assert revived.chain.height == 10
+            finally:
+                await revived.stop()
+
+        run(scenario())
+
+    def test_store_degraded_exit_signals_fatal(self, tmp_path):
+        """The --store-degraded-exit escape hatch: the node signals the
+        CLI (store_fatal) instead of entering degraded mode."""
+
+        async def scenario():
+            chain_blocks = make_blocks(3, difficulty=DIFF)
+            peer = HostilePeer(chain_blocks)
+            await peer.start()
+            store = FaultStore(
+                tmp_path / "fatal.dat",
+                plan=StoreFaultPlan(fail_write_at=2),  # first record
+            )
+            node = Node(
+                _config(
+                    peers=[f"127.0.0.1:{peer.port}"],
+                    store_path=str(tmp_path / "fatal.dat"),
+                    store_degraded_exit=True,
+                ),
+                store=store,
+            )
+            await node.start()
+            try:
+                assert await wait_until(lambda: node.store_fatal.is_set())
+                assert node.status()["storage"]["degraded"] is True
+            finally:
+                await node.stop()
+                await peer.stop()
+
+        run(scenario())
+
+    def test_mining_pauses_while_degraded(self, tmp_path):
+        """A degraded miner stops sealing blocks (they could never be
+        persisted or honestly gossiped) and resumes after recovery."""
+
+        async def scenario():
+            store = FaultStore(
+                tmp_path / "miner.dat",
+                plan=StoreFaultPlan(fail_writes_from=4),  # after 2 blocks
+            )
+            node = Node(
+                _config(
+                    mine=True,
+                    store_path=str(tmp_path / "miner.dat"),
+                    sync_backoff_base_s=0.05,
+                    sync_backoff_max_s=0.2,
+                ),
+                store=store,
+            )
+            await node.start()
+            try:
+                assert await wait_until(lambda: node._store_degraded)
+                frozen = node.chain.height
+                import asyncio
+
+                await asyncio.sleep(0.8)
+                assert node.chain.height == frozen  # no sealing while down
+                store.clear_faults()
+                assert await wait_until(
+                    lambda: not node._store_degraded, timeout=10.0
+                )
+                assert await wait_until(
+                    lambda: node.chain.height > frozen, timeout=20.0
+                )
+            finally:
+                await node.stop()
+
+        run(scenario())
