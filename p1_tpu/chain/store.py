@@ -57,6 +57,14 @@ _QREC = struct.Struct(">QI")
 MAGIC = b"P1TPUCH3"
 V2_MAGIC = b"P1TPUCH2"
 _OLD_MAGICS = (b"P1TPUCHN",)
+#: Largest length prefix a record may carry — same bound as the wire's
+#: ``protocol.MAX_FRAME`` (every stored block arrived in, or must fit
+#: into, one gossip frame).  Scanning rejects bigger length fields
+#: before checksumming, which bounds the resync walk's per-candidate
+#: cost: a random 32-bit length passes this gate ~0.8% of the time, so
+#: recovering framing past a corrupt span stays near-linear instead of
+#: O(file_size x record_size).
+_MAX_RECORD = 32 << 20
 
 
 def fsync_dir(path: str | os.PathLike) -> None:
@@ -196,12 +204,24 @@ class ChainStore:
                     )
                 if not heal or scan.clean:
                     break
-                if scan.bad_spans and attempt == 0:
-                    # Mid-log corruption: quarantine + rebuild replaces
-                    # the inode, so loop to re-lock and re-verify it.
-                    self._heal_rebuild(data, scan)
-                    fh.close()
-                    continue
+                if scan.bad_spans:
+                    if attempt == 0:
+                        # Mid-log corruption: quarantine + rebuild
+                        # replaces the inode, so loop to re-lock and
+                        # re-verify it.
+                        self._heal_rebuild(data, scan)
+                        fh.close()
+                        continue
+                    # The rebuild wrote only checksum-valid records, so
+                    # corruption surviving the re-verify means the medium
+                    # itself is lying (persistent read fault, bytes
+                    # re-corrupting under us).  Refuse the writer rather
+                    # than silently append behind unhealed damage.
+                    raise ValueError(
+                        f"{self.path}: {len(scan.bad_spans)} corrupt "
+                        "span(s) persist after heal — refusing writer; "
+                        "run `p1 fsck`"
+                    )
                 if scan.torn_tail is not None:
                     # Drop the truncated tail record (crash mid-append)
                     # before writing behind it, or its stale length
@@ -252,10 +272,26 @@ class ChainStore:
 
     def append(self, block: Block) -> None:
         self.acquire()
+        if self.last_scan is not None and self.last_scan.version == 2:
+            # allow_v2 admits readers and rewriters, never appenders: a
+            # v3 CRC-trailed record in a v2-magic file reads back with
+            # the trailer as the NEXT record's length prefix, silently
+            # desyncing the whole log's framing.
+            raise ValueError(
+                f"{self.path}: cannot append to a v2 chain store — "
+                "rewrite it as v3 first (`p1 fsck` or `p1 compact`)"
+            )
         # ``serialize`` is memoized on the block: for a block that arrived
         # off the wire these are the exact gossip bytes — ingest appends
         # with zero re-packing (docs/PERF.md "host ingest plane").
         raw = block.serialize()
+        if len(raw) > _MAX_RECORD:
+            # The scan rejects bigger length fields as corruption, so a
+            # record this size would be unreadable the moment it landed.
+            raise ValueError(
+                f"block serializes to {len(raw)} bytes, over the "
+                f"{_MAX_RECORD}-byte record limit"
+            )
         prefix = _LEN.pack(len(raw))
         crc = zlib.crc32(raw, zlib.crc32(prefix))
         # One write per record: a torn append (crash, ENOSPC mid-write)
@@ -300,6 +336,8 @@ class ChainStore:
         if off + _LEN.size + _CRC.size > len(data):
             return None
         (n,) = _LEN.unpack_from(data, off)
+        if n > _MAX_RECORD:
+            return None
         end = off + _LEN.size + n + _CRC.size
         if end > len(data):
             return None
@@ -314,7 +352,11 @@ class ChainStore:
         — how the scan recovers framing past a corrupt span.  A false
         positive needs a 32-bit CRC collision at a byte offset whose
         length field also happens to land exactly inside the file
-        (~2^-32 per candidate): negligible against whole-log loss."""
+        (~2^-32 per candidate): negligible against whole-log loss.
+        Candidates whose length field exceeds ``_MAX_RECORD`` (or whose
+        frame overruns the file) are rejected before any checksumming,
+        so the walk's cost is dominated by the cheap 4-byte reads, not
+        by CRCs over garbage."""
         for cand in range(start, len(data) - (_LEN.size + _CRC.size) + 1):
             if cls._v3_record_at(data, cand) is not None:
                 return cand

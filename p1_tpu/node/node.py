@@ -780,9 +780,28 @@ class Node:
             self.store_fatal.set()
             return
         if self._running:
-            task = asyncio.create_task(self._store_recovery_loop())
-            self._sessions.add(task)
-            task.add_done_callback(self._sessions.discard)
+            self._spawn_store_recovery()
+
+    def _spawn_store_recovery(self) -> None:
+        task = asyncio.create_task(self._store_recovery_loop())
+        self._sessions.add(task)
+        task.add_done_callback(self._store_recovery_done)
+
+    def _store_recovery_done(self, task: asyncio.Task) -> None:
+        """A recovery task that dies while the node is still degraded
+        would strand it serve-only forever — ``_store_fail`` early-returns
+        once degraded, so nothing else ever respawns the loop.  Surface
+        the wreck and restart; the loop's own backoff (first await) keeps
+        a persistent crash from spinning."""
+        self._sessions.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        log.error("store recovery loop died: %r", exc)
+        if self._running and self._store_degraded:
+            self._spawn_store_recovery()
 
     async def _store_recovery_loop(self) -> None:
         """Retry the store under the RequestSupervisor backoff policy

@@ -152,9 +152,14 @@ class RequestSupervisor:
         use it directly; pollers (the node loop) just re-check."""
         self.stalls += 1
         self.attempts += 1
+        # Exponent clamped: a caller that disables exhaustion (the store
+        # recovery loop runs with attempts_max effectively infinite) can
+        # accumulate thousands of attempts, and 2**attempts would
+        # overflow the int->float conversion long before the min() could
+        # discard it.  Past the clamp the delay is backoff_max_s anyway.
         delay = min(
             self.backoff_max_s,
-            self.backoff_base_s * (2 ** (self.attempts - 1)),
+            self.backoff_base_s * (2.0 ** min(self.attempts - 1, 60)),
         )
         delay *= _JITTER_LO + _JITTER_SPAN * self._rng.random()
         self._retry_at = self._clock() + delay

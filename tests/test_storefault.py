@@ -233,6 +233,22 @@ class TestV2Compat:
         store.close()
         assert path.read_bytes().startswith(V2_MAGIC)  # untouched
 
+    def test_v2_append_refused_even_under_allow_v2(self, blocks, tmp_path):
+        # allow_v2 admits readers/rewriters; an appended v3 record's CRC
+        # trailer would read back as the next record's length prefix and
+        # desync the v2 framing, so append must refuse.
+        path = tmp_path / "v2.dat"
+        _write_v2_store(path, blocks)
+        before = path.read_bytes()
+        store = ChainStore(path)
+        store.acquire(allow_v2=True)
+        try:
+            with pytest.raises(ValueError, match="v2"):
+                store.append(blocks[1])
+        finally:
+            store.close()
+        assert path.read_bytes() == before  # untouched
+
     def test_v2_torn_tail_truncated_under_allow_v2(self, blocks, tmp_path):
         path = tmp_path / "v2.dat"
         _write_v2_store(path, blocks)
@@ -245,6 +261,20 @@ class TestV2Compat:
 
 
 class TestFaultStore:
+    def test_persistent_read_fault_refuses_writer(self, blocks, tmp_path):
+        # A bit that re-flips on EVERY read (bad sector / lying medium)
+        # survives the quarantine rebuild's re-verify; the writer must be
+        # refused, not admitted behind unhealed corruption.
+        path = tmp_path / "f.dat"
+        data = _fill_store(path, blocks)
+        s, e = _record_frames(data)[0]
+        store = FaultStore(
+            path,
+            plan=StoreFaultPlan(flip_read_at=(s + e) // 2, flip_mask=0x10),
+        )
+        with pytest.raises(RuntimeError, match="persist"):
+            store.acquire()
+
     def test_enospc_on_nth_write(self, blocks, tmp_path):
         # Write #1 is the magic, each append is one write.
         store = FaultStore(

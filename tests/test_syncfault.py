@@ -110,6 +110,19 @@ class TestSupervisorUnit:
         self.now = delay + 0.01
         assert sup.ready()
 
+    def test_backoff_never_overflows_at_huge_attempt_counts(self):
+        # The store recovery loop disables exhaustion (attempts_max is
+        # effectively infinite) and stalls forever against a dead disk;
+        # 2**attempts must not overflow float conversion, and the delay
+        # must stay at the cap.
+        sup = self._sup(
+            attempts_max=1 << 30, backoff_base_s=0.25, backoff_max_s=5.0
+        )
+        sup.attempts = 5000  # ~7 hours of stalls at the 5 s cap
+        sup.begin("disk")
+        d = sup.record_stall()
+        assert 0.5 * 5.0 <= d <= 1.5 * 5.0
+
     def test_idle_without_begin_never_stalls(self):
         sup = self._sup()
         self.now = 1e9
