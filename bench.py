@@ -137,6 +137,29 @@ def main() -> None:
     except OSError:
         load_1m = load_5m = None
 
+    # Peak RSS of the bench process itself: the memory-side context
+    # field the governor work reads against — an unexplained jump here
+    # flags a resident-set regression the throughput numbers can't see
+    # (docs/PERF.md "Memory-bounded operation").  VmHWM, not ru_maxrss:
+    # the latter survives fork+exec on Linux, so a bench spawned from a
+    # fat driver would report the DRIVER's high-water mark
+    # (benchmarks/memory_bound.py measured exactly that failure mode).
+    peak_rss_bytes = None
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    peak_rss_bytes = int(line.split()[1]) * 1024
+                    break
+    except OSError:
+        pass
+    if peak_rss_bytes is None:
+        import resource
+
+        peak_rss_bytes = (
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        )
+
     ttb = _time_to_block(Miner(backend=device), difficulty=20)
 
     # Host ingest plane (the serialization-side headline,
@@ -194,6 +217,7 @@ def main() -> None:
                 "load_avg_1m": load_1m,
                 "load_avg_5m": load_5m,
                 "cpu_count": os.cpu_count(),
+                "peak_rss_bytes": peak_rss_bytes,
                 "time_to_block_d20_s": round(ttb, 3),
                 "batch": device.batch,
                 **extra,

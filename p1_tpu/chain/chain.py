@@ -52,6 +52,7 @@ from typing import Iterator
 
 from p1_tpu.core.block import Block, merkle_branch
 from p1_tpu.core.genesis import make_genesis
+from p1_tpu.core.header import BlockHeader
 from p1_tpu.core.retarget import RetargetRule
 from p1_tpu.chain.ledger import Ledger, LedgerError
 from p1_tpu.chain.proof import TxProof
@@ -109,9 +110,17 @@ class AddResult:
         return bool(self.added)
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(slots=True)
 class _Entry:
-    block: Block
+    """One indexed block.  ``header`` is ALWAYS resident (fork choice,
+    difficulty schedules, and locators need it); ``block`` may be evicted
+    to ``None`` once the body is safely refetchable from the chain's
+    ``body_source`` (memory-bounded operation — ``evict_bodies``).
+    Slots: there is one of these per block FOREVER — the per-instance
+    dict would be a ~200-byte O(chain) RAM term all by itself."""
+
+    block: Block | None
+    header: "BlockHeader"
     height: int
     work: int  # cumulative, including this block
 
@@ -135,9 +144,25 @@ class Chain:
         )
         ghash = self.genesis.block_hash()
         self._index: dict[bytes, _Entry] = {
-            ghash: _Entry(self.genesis, 0, 1 << difficulty)
+            ghash: _Entry(self.genesis, self.genesis.header, 0, 1 << difficulty)
         }
         self._tip_hash = ghash
+        #: Memory-bounded operation (node/governor.py): an object with
+        #: ``has_body(bhash)`` / ``read_body(bhash)`` — the ChainStore —
+        #: that can re-serve an evicted block body on demand.  None (the
+        #: default) keeps every body resident and ``evict_bodies`` a
+        #: no-op, exactly the pre-governor behavior.
+        self.body_source = None
+        #: Serialized bytes of the bodies currently resident (genesis
+        #: excluded — never evicted), the big term in the node's memory
+        #: gauge; plus eviction/refetch telemetry.
+        self.resident_body_bytes = 0
+        self.bodies_evicted = 0
+        self.body_refetches = 0
+        #: Insertion-ordered candidates for body eviction (≈ height
+        #: order).  Entries already evicted or de-indexed are skipped on
+        #: the sweep, so the deque stays O(resident bodies).
+        self._resident_fifo: collections.deque[bytes] = collections.deque()
         #: Main-chain hashes by height (``_main_hashes[h]`` is the height-h
         #: block).  Kept in sync on every tip move so sync serving
         #: (``blocks_after``) and ``_on_main_chain`` are O(1) per block
@@ -155,8 +180,12 @@ class Chain:
         #: Contextually invalid blocks (overdraw somewhere in their history)
         #: + why.  Membership is permanent; descendants inherit it.
         self._invalid: dict[bytes, str] = {}
-        #: parent hash -> child hashes, for invalidating indexed subtrees.
-        self._children: dict[bytes, list[bytes]] = {}
+        #: parent hash -> child hash(es), for invalidating indexed
+        #: subtrees.  Value is the bare child hash (shared with the index
+        #: key — zero extra allocation) in the universal one-child case,
+        #: widening to a list only at a real fork: one list shell per
+        #: block would be a ~9 MB O(chain) RAM term at 100k blocks.
+        self._children: dict[bytes, bytes | list[bytes]] = {}
         #: txid -> containing main-chain block hash, maintained with every
         #: tip move (like the ledger) so SPV proof serving is O(block), not
         #: O(chain).  Main chain only: side-branch confirmations are not
@@ -169,7 +198,7 @@ class Chain:
 
     @property
     def tip(self) -> Block:
-        return self._index[self._tip_hash].block
+        return self._block_at(self._tip_hash)
 
     @property
     def tip_hash(self) -> bytes:
@@ -186,8 +215,28 @@ class Chain:
         return len(self._index)
 
     def get(self, block_hash: bytes) -> Block | None:
+        if block_hash not in self._index:
+            return None
+        return self._block_at(block_hash)
+
+    def header_of(self, block_hash: bytes) -> BlockHeader | None:
+        """The indexed block's header — always resident, so queries that
+        only need header fields never cost a body refetch."""
         entry = self._index.get(block_hash)
-        return entry.block if entry else None
+        return entry.header if entry else None
+
+    def _block_at(self, block_hash: bytes) -> Block:
+        """The full block for an INDEXED hash, refetching an evicted body
+        from ``body_source`` on demand.  Refetches are transient — the
+        body is NOT re-cached into the index, so serving deep history to
+        a syncing peer cannot silently re-grow the working set the
+        eviction sweep just bounded."""
+        entry = self._index[block_hash]
+        if entry.block is not None:
+            return entry.block
+        block = self.body_source.read_body(block_hash)
+        self.body_refetches += 1
+        return block
 
     def height_of(self, block_hash: bytes) -> int:
         return self._index[block_hash].height
@@ -205,7 +254,7 @@ class Chain:
         best = self._index[best_hash]
         for bhash, entry in self._index.items():
             if (
-                entry.block.header.timestamp > ts_bound
+                entry.header.timestamp > ts_bound
                 or bhash in self._invalid
             ):
                 # Invalid branches keep their index entries (permanent
@@ -220,7 +269,7 @@ class Chain:
                 entry.work == best.work and bhash < best_hash
             ):
                 best, best_hash = entry, bhash
-        return best.block
+        return self._block_at(best_hash)
 
     def balance(self, account: str) -> int:
         """``account``'s balance at the current tip (consensus ledger) —
@@ -259,15 +308,16 @@ class Chain:
             return self.difficulty
         height = prev.height + 1
         if height % rule.window != 0:
-            return prev.block.header.difficulty
+            return prev.header.difficulty
         # Window boundary: observe the span of the closing window (its
         # first block is `window-1` parents above `prev`; the walk is
-        # O(window) once per window, amortized O(1)/block).
+        # O(window) once per window, amortized O(1)/block — and headers
+        # are always resident, so it never refetches).
         anchor = prev
         for _ in range(rule.window - 1):
-            anchor = self._index[anchor.block.header.prev_hash]
-        span = prev.block.header.timestamp - anchor.block.header.timestamp
-        return rule.adjusted(prev.block.header.difficulty, span)
+            anchor = self._index[anchor.header.prev_hash]
+        span = prev.header.timestamp - anchor.header.timestamp
+        return rule.adjusted(prev.header.difficulty, span)
 
     def fee_stats(self, window: int = 32) -> dict:
         """Fee percentiles over the transfers confirmed in the last
@@ -283,7 +333,9 @@ class Chain:
             if entry.height == 0:
                 break  # genesis anchors, it does not sample
             blocks += 1
-            fees.extend(tx.fee for tx in entry.block.txs if not tx.is_coinbase)
+            fees.extend(
+                tx.fee for tx in self._block_at(h).txs if not tx.is_coinbase
+            )
         fees.sort()
 
         def pct(p: float) -> int:
@@ -307,11 +359,12 @@ class Chain:
         if bhash is None:
             return None
         entry = self._index[bhash]
-        txids = [tx.txid() for tx in entry.block.txs]
+        block = self._block_at(bhash)
+        txids = [tx.txid() for tx in block.txs]
         index = txids.index(txid)
         return TxProof(
-            tx=entry.block.txs[index],
-            header=entry.block.header,
+            tx=block.txs[index],
+            header=block.header,
             height=entry.height,
             tip_height=self.height,
             index=index,
@@ -321,7 +374,7 @@ class Chain:
     def main_chain(self) -> Iterator[Block]:
         """Genesis-first iteration of the current best chain."""
         for h in self._main_hashes:
-            yield self._index[h].block
+            yield self._block_at(h)
 
     def locator(self, dense: int = 10) -> list[bytes]:
         """Hashes from tip back to genesis: the last ``dense`` blocks one by
@@ -342,7 +395,7 @@ class Chain:
                 break
         end = min(start_height + limit, len(self._main_hashes))
         return [
-            self._index[self._main_hashes[i]].block
+            self._block_at(self._main_hashes[i])
             for i in range(start_height, end)
         ]
 
@@ -443,7 +496,7 @@ class Chain:
         # no reorg walk needed).  Same semantics as the general loop
         # below for this shape, including the invalid-branch fallback.
         if self._tip_hash != old_tip:
-            candidate = self._index[self._tip_hash].block
+            candidate = self._block_at(self._tip_hash)
             if candidate.header.prev_hash == old_tip:
                 try:
                     self._ledger.apply_block(candidate)
@@ -485,7 +538,8 @@ class Chain:
                 continue
             self._invalid[h] = why
             pending.extend(
-                (c, "descends from invalid block") for c in self._children.get(h, [])
+                (c, "descends from invalid block")
+                for c in self._children_of(h)
             )
 
     def _best_valid_tip(self) -> bytes:
@@ -531,7 +585,7 @@ class Chain:
             # verifier and the miner's clamp.
             reason = self.retarget.timestamp_violation(
                 prev.height,
-                prev.block.header.timestamp,
+                prev.header.timestamp,
                 block.header.timestamp,
             )
             if reason is not None:
@@ -546,10 +600,25 @@ class Chain:
             except ValidationError as e:
                 return AddStatus.REJECTED, str(e)
         entry = _Entry(
-            block, prev.height + 1, prev.work + (1 << block.header.difficulty)
+            block,
+            block.header,
+            prev.height + 1,
+            prev.work + (1 << block.header.difficulty),
         )
         self._index[bhash] = entry
-        self._children.setdefault(block.header.prev_hash, []).append(bhash)
+        # Body residency accounting (memory-bounded operation): the
+        # serialized length is a cached-bytes len for wire/disk-ingested
+        # blocks (encoding cache) and needed for store/gossip anyway for
+        # local ones — the gauge costs the hot path nothing.
+        self.resident_body_bytes += len(block.serialize())
+        self._resident_fifo.append(bhash)
+        kids = self._children.get(block.header.prev_hash)
+        if kids is None:
+            self._children[block.header.prev_hash] = bhash
+        elif isinstance(kids, bytes):
+            self._children[block.header.prev_hash] = [kids, bhash]
+        else:
+            kids.append(bhash)
         if block.header.prev_hash in self._invalid:
             # An extension of an invalid branch is invalid by inheritance —
             # index it (dedup/duplicate detection) but never offer it as tip.
@@ -562,7 +631,60 @@ class Chain:
             self._tip_hash = bhash
         return AddStatus.ACCEPTED, ""
 
+    # -- memory-bounded operation (body eviction) -------------------------
+
+    def evict_bodies(self, keep_recent: int) -> int:
+        """Evict block bodies below the keep window, keeping headers and
+        every index structure intact; returns bytes freed.
+
+        Eviction policy, not correctness: only bodies the ``body_source``
+        can re-serve (``has_body`` — i.e. durably in the append-only
+        store) are dropped, and the last ``keep_recent`` heights stay hot
+        (the tip region serves gossip, reorgs, and mining; deep history
+        serves only the occasional IBD peer, which can afford the
+        refetch).  Side branches below the window evict on the same
+        terms.  The sweep walks the insertion-ordered candidate deque,
+        so repeated calls cost O(resident), not O(index)."""
+        if self.body_source is None or keep_recent < 1:
+            return 0
+        floor = self.height - keep_recent
+        freed = 0
+        keep: collections.deque[bytes] = collections.deque()
+        while self._resident_fifo:
+            bhash = self._resident_fifo.popleft()
+            entry = self._index.get(bhash)
+            if entry is None or entry.block is None:
+                continue  # stale candidate (already evicted)
+            if entry.height > floor or not self.body_source.has_body(bhash):
+                keep.append(bhash)  # hot window, or not yet durable
+                continue
+            blen = len(entry.block.serialize())
+            entry.block = None
+            try:
+                # The header's memoized 80-byte encoding goes with the
+                # body: repacking is byte-identical (canonical fixed
+                # width, tested) and deep-history header serves are rare
+                # — another ~113 B/block the evicted region doesn't pin.
+                object.__delattr__(entry.header, "_raw")
+            except AttributeError:
+                pass
+            self.resident_body_bytes -= blen
+            self.bodies_evicted += 1
+            freed += blen
+        self._resident_fifo = keep
+        return freed
+
     # -- internals -------------------------------------------------------
+
+    def _children_of(self, bhash: bytes) -> tuple[bytes, ...]:
+        """``bhash``'s indexed children, normalized over the compact
+        one-child representation."""
+        kids = self._children.get(bhash)
+        if kids is None:
+            return ()
+        if isinstance(kids, bytes):
+            return (kids,)
+        return tuple(kids)
 
     def _park_orphan(self, block: Block, bhash: bytes) -> tuple[AddStatus, str]:
         """Hold a parentless block until its parent arrives — safely.
@@ -631,14 +753,14 @@ class Chain:
         removed: list[Block] = []
         added: list[Block] = []
         while self._index[a].height > self._index[b].height:
-            removed.append(self._index[a].block)
-            a = self._index[a].block.header.prev_hash
+            removed.append(self._block_at(a))
+            a = self._index[a].header.prev_hash
         while self._index[b].height > self._index[a].height:
-            added.append(self._index[b].block)
-            b = self._index[b].block.header.prev_hash
+            added.append(self._block_at(b))
+            b = self._index[b].header.prev_hash
         while a != b:
-            removed.append(self._index[a].block)
-            added.append(self._index[b].block)
-            a = self._index[a].block.header.prev_hash
-            b = self._index[b].block.header.prev_hash
+            removed.append(self._block_at(a))
+            added.append(self._block_at(b))
+            a = self._index[a].header.prev_hash
+            b = self._index[b].header.prev_hash
         return tuple(removed), tuple(reversed(added))

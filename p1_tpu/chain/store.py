@@ -41,7 +41,7 @@ from typing import Iterator
 
 from p1_tpu.chain.chain import AddStatus, Chain
 from p1_tpu.core.block import Block
-from p1_tpu.core.header import HEADER_SIZE
+from p1_tpu.core.header import HEADER_SIZE, BlockHeader
 
 _LEN = struct.Struct(">I")
 _CRC = struct.Struct(">I")
@@ -65,6 +65,13 @@ _OLD_MAGICS = (b"P1TPUCHN",)
 #: recovering framing past a corrupt span stays near-linear instead of
 #: O(file_size x record_size).
 _MAX_RECORD = 32 << 20
+
+#: Body spans are packed ``(offset << _SPAN_SHIFT) | length`` into ONE
+#: int per block: the span index is an O(chain) RAM structure, and a
+#: small int (~36 B) beats a tuple of two (~116 B) by ~8 MB at 100k
+#: blocks.  26 bits holds any length ≤ _MAX_RECORD (= 2**25) inclusive.
+_SPAN_SHIFT = 26
+assert _MAX_RECORD < (1 << _SPAN_SHIFT)
 
 
 def fsync_dir(path: str | os.PathLike) -> None:
@@ -138,6 +145,18 @@ class ChainStore:
             "quarantined_bytes": 0,
             "truncated_bytes": 0,
         }
+        #: block hash -> (payload offset, length), populated by the read
+        #: paths and by ``append`` — the offset index behind on-demand
+        #: body refetch (``read_body``), which is what lets the chain
+        #: evict block bodies from RAM (memory-bounded operation).
+        self._body_spans: dict[bytes, int] = {}
+        #: File offset the NEXT append lands at, maintained so appends can
+        #: register their span without a stat per record.  None = unknown
+        #: (not yet acquired, or a failed write left the tail unknowable —
+        #: spans stop being registered until re-acquire, which only costs
+        #: evictability of post-incident blocks, never correctness).
+        self._append_off: int | None = None
+        self._read_fd: int | None = None
 
     # -- file-layer seams (FaultStore overrides these) --------------------
 
@@ -237,6 +256,10 @@ class ChainStore:
                 fh.close()
                 raise RuntimeError(str(e)) from e
         self._fh = fh
+        try:
+            self._append_off = self.path.stat().st_size
+        except OSError:
+            self._append_off = None
 
     def _heal_rebuild(self, data: bytes, scan: StoreScan) -> None:
         """Quarantine ``scan.bad_spans`` to the sidecar, then atomically
@@ -296,8 +319,21 @@ class ChainStore:
         crc = zlib.crc32(raw, zlib.crc32(prefix))
         # One write per record: a torn append (crash, ENOSPC mid-write)
         # can tear at most THIS record, never desync an earlier one.
-        self._fh.write(prefix + raw + _CRC.pack(crc))
-        self._fh.flush()
+        try:
+            self._fh.write(prefix + raw + _CRC.pack(crc))
+            self._fh.flush()
+        except OSError:
+            # The tail may now hold a partial record, so the next append's
+            # offset is unknowable without a rescan: stop registering
+            # spans (post-incident blocks just stay unevictable until the
+            # next acquire re-derives clean framing).
+            self._append_off = None
+            raise
+        if self._append_off is not None:
+            self._body_spans[block.block_hash()] = (
+                (self._append_off + _LEN.size) << _SPAN_SHIFT
+            ) | len(raw)
+            self._append_off += _LEN.size + len(raw) + _CRC.size
         if self.fsync:
             self._fsync_file(self._fh)
 
@@ -315,6 +351,10 @@ class ChainStore:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+        self._append_off = None
+        if self._read_fd is not None:
+            os.close(self._read_fd)
+            self._read_fd = None
 
     # -- the framing walk -------------------------------------------------
 
@@ -441,13 +481,80 @@ class ChainStore:
         caches with the record's exact bytes, so resume never re-packs —
         ``add_block``'s hashing, the ledger's txids, and any later relay
         all reuse the disk bytes (docs/PERF.md "Restart at scale")."""
+        return list(self.iter_blocks())
+
+    def iter_blocks(self):
+        """Streaming form of ``load_blocks``: one block at a time, never
+        the whole object list at once — what memory-bounded resume
+        iterates (``load_chain(..., body_cache=N)`` evicts as it goes, so
+        peak RSS is bounded by the keep window, not the chain length).
+        Registers each record's span in the body index as a side effect.
+
+        The whole-file buffer is needed for the checksum walk but is
+        RELEASED before the object build: records are re-read per span
+        (pread against the page cache the scan just warmed), so the
+        build phase — where the per-block index objects accumulate —
+        never also carries an O(chain) byte buffer.  At 100k blocks that
+        is ~24 MB off the resume's peak RSS (docs/PERF.md
+        "Memory-bounded operation")."""
         if not self.path.exists():
-            return []
+            return
         data = self._read_checked()
-        return [
-            Block.deserialize(data[off : off + n])
-            for off, n in self._record_spans(data)
-        ]
+        spans = list(self._record_spans(data))
+        del data
+        if self._read_fd is None:
+            self._read_fd = os.open(self.path, os.O_RDONLY)
+        for off, n in spans:
+            raw = os.pread(self._read_fd, n, off)
+            if len(raw) != n:
+                raise OSError(f"{self.path}: short record read at {off}")
+            block = Block.deserialize(raw)
+            self._body_spans[block.block_hash()] = (off << _SPAN_SHIFT) | n
+            yield block
+
+    def first_difficulty(self) -> int | None:
+        """The difficulty the first stored record declares (every block
+        carries the chain difficulty), or None for an empty store —
+        the streaming-resume path's pre-check, which must not
+        materialize the block list just to read one header field."""
+        if not self.path.exists():
+            return None
+        data = self._read_checked()
+        for off, _ in self._record_spans(data):
+            return BlockHeader.deserialize(
+                data[off : off + HEADER_SIZE]
+            ).difficulty
+        return None
+
+    # -- body refetch (memory-bounded operation) ---------------------------
+
+    def has_body(self, block_hash: bytes) -> bool:
+        """True when ``read_body`` can re-serve this block — the chain's
+        eviction gate: only durably refetchable bodies leave RAM."""
+        return block_hash in self._body_spans
+
+    def read_body(self, block_hash: bytes) -> Block:
+        """Re-read one block straight from its record span (pread — no
+        shared seek state with the appender; the writer flushes every
+        record, so the bytes are page-cache-visible the moment the span
+        exists).  The deserialize seeds the block's encoding caches with
+        the disk bytes, so a refetched body re-serves/re-hashes at the
+        zero-repack rate; the hash check pins the span map itself —
+        a mismatch is a store-layer bug, not peer input, so it raises."""
+        span = self._body_spans[block_hash]
+        off, n = span >> _SPAN_SHIFT, span & ((1 << _SPAN_SHIFT) - 1)
+        if self._read_fd is None:
+            self._read_fd = os.open(self.path, os.O_RDONLY)
+        raw = os.pread(self._read_fd, n, off)
+        if len(raw) != n:
+            raise OSError(f"{self.path}: short body read at {off}")
+        block = Block.deserialize(raw)
+        if block.block_hash() != block_hash:
+            raise ValueError(
+                f"{self.path}: body span for {block_hash.hex()[:16]} "
+                "re-read as a different block"
+            )
+        return block
 
     def packed_headers(self) -> tuple[bytes, int]:
         """(buffer, count): every record's 80-byte header, contiguous, cut
@@ -472,6 +579,7 @@ class ChainStore:
         blocks: list[Block] | None = None,
         retarget=None,
         trusted: bool = False,
+        body_cache: int = 0,
     ) -> Chain:
         """Rebuild a validated chain from the log (skipping the genesis
         record, which the Chain constructor provides).  Pass ``blocks``
@@ -506,15 +614,32 @@ class ChainStore:
         the record bytes, so the per-block hashing that ``add_block`` and
         the ledger need digests the disk bytes directly — no
         re-serialization anywhere in the resume loop (measured in
-        benchmarks/host_ingest.py, recorded in docs/PERF.md)."""
+        benchmarks/host_ingest.py, recorded in docs/PERF.md).
+
+        ``body_cache=N`` (memory-bounded resume) wires the chain's body
+        refetch to THIS store and streams the log through periodic body
+        eviction, so peak RSS is bounded by the keep window instead of
+        the whole chain's object graph — the governor's memory-bounded
+        operation starts at boot, not after it (docs/PERF.md
+        "Memory-bounded operation")."""
         chain = Chain(difficulty, retarget=retarget)
+        if body_cache > 0:
+            chain.body_source = self
         ghash = chain.genesis.block_hash()
         saw_record = False
-        for block in self.load_blocks() if blocks is None else blocks:
+        if blocks is None:
+            blocks = self.iter_blocks() if body_cache > 0 else self.load_blocks()
+        seen = 0
+        for block in blocks:
             if block.block_hash() == ghash:
                 continue
             saw_record = True
             chain.add_block(block, trusted=trusted)
+            seen += 1
+            if body_cache > 0 and seen % 1024 == 0:
+                chain.evict_bodies(body_cache)
+        if body_cache > 0:
+            chain.evict_bodies(body_cache)
         if saw_record and not chain.height:
             raise ValueError(
                 f"{self.path}: records do not connect to this chain's "

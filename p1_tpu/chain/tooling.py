@@ -1,0 +1,340 @@
+"""Offline store maintenance: the `p1 compact` and `p1 fsck` engines.
+
+Extracted from ``cli.py`` (which keeps only parsing + dispatch).  Both
+commands keep their CLI contract exactly: JSON report on stdout, human
+diagnostics on stderr, and the documented exit codes (`p1 fsck`: 0 clean
+/ 1 salvaged / 2 unrecoverable; `p1 compact`: 0 ok / 2 refused / 3
+snapshot self-check failed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def load_store(
+    path: str, expected_difficulty: int | None = None, retarget=None
+):
+    """(blocks, chain) from a persisted store, difficulty inferred from the
+    records (every block declares the chain difficulty — validation
+    enforces it — so the store is self-describing; the retarget rule is
+    NOT, so retarget chains need their flags).  Raises SystemExit 2 for an
+    empty/missing store, an ``expected_difficulty`` mismatch, or records
+    that do not connect to the selected genesis (wrong retarget flags)."""
+    from p1_tpu.chain import ChainStore
+
+    store = ChainStore(path)
+    try:
+        blocks = store.load_blocks()
+    finally:
+        store.close()
+    if not blocks:
+        print(f"{path}: empty or missing chain store", file=sys.stderr)
+        raise SystemExit(2)
+    stored = blocks[0].header.difficulty
+    if expected_difficulty is not None and expected_difficulty != stored:
+        # A wrong flag would otherwise silently yield an empty chain.
+        print(
+            f"--difficulty {expected_difficulty} does not match the store's "
+            f"chain (difficulty {stored})",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    try:
+        chain = store.load_chain(stored, blocks, retarget=retarget)
+    except ValueError as e:  # none-connected guard (store.py)
+        print(str(e), file=sys.stderr)
+        raise SystemExit(2)
+    return blocks, chain
+
+
+def run_balances(
+    store_path: str,
+    account: str | None,
+    expected_difficulty: int | None = None,
+    retarget=None,
+) -> int:
+    """`p1 balances`: account balances from a persisted chain, plus the
+    offline conservation audit when no single account is selected."""
+    from p1_tpu.chain import balances
+
+    _, chain = load_store(
+        store_path, expected_difficulty, retarget=retarget
+    )
+    ledger = balances(chain.main_chain())
+    if account is not None:
+        print(
+            json.dumps(
+                {
+                    "config": "balances",
+                    "height": chain.height,
+                    "account": account,
+                    "balance": ledger.get(account, 0),
+                }
+            )
+        )
+        return 0
+    # Offline audit: the store loads through full consensus validation, so
+    # the view must agree with the incremental ledger, hold nothing
+    # negative, and conserve exactly — total = coinbase minted minus the
+    # fees burned by the rare coinbase-less blocks.  A False here means a
+    # corrupted store or a consensus bug — surface it in the exit code.
+    minted = burned = 0
+    for b in chain.main_chain():
+        if b.txs and b.txs[0].is_coinbase:
+            minted += b.txs[0].amount
+        else:
+            burned += sum(t.fee for t in b.txs)
+    conserved = (
+        sum(ledger.values()) == minted - burned
+        and all(v >= 0 for v in ledger.values())
+        and {a: v for a, v in ledger.items() if v} == chain.balances_snapshot()
+    )
+    print(
+        json.dumps(
+            {
+                "config": "balances",
+                "height": chain.height,
+                "conserved": conserved,
+                "balances": dict(sorted(ledger.items())),
+            }
+        )
+    )
+    return 0 if conserved else 1
+
+
+def run_compact(store_path: str, out_path: str | None, retarget=None) -> int:
+    """Store maintenance: the append-only log keeps every side branch and
+    reorged-away block forever (that's what makes restarts deterministic);
+    compaction snapshots just the current main branch, shrinking the file
+    while resume behavior for the surviving chain is unchanged."""
+    from p1_tpu.chain import ChainStore, save_chain
+
+    if not os.path.exists(store_path):
+        print(f"{store_path}: empty or missing chain store", file=sys.stderr)
+        return 2
+    # Lock FIRST, then load: records appended between an unlocked read and
+    # the rewrite would be silently dropped, and replacing the inode under
+    # a live node would orphan everything it appends afterwards.
+    src = ChainStore(store_path)
+    try:
+        try:
+            # allow_v2: compaction IS the upgrade path for pre-checksum
+            # stores (the snapshot below is written in v3 framing).
+            src.acquire(allow_v2=True)
+        except RuntimeError as e:
+            print(f"{e} — stop it before compacting", file=sys.stderr)
+            return 2
+        blocks = src.load_blocks()
+        if not blocks:
+            print(f"{store_path}: empty chain store", file=sys.stderr)
+            return 2
+        try:
+            chain = src.load_chain(
+                blocks[0].header.difficulty,
+                blocks,
+                retarget=retarget,
+            )
+        except ValueError as e:
+            # Without this, compacting a retarget store with forgotten
+            # flags would REPLACE it with a genesis-only snapshot of the
+            # wrong chain — the one unrecoverable failure mode here.
+            print(str(e), file=sys.stderr)
+            return 2
+        before = os.path.getsize(store_path)
+        out = out_path or store_path
+        dst = None
+        if out_path and os.path.realpath(out) != os.path.realpath(store_path):
+            # The destination needs the same in-use guard: replacing it
+            # would orphan a live node's inode there.
+            dst = ChainStore(out)
+            try:
+                dst.acquire()
+            except RuntimeError as e:
+                print(f"{e} — stop it before overwriting", file=sys.stderr)
+                return 2
+        else:
+            out = store_path
+        try:
+            # Always write a sibling temp file and atomically replace, so
+            # a crash mid-write can never leave EITHER path deleted or
+            # truncated.
+            tmp = f"{out}.compact.{os.getpid()}"
+            save_chain(chain, tmp)
+            # Prove the snapshot BEFORE it replaces the original: the
+            # main branch is linear, so its packed headers verify (PoW +
+            # linkage + difficulty) in one native call straight off the
+            # bytes just written — a torn or miswritten snapshot can
+            # never clobber a good log.
+            from p1_tpu.chain import replay_packed
+
+            raw_headers, n_headers = ChainStore(tmp).packed_headers()
+            snap = replay_packed(raw_headers, retarget=retarget)
+            if not snap.valid:
+                os.unlink(tmp)
+                print(
+                    f"snapshot self-check failed at record "
+                    f"{snap.first_invalid} of {n_headers} — original store "
+                    "left untouched",
+                    file=sys.stderr,
+                )
+                return 3
+            os.replace(tmp, out)
+            # The rename itself must survive a metadata-journal loss:
+            # save_chain fsynced the tmp's data and directory entry, but
+            # the replace is a second directory mutation.
+            from p1_tpu.chain.store import fsync_dir
+
+            fsync_dir(os.path.dirname(os.path.abspath(out)))
+        finally:
+            if dst is not None:
+                dst.close()
+    finally:
+        src.close()
+    print(
+        json.dumps(
+            {
+                "config": "compact",
+                "height": chain.height,
+                "records_before": len(blocks),
+                "records_after": chain.height + 1,
+                "bytes_before": before,
+                "bytes_after": os.path.getsize(out),
+                "out": out,
+            }
+        )
+    )
+    return 0
+
+
+def run_fsck(store_path: str, out_path: str | None) -> int:
+    """Offline store integrity scan + salvage (the disk counterpart of
+    Bitcoin's -checkblocks/salvagewallet tooling).  Exit contract:
+
+    - **0 clean** — every record checksum-valid, nothing rewritten (a
+      lossless v2→v3 upgrade also exits 0: no information was lost);
+    - **1 salvaged** — corruption or a torn tail was found; every
+      checksum-valid record was rewritten into a fresh verified store,
+      bad spans quarantined to the ``.quarantine`` sidecar;
+    - **2 unrecoverable** — missing/empty/locked store, unrecognizable
+      magic, or zero salvageable records.
+
+    Unlike ``p1 compact`` this preserves insertion order and side
+    branches (it salvages the LOG, not the main branch), so the
+    self-check is framing-level — every salvaged record re-reads
+    checksum-valid and byte-identical — rather than the linear-chain
+    ``replay_packed`` proof compaction can afford."""
+    import struct
+
+    from p1_tpu.chain import ChainStore
+    from p1_tpu.chain.store import fsync_dir
+    from p1_tpu.core.block import Block
+
+    if not os.path.exists(store_path) or os.path.getsize(store_path) == 0:
+        print(f"{store_path}: empty or missing chain store", file=sys.stderr)
+        return 2
+    store = ChainStore(store_path)
+    try:
+        try:
+            # Lock first (a live node's in-flight appends must not race
+            # the rewrite), scan without healing: fsck owns the salvage
+            # decision and must report BEFORE mutating.
+            store.acquire(allow_v2=True, heal=False)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        data = store._read_bytes()
+        scan = store.scan(data)
+        report = {
+            "config": "fsck",
+            "store": store_path,
+            "version": scan.version,
+            "records_valid": len(scan.spans),
+            "bad_spans": len(scan.bad_spans),
+            "bytes_quarantined": scan.quarantined_bytes,
+            "torn_tail_bytes": (
+                scan.size - scan.torn_tail if scan.torn_tail is not None else 0
+            ),
+        }
+        if scan.version == 3 and scan.clean:
+            print(json.dumps({**report, "status": "clean"}))
+            return 0
+
+        # Salvage: every checksum-valid record that still parses as a
+        # block, in original insertion order, into a fresh v3 store.
+        blocks, parse_failures = [], 0
+        for off, n in scan.spans:
+            try:
+                blocks.append(Block.deserialize(data[off : off + n]))
+            except ValueError:
+                parse_failures += 1
+        report["parse_failures"] = parse_failures
+        if not blocks:
+            print(
+                json.dumps({**report, "status": "unrecoverable"}),
+            )
+            print(
+                f"{store_path}: no salvageable records", file=sys.stderr
+            )
+            return 2
+        if scan.bad_spans:
+            # Evidence first, durably, before the original bytes go away.
+            qpath = store.quarantine_path()
+            with open(qpath, "ab") as qf:
+                for s, e in scan.bad_spans:
+                    qf.write(struct.pack(">QI", s, e - s))
+                    qf.write(data[s:e])
+                qf.flush()
+                os.fsync(qf.fileno())
+            report["quarantine"] = str(qpath)
+        out = out_path or store_path
+        tmp = f"{out}.fsck.{os.getpid()}"
+        dst = ChainStore(tmp, fsync=False)
+        try:
+            for block in blocks:
+                dst.append(block)
+            dst.sync()
+            dst._fsync_dir()
+        finally:
+            dst.close()
+        # Self-check BEFORE the replace: the fresh store must re-scan
+        # clean with every record byte-identical to what was salvaged —
+        # a miswritten salvage must never clobber the evidence.
+        vdata = ChainStore(tmp)._read_checked()
+        vscan = ChainStore.scan(vdata)
+        ok = (
+            vscan.version == 3
+            and vscan.clean
+            and len(vscan.spans) == len(blocks)
+            and all(
+                vdata[off : off + n] == block.serialize()
+                for (off, n), block in zip(vscan.spans, blocks)
+            )
+        )
+        if not ok:
+            os.unlink(tmp)
+            print(
+                "salvage self-check failed — original store left untouched",
+                file=sys.stderr,
+            )
+            return 2
+        os.replace(tmp, out)
+        fsync_dir(os.path.dirname(os.path.abspath(out)))
+        lossless = (
+            not scan.bad_spans
+            and scan.torn_tail is None
+            and not parse_failures
+        )
+        report.update(
+            {
+                "records_salvaged": len(blocks),
+                "out": out,
+                "status": "upgraded" if lossless else "salvaged",
+            }
+        )
+        print(json.dumps(report))
+        return 0 if lossless else 1
+    finally:
+        store.close()

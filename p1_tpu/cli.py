@@ -20,7 +20,6 @@ import argparse
 import asyncio
 import json
 import logging
-import os
 import statistics
 import sys
 import time
@@ -235,6 +234,44 @@ def build_parser() -> argparse.ArgumentParser:
         "no-progress re-issues before the node stops chasing and waits "
         "for a fresh sync trigger (progress resets the budget)",
     )
+    p.add_argument(
+        "--mem-watermark-mb",
+        type=float,
+        default=0.0,
+        help="overload high watermark in MB on the node's accounted "
+        "memory gauge (resident chain bodies + pending pool + peer "
+        "write buffers): above it the node SHEDs low-priority gossip "
+        "and mempool pages and pauses mining while consensus-critical "
+        "headers/blocks/proof service keeps running; back to NORMAL "
+        "below 80%% of the mark (0 = no shedding)",
+    )
+    p.add_argument(
+        "--body-cache",
+        type=int,
+        default=0,
+        help="memory-bounded operation: keep only the last N main-chain "
+        "block BODIES resident (headers/metadata always stay), evicting "
+        "older bodies once durably in the store and refetching on "
+        "demand — bounds RSS at O(N) instead of O(chain); 0 = fully "
+        "resident (requires --store)",
+    )
+    p.add_argument(
+        "--no-admission-control",
+        action="store_true",
+        help="disable the per-peer blocks/txs/queries admission budgets "
+        "(on by default; the budgets sit far above honest rates and "
+        "only clip protocol-valid floods)",
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
+        "status",
+        help="query a running node's status JSON (height, peers, sync/"
+        "storage/overload state) over the wire",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
     _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
@@ -707,98 +744,6 @@ def cmd_replay(args) -> int:
 # -- node ----------------------------------------------------------------
 
 
-async def _run_node(args, miner=None) -> int:
-    from p1_tpu.config import NodeConfig
-    from p1_tpu.node import Node
-
-    config = NodeConfig(
-        difficulty=args.difficulty,
-        backend=args.backend,
-        host=args.host,
-        port=args.port,
-        peers=tuple(args.peers),
-        mine=not args.no_mine,
-        store_path=args.store,
-        batch=args.batch,
-        chunk=args.chunk,
-        miner_id=args.miner_id,
-        # getattr: `p1 pod` reuses this runner with its own arg namespace,
-        # which has no retarget or compact-gossip flags (pod mining is
-        # fixed-difficulty — config 5's shape).
-        retarget_window=getattr(args, "retarget_window", 0),
-        target_spacing=getattr(args, "target_spacing", 0),
-        compact_gossip=not getattr(args, "no_compact_gossip", False),
-        target_peers=getattr(args, "target_peers", 0),
-        mempool_ttl_s=getattr(args, "mempool_ttl", 3600.0),
-        handshake_timeout_s=getattr(args, "handshake_timeout", 10.0),
-        ping_interval_s=getattr(args, "ping_interval", 60.0),
-        pong_timeout_s=getattr(args, "pong_timeout", 20.0),
-        sync_stall_timeout_s=getattr(args, "sync_stall_timeout", 10.0),
-        sync_attempts_max=getattr(args, "sync_attempts", 8),
-        revalidate_store=getattr(args, "revalidate_store", False),
-        store_degraded_exit=getattr(args, "store_degraded_exit", False),
-    )
-    node = Node(config, miner=miner)
-    await node.start()
-    # --store-degraded-exit watch: the node signals instead of exiting
-    # itself so teardown (final status line, mempool save, store close)
-    # still runs through the one path below.  Exit code 4.
-    fatal = asyncio.ensure_future(node.store_fatal.wait())
-    rc = 0
-    try:
-        if args.deadline is not None or args.duration is not None:
-            if args.deadline == "stdin":
-                print(json.dumps({"ready": node.port}), flush=True)
-                loop = asyncio.get_running_loop()
-                line = await loop.run_in_executor(None, sys.stdin.readline)
-                deadline = float(line.strip())
-            elif args.deadline is not None:
-                deadline = float(args.deadline)
-            else:
-                deadline = time.time() + args.duration
-            window = max(0.0, deadline - time.time())
-            logging.info("mining window: %.2fs until deadline", window)
-            await asyncio.wait({fatal}, timeout=window)
-            if fatal.done():
-                rc = 4
-            else:
-                # Quiesce: stop producing, then wait for the gossip
-                # backlog to drain (GIL-bound mining starves the event
-                # loop, so a fixed sleep can undershoot): exit once the
-                # chain has been stable for a full second, or after 20s
-                # regardless.
-                await node.stop_mining()
-                await node.request_sync()
-                t_end = time.monotonic() + 20.0
-                stable = (node.chain.tip_hash, node.metrics.blocks_accepted)
-                stable_since = time.monotonic()
-                while time.monotonic() < t_end:
-                    await asyncio.sleep(0.1)
-                    now_state = (
-                        node.chain.tip_hash,
-                        node.metrics.blocks_accepted,
-                    )
-                    if now_state != stable:
-                        stable, stable_since = now_state, time.monotonic()
-                        await node.request_sync()
-                    elif time.monotonic() - stable_since >= 1.0:
-                        break
-        else:
-            while True:
-                await asyncio.wait({fatal}, timeout=args.status_interval)
-                if fatal.done():
-                    rc = 4
-                    break
-                print(json.dumps(node.status()), flush=True)
-    except (KeyboardInterrupt, asyncio.CancelledError):
-        pass
-    finally:
-        fatal.cancel()
-        print(json.dumps(node.status()), flush=True)
-        await node.stop()
-    return rc
-
-
 def cmd_node(args) -> int:
     _retarget_rule(args)  # flag-pair validation: clean error, no traceback
     # The CPU miner thread is GIL-bound (hashlib holds the GIL for
@@ -813,10 +758,40 @@ def cmd_node(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    from p1_tpu.node.runner import run_node
+
     try:
-        return asyncio.run(_run_node(args))
+        return asyncio.run(run_node(args))
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_status(args) -> int:
+    """Query a running node's full status JSON (`p1 status`) — the same
+    object the node logs, served over the wire (GETSTATUS/STATUS, v9),
+    overload block included.  Works even while the node sheds load."""
+    from p1_tpu.node.client import get_status
+
+    try:
+        status = asyncio.run(
+            get_status(
+                args.host,
+                args.port,
+                args.difficulty,
+                retarget=_retarget_rule(args),
+            )
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"status query failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(status, indent=2, sort_keys=True))
+    return 0
 
 
 # -- tx ------------------------------------------------------------------
@@ -1216,109 +1191,6 @@ def cmd_keygen(args) -> int:
 # -- pod -----------------------------------------------------------------
 
 
-class _PodWatchdog:
-    """No-progress failsafe: a vanished pod peer leaves the survivor
-    blocked inside a collective forever (aborts can't unblock it, and
-    interpreter exit would hang on the executor join), so if no lockstep
-    point is reached for ``grace`` seconds the process fails over.
-    ``grace`` covers the longest LEGITIMATE inter-beat gap — the first
-    search's jit compile on a real mesh plus one chunk — independent of
-    run length (progress-based, not an absolute deadline).  Override with
-    ``P1_POD_GRACE_S`` (tests shrink it; operators can tune it).
-
-    On trip the watchdog runs ``on_trip`` — the LEADER re-execs itself
-    into a single-process ``p1 node`` against the same store and identity
-    (SURVEY §5 elastic recovery: mining degrades instead of going dark;
-    see ``cmd_pod``), while followers, whose chain state lives in the
-    leader, still just exit 3 for their external supervisor to restart.
-
-    ``beat()`` is a plain monotonic-timestamp store (the hot path runs it
-    per chunk); one long-lived daemon thread polls, instead of spawning a
-    Timer thread per beat.
-    """
-
-    _POLL_S = 1.0
-
-    def __init__(self, role: str, on_trip=None):
-        import threading
-
-        self.role = role
-        self.grace_s = float(os.environ.get("P1_POD_GRACE_S", "600"))
-        self._on_trip = on_trip
-        self._last = time.monotonic()
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._poll, daemon=True)
-        self._thread.start()
-
-    def beat(self) -> None:
-        self._last = time.monotonic()
-
-    def cancel(self) -> None:
-        self._stop.set()
-
-    def _poll(self) -> None:
-        while not self._stop.wait(self._POLL_S):
-            if time.monotonic() - self._last > self.grace_s:
-                logging.error(
-                    "pod watchdog (%s): no lockstep progress for %.0fs "
-                    "(peer lost?), failing over",
-                    self.role,
-                    self.grace_s,
-                )
-                if self._on_trip is not None:
-                    try:
-                        self._on_trip()
-                    except Exception:
-                        # A failed leader failover (os.execv can raise
-                        # ENOMEM/E2BIG, or the interpreter path vanished)
-                        # must still END the wedged process — the exit
-                        # code is the supervisor's only signal.
-                        logging.exception("pod failover failed")
-                os._exit(3)  # followers, or a failed on_trip
-
-
-def _pod_leader_failover(args, deadline: float) -> None:
-    """Degrade the pod leader to a single-process ``p1 node`` when a pod
-    peer vanishes (VERDICT r3 item 8 / SURVEY §5 elastic recovery).
-
-    ``os.execv`` replaces the wedged process image in place: the thread
-    stuck inside the dead collective, the jax.distributed client, and the
-    executor all go with it, while the pid (for the operator) and the
-    environment (JAX platform pins, XLA flags) survive.  The store's
-    writer flock is released automatically — Python opens files
-    close-on-exec — so the SAME process re-acquires the SAME store and
-    mining continues on the persisted chain with the same coinbase
-    identity and peer list, for the remainder of the original window.
-    Followers hold no chain state, so they still exit for their
-    supervisor (cmd_pod docstring documents the recipe).  A leader
-    configured with ``--port 0`` re-binds a fresh ephemeral port; pinned
-    ports are re-bound exactly (the old socket died with the exec).
-    """
-    argv = [
-        sys.executable, "-m", "p1_tpu", "node",
-        "--difficulty", str(args.difficulty),
-        "--backend", "sharded",  # local mesh only, no jax.distributed
-        "--host", args.host,
-        "--port", str(args.port),
-        "--duration", f"{max(5.0, deadline - time.time()):.1f}",
-    ]
-    if args.peers:
-        argv += ["--peers", *args.peers]
-    if args.miner_id:
-        argv += ["--miner-id", args.miner_id]
-    if args.store:
-        argv += ["--store", args.store]
-    if args.chunk:
-        argv += ["--chunk", str(args.chunk)]
-    if args.batch:
-        argv += ["--batch", str(args.batch)]
-    if args.platform:
-        argv += ["--platform", args.platform]
-    logging.error("pod leader failing over to solo mining: %s", " ".join(argv))
-    sys.stderr.flush()
-    os.execv(sys.executable, argv)
-
-
 def cmd_pod(args) -> int:
     """Multi-host mining (north star config 5, multi-host form): every
     process joins one jax.distributed mesh and mirrors the same sharded
@@ -1326,12 +1198,13 @@ def cmd_pod(args) -> int:
     whole pod presents as a single miner on the gossip network.
 
     Failure handling: each role arms a no-progress watchdog (bounded runs
-    only).  A follower that loses the pod exits 3 — restart it with the
-    same ``--host-id`` under any supervisor (systemd ``Restart=on-failure``,
-    a shell loop) once the pod coordinator is back.  The LEADER owns the
-    chain store and the gossip identity, so it does NOT go dark: the
-    watchdog re-execs it into single-process sharded mining against the
-    same store/port/peers (``_pod_leader_failover``) and the chain keeps
+    only; ``parallel/watchdog.py``).  A follower that loses the pod exits
+    3 (``POD_LOST_EXIT``) — restart it with the same ``--host-id`` under
+    any supervisor (systemd ``Restart=on-failure``, a shell loop) once
+    the pod coordinator is back.  The LEADER owns the chain store and
+    the gossip identity, so it does NOT go dark: the watchdog re-execs
+    it into single-process sharded mining against the same
+    store/port/peers (``pod_leader_failover``) and the chain keeps
     growing while the pod is rebuilt."""
     if args.platform:
         import jax
@@ -1339,6 +1212,11 @@ def cmd_pod(args) -> int:
         jax.config.update("jax_platforms", args.platform)
     from p1_tpu.hashx import get_backend
     from p1_tpu.parallel import PodMiner, init_distributed
+    from p1_tpu.parallel.watchdog import (
+        POD_LOST_EXIT,
+        PodWatchdog,
+        pod_leader_failover,
+    )
 
     init_distributed(args.coordinator, args.num_hosts, args.host_id)
     is_leader = args.host_id == 0
@@ -1350,9 +1228,9 @@ def cmd_pod(args) -> int:
     if args.duration is not None:
         deadline = time.time() + args.duration
         on_trip = (
-            (lambda: _pod_leader_failover(args, deadline)) if is_leader else None
+            (lambda: pod_leader_failover(args, deadline)) if is_leader else None
         )
-        watchdog = _PodWatchdog(
+        watchdog = PodWatchdog(
             role="leader" if is_leader else "follower", on_trip=on_trip
         )
     kwargs = {"batch": args.batch} if args.batch else {}
@@ -1377,871 +1255,71 @@ def cmd_pod(args) -> int:
     if watchdog is not None:
         miner.heartbeat = watchdog.beat
     if not is_leader:
-        mirrored = miner.follow()
+        try:
+            mirrored = miner.follow()
+        except Exception as e:
+            # Losing the pod mid-collective races two detectors: usually
+            # the survivor BLOCKS in the dead collective and the
+            # watchdog's no-progress trip exits 3 — but under host
+            # contention the runtime can instead RAISE out of the
+            # collective first, which used to end the process with a
+            # traceback and exit code 1.  Same event, same contract:
+            # exit POD_LOST_EXIT either way, so supervisors (and
+            # tests/test_pod.py) see one deterministic code.  os._exit,
+            # like every other pod death path: a normal return would
+            # hang in jax.distributed's atexit barrier.
+            import os
+
+            print(f"pod follower lost the mesh: {e}", file=sys.stderr, flush=True)
+            os._exit(POD_LOST_EXIT)
         if watchdog is not None:
             watchdog.cancel()
         print(json.dumps({"config": "pod", "role": "follower", "searches": mirrored}))
         return 0
-    args.backend = "sharded"  # for _run_node's NodeConfig (miner overrides)
+    args.backend = "sharded"  # for run_node's NodeConfig (miner overrides)
+    from p1_tpu.node.runner import run_node
+
     try:
-        return asyncio.run(_run_node(args, miner=miner))
+        return asyncio.run(run_node(args, miner=miner))
     finally:
         miner.shutdown()
         if watchdog is not None:
             watchdog.cancel()
 
 
-# -- balances ------------------------------------------------------------
-
-
-def _load_store(
-    path: str, expected_difficulty: int | None = None, retarget=None
-):
-    """(blocks, chain) from a persisted store, difficulty inferred from the
-    records (every block declares the chain difficulty — validation
-    enforces it — so the store is self-describing; the retarget rule is
-    NOT, so retarget chains need their flags).  Raises SystemExit 2 for an
-    empty/missing store, an ``expected_difficulty`` mismatch, or records
-    that do not connect to the selected genesis (wrong retarget flags)."""
-    from p1_tpu.chain import ChainStore
-
-    store = ChainStore(path)
-    try:
-        blocks = store.load_blocks()
-    finally:
-        store.close()
-    if not blocks:
-        print(f"{path}: empty or missing chain store", file=sys.stderr)
-        raise SystemExit(2)
-    stored = blocks[0].header.difficulty
-    if expected_difficulty is not None and expected_difficulty != stored:
-        # A wrong flag would otherwise silently yield an empty chain.
-        print(
-            f"--difficulty {expected_difficulty} does not match the store's "
-            f"chain (difficulty {stored})",
-            file=sys.stderr,
-        )
-        raise SystemExit(2)
-    try:
-        chain = store.load_chain(stored, blocks, retarget=retarget)
-    except ValueError as e:  # none-connected guard (store.py)
-        print(str(e), file=sys.stderr)
-        raise SystemExit(2)
-    return blocks, chain
+# -- balances (engine in chain/tooling.py) --------------------------------
 
 
 def cmd_balances(args) -> int:
-    from p1_tpu.chain import balances
+    from p1_tpu.chain.tooling import run_balances
 
-    blocks, chain = _load_store(
-        args.store, args.difficulty, retarget=_retarget_rule(args)
+    return run_balances(
+        args.store,
+        args.account,
+        expected_difficulty=args.difficulty,
+        retarget=_retarget_rule(args),
     )
-    ledger = balances(chain.main_chain())
-    if args.account is not None:
-        print(
-            json.dumps(
-                {
-                    "config": "balances",
-                    "height": chain.height,
-                    "account": args.account,
-                    "balance": ledger.get(args.account, 0),
-                }
-            )
-        )
-        return 0
-    # Offline audit: the store loads through full consensus validation, so
-    # the view must agree with the incremental ledger, hold nothing
-    # negative, and conserve exactly — total = coinbase minted minus the
-    # fees burned by the rare coinbase-less blocks.  A False here means a
-    # corrupted store or a consensus bug — surface it in the exit code.
-    minted = burned = 0
-    for b in chain.main_chain():
-        if b.txs and b.txs[0].is_coinbase:
-            minted += b.txs[0].amount
-        else:
-            burned += sum(t.fee for t in b.txs)
-    conserved = (
-        sum(ledger.values()) == minted - burned
-        and all(v >= 0 for v in ledger.values())
-        and {a: v for a, v in ledger.items() if v} == chain.balances_snapshot()
-    )
-    print(
-        json.dumps(
-            {
-                "config": "balances",
-                "height": chain.height,
-                "conserved": conserved,
-                "balances": dict(sorted(ledger.items())),
-            }
-        )
-    )
-    return 0 if conserved else 1
 
 
-# -- compact -------------------------------------------------------------
+# -- compact / fsck / net (engines in chain/tooling.py, node/netharness.py) --
 
 
 def cmd_compact(args) -> int:
-    """Store maintenance: the append-only log keeps every side branch and
-    reorged-away block forever (that's what makes restarts deterministic);
-    compaction snapshots just the current main branch, shrinking the file
-    while resume behavior for the surviving chain is unchanged."""
-    import os
+    from p1_tpu.chain.tooling import run_compact
 
-    from p1_tpu.chain import ChainStore, save_chain
-
-    if not os.path.exists(args.store):
-        print(f"{args.store}: empty or missing chain store", file=sys.stderr)
-        return 2
-    # Lock FIRST, then load: records appended between an unlocked read and
-    # the rewrite would be silently dropped, and replacing the inode under
-    # a live node would orphan everything it appends afterwards.
-    src = ChainStore(args.store)
-    try:
-        try:
-            # allow_v2: compaction IS the upgrade path for pre-checksum
-            # stores (the snapshot below is written in v3 framing).
-            src.acquire(allow_v2=True)
-        except RuntimeError as e:
-            print(f"{e} — stop it before compacting", file=sys.stderr)
-            return 2
-        blocks = src.load_blocks()
-        if not blocks:
-            print(f"{args.store}: empty chain store", file=sys.stderr)
-            return 2
-        try:
-            chain = src.load_chain(
-                blocks[0].header.difficulty,
-                blocks,
-                retarget=_retarget_rule(args),
-            )
-        except ValueError as e:
-            # Without this, compacting a retarget store with forgotten
-            # flags would REPLACE it with a genesis-only snapshot of the
-            # wrong chain — the one unrecoverable failure mode here.
-            print(str(e), file=sys.stderr)
-            return 2
-        before = os.path.getsize(args.store)
-        out = args.out or args.store
-        dst = None
-        if args.out and os.path.realpath(out) != os.path.realpath(args.store):
-            # The destination needs the same in-use guard: replacing it
-            # would orphan a live node's inode there.
-            dst = ChainStore(out)
-            try:
-                dst.acquire()
-            except RuntimeError as e:
-                print(f"{e} — stop it before overwriting", file=sys.stderr)
-                return 2
-        else:
-            out = args.store
-        try:
-            # Always write a sibling temp file and atomically replace, so
-            # a crash mid-write can never leave EITHER path deleted or
-            # truncated.
-            tmp = f"{out}.compact.{os.getpid()}"
-            save_chain(chain, tmp)
-            # Prove the snapshot BEFORE it replaces the original: the
-            # main branch is linear, so its packed headers verify (PoW +
-            # linkage + difficulty) in one native call straight off the
-            # bytes just written — a torn or miswritten snapshot can
-            # never clobber a good log.
-            from p1_tpu.chain import replay_packed
-
-            raw_headers, n_headers = ChainStore(tmp).packed_headers()
-            snap = replay_packed(raw_headers, retarget=_retarget_rule(args))
-            if not snap.valid:
-                os.unlink(tmp)
-                print(
-                    f"snapshot self-check failed at record "
-                    f"{snap.first_invalid} of {n_headers} — original store "
-                    "left untouched",
-                    file=sys.stderr,
-                )
-                return 3
-            os.replace(tmp, out)
-            # The rename itself must survive a metadata-journal loss:
-            # save_chain fsynced the tmp's data and directory entry, but
-            # the replace is a second directory mutation.
-            from p1_tpu.chain.store import fsync_dir
-
-            fsync_dir(os.path.dirname(os.path.abspath(out)))
-        finally:
-            if dst is not None:
-                dst.close()
-    finally:
-        src.close()
-    print(
-        json.dumps(
-            {
-                "config": "compact",
-                "height": chain.height,
-                "records_before": len(blocks),
-                "records_after": chain.height + 1,
-                "bytes_before": before,
-                "bytes_after": os.path.getsize(out),
-                "out": out,
-            }
-        )
-    )
-    return 0
-
-
-# -- fsck ----------------------------------------------------------------
+    return run_compact(args.store, args.out, retarget=_retarget_rule(args))
 
 
 def cmd_fsck(args) -> int:
-    """Offline store integrity scan + salvage (the disk counterpart of
-    Bitcoin's -checkblocks/salvagewallet tooling).  Exit contract:
+    from p1_tpu.chain.tooling import run_fsck
 
-    - **0 clean** — every record checksum-valid, nothing rewritten (a
-      lossless v2→v3 upgrade also exits 0: no information was lost);
-    - **1 salvaged** — corruption or a torn tail was found; every
-      checksum-valid record was rewritten into a fresh verified store,
-      bad spans quarantined to the ``.quarantine`` sidecar;
-    - **2 unrecoverable** — missing/empty/locked store, unrecognizable
-      magic, or zero salvageable records.
-
-    Unlike ``p1 compact`` this preserves insertion order and side
-    branches (it salvages the LOG, not the main branch), so the
-    self-check is framing-level — every salvaged record re-reads
-    checksum-valid and byte-identical — rather than the linear-chain
-    ``replay_packed`` proof compaction can afford."""
-    import os
-
-    from p1_tpu.chain import ChainStore
-    from p1_tpu.chain.store import fsync_dir
-    from p1_tpu.core.block import Block
-
-    if not os.path.exists(args.store) or os.path.getsize(args.store) == 0:
-        print(f"{args.store}: empty or missing chain store", file=sys.stderr)
-        return 2
-    store = ChainStore(args.store)
-    try:
-        try:
-            # Lock first (a live node's in-flight appends must not race
-            # the rewrite), scan without healing: fsck owns the salvage
-            # decision and must report BEFORE mutating.
-            store.acquire(allow_v2=True, heal=False)
-        except RuntimeError as e:
-            print(str(e), file=sys.stderr)
-            return 2
-        data = store._read_bytes()
-        scan = store.scan(data)
-        report = {
-            "config": "fsck",
-            "store": args.store,
-            "version": scan.version,
-            "records_valid": len(scan.spans),
-            "bad_spans": len(scan.bad_spans),
-            "bytes_quarantined": scan.quarantined_bytes,
-            "torn_tail_bytes": (
-                scan.size - scan.torn_tail if scan.torn_tail is not None else 0
-            ),
-        }
-        if scan.version == 3 and scan.clean:
-            print(json.dumps({**report, "status": "clean"}))
-            return 0
-
-        # Salvage: every checksum-valid record that still parses as a
-        # block, in original insertion order, into a fresh v3 store.
-        blocks, parse_failures = [], 0
-        for off, n in scan.spans:
-            try:
-                blocks.append(Block.deserialize(data[off : off + n]))
-            except ValueError:
-                parse_failures += 1
-        report["parse_failures"] = parse_failures
-        if not blocks:
-            print(
-                json.dumps({**report, "status": "unrecoverable"}),
-            )
-            print(
-                f"{args.store}: no salvageable records", file=sys.stderr
-            )
-            return 2
-        if scan.bad_spans:
-            # Evidence first, durably, before the original bytes go away.
-            qpath = store.quarantine_path()
-            import struct as _struct
-
-            with open(qpath, "ab") as qf:
-                for s, e in scan.bad_spans:
-                    qf.write(_struct.pack(">QI", s, e - s))
-                    qf.write(data[s:e])
-                qf.flush()
-                os.fsync(qf.fileno())
-            report["quarantine"] = str(qpath)
-        out = args.out or args.store
-        tmp = f"{out}.fsck.{os.getpid()}"
-        dst = ChainStore(tmp, fsync=False)
-        try:
-            for block in blocks:
-                dst.append(block)
-            dst.sync()
-            dst._fsync_dir()
-        finally:
-            dst.close()
-        # Self-check BEFORE the replace: the fresh store must re-scan
-        # clean with every record byte-identical to what was salvaged —
-        # a miswritten salvage must never clobber the evidence.
-        vdata = ChainStore(tmp)._read_checked()
-        vscan = ChainStore.scan(vdata)
-        ok = (
-            vscan.version == 3
-            and vscan.clean
-            and len(vscan.spans) == len(blocks)
-            and all(
-                vdata[off : off + n] == block.serialize()
-                for (off, n), block in zip(vscan.spans, blocks)
-            )
-        )
-        if not ok:
-            os.unlink(tmp)
-            print(
-                "salvage self-check failed — original store left untouched",
-                file=sys.stderr,
-            )
-            return 2
-        os.replace(tmp, out)
-        fsync_dir(os.path.dirname(os.path.abspath(out)))
-        lossless = (
-            not scan.bad_spans
-            and scan.torn_tail is None
-            and not parse_failures
-        )
-        report.update(
-            {
-                "records_salvaged": len(blocks),
-                "out": out,
-                "status": "upgraded" if lossless else "salvaged",
-            }
-        )
-        print(json.dumps(report))
-        return 0 if lossless else 1
-    finally:
-        store.close()
-
-
-# -- net -----------------------------------------------------------------
-
-
-async def _inject_txs(
-    ports, keys, difficulty, deadline, rate, retarget=None
-) -> tuple[int, int]:
-    """Drive a live economy during a `p1 net` run: ~``rate`` transfers/sec,
-    each one a real wallet round — GETACCOUNT for the sender's next seq at
-    its own node, sign chain-bound, push via the tx client.  Best-effort:
-    a busy node (GIL-bound mining) or an unaffordable pick just skips a
-    beat; the audit invariant is conservation, not delivery."""
-    import random
-
-    from p1_tpu.core.genesis import genesis_hash
-    from p1_tpu.core.tx import Transaction
-    from p1_tpu.node.client import get_account, send_tx
-
-    tag = genesis_hash(difficulty, retarget)
-    submitted = failed = 0
-    rng = random.Random(0xD1CE)
-    period = 1.0 / rate
-    while time.time() < deadline - 1.0:
-        i = rng.randrange(len(keys))
-        recipient = keys[rng.randrange(len(keys))].account
-        try:
-            state = await get_account(
-                "127.0.0.1",
-                ports[i],
-                keys[i].account,
-                difficulty,
-                timeout=5,
-                retarget=retarget,
-            )
-            amount = rng.randint(1, 5)
-            if state.balance >= amount + 1:
-                tx = Transaction.transfer(
-                    keys[i], recipient, amount, 1, state.next_seq, chain=tag
-                )
-                await send_tx(
-                    "127.0.0.1",
-                    ports[i],
-                    tx,
-                    difficulty,
-                    timeout=5,
-                    retarget=retarget,
-                )
-                submitted += 1
-        except (
-            ConnectionError,
-            OSError,
-            ValueError,
-            asyncio.TimeoutError,
-            asyncio.IncompleteReadError,
-        ):
-            failed += 1
-        await asyncio.sleep(period)
-    return submitted, failed
-
-
-async def _byzantine_actor(
-    actor: int, ports, difficulty, deadline, retarget, stats: dict
-) -> None:
-    """One actively malicious participant (VERDICT r4 weak #5): connects
-    to honest nodes from its own loopback alias (127.0.0.{10+actor}, so
-    misbehavior bans hit the attacker's address, not the honest mesh's)
-    and cycles the whole hostile repertoire — invalid signatures,
-    overdraws, replays of confirmed transfers, forged compact-block
-    material, unsolicited BLOCKTXN, ADDR spam, oversized frames, random
-    garbage.  Counts what it sent and how often the node refused it at
-    accept time (= an active ban).  Every attack is fire-and-observe:
-    the honest invariants are asserted from the nodes' final statuses,
-    not from here."""
-    import dataclasses
-    import random
-    import struct
-
-    from p1_tpu.core.genesis import make_genesis
-    from p1_tpu.core.header import BlockHeader
-    from p1_tpu.core.keys import Keypair
-    from p1_tpu.core.tx import Transaction
-    from p1_tpu.node import protocol
-    from p1_tpu.node.protocol import Hello, MsgType
-
-    rng = random.Random(0xBAD + actor)
-    source = f"127.0.0.{10 + actor}"
-    genesis = make_genesis(difficulty, retarget)
-    gh = genesis.block_hash()
-    tag = gh
-    key = Keypair.from_seed_text(f"p1-byz-{actor}")
-    harvested_txs: list[bytes] = []  # raw TX payloads seen in gossip
-    harvested_headers: list[BlockHeader] = []
-
-    def bump(name: str) -> None:
-        stats["attacks"][name] = stats["attacks"].get(name, 0) + 1
-
-    while time.time() < deadline - 1.0:
-        port = ports[rng.randrange(len(ports))]
-        try:
-            reader, writer = await asyncio.open_connection(
-                "127.0.0.1", port, local_addr=(source, 0)
-            )
-        except OSError:
-            await asyncio.sleep(0.2)
-            continue
-        try:
-            first = await asyncio.wait_for(protocol.read_frame(reader), 5)
-            mtype, _ = protocol.decode(first)
-            assert mtype is MsgType.HELLO
-        except asyncio.TimeoutError:
-            # Slow HELLO ≠ ban: a GIL-loaded honest node can take
-            # seconds — counting it as a refusal would let bans_fired
-            # read true with the ban machinery broken.
-            stats["slow_hellos"] = stats.get("slow_hellos", 0) + 1
-            writer.close()
-            await asyncio.sleep(0.2)
-            continue
-        except (
-            asyncio.IncompleteReadError,
-            ConnectionError,
-            OSError,
-            ValueError,
-        ):
-            # Immediate hang-up before HELLO: the accept-time ban said no.
-            stats["refused_connects"] += 1
-            writer.close()
-            await asyncio.sleep(0.2)
-            continue
-        harvester = None
-        try:
-            await protocol.write_frame(
-                writer, protocol.encode_hello(Hello(gh, 0, 0, 0))
-            )
-            session_end = min(deadline - 0.5, time.time() + 2.0)
-
-            async def harvest() -> None:
-                try:
-                    while True:
-                        payload = await protocol.read_frame(reader)
-                        if not payload:
-                            continue
-                        if (
-                            payload[0] == MsgType.TX
-                            and len(harvested_txs) < 64
-                        ):
-                            harvested_txs.append(payload)
-                        elif payload[0] == MsgType.BLOCK:
-                            try:
-                                _, (_ts, blk) = protocol.decode(payload)
-                                if len(harvested_headers) < 16:
-                                    harvested_headers.append(blk.header)
-                            except ValueError:
-                                pass
-                except (
-                    asyncio.IncompleteReadError,
-                    ConnectionError,
-                    OSError,
-                ):
-                    return  # node hung up on us (a ban working) — done
-
-            harvester = asyncio.create_task(harvest())
-            if deadline - time.time() >= 25.0 and rng.random() < 0.25:
-                # A CAMPING session — the round-4 verdict's exact
-                # slot-pinning profile: hold the connection, reading but
-                # never sending, until the liveness layer reaps us.
-                # Decided ONCE per session with small probability (a
-                # per-iteration draw converted ~99% of sessions into
-                # camps and starved the ban machinery the containment
-                # contract asserts), and skipped near the deadline so
-                # short runs still exercise every other attack.  The
-                # session sends nothing after HELLO, so a teardown here
-                # is attributable to the keepalive probe (accept-time
-                # bans close pre-HELLO and never reach this point).
-                bump("camp")
-                camp_end = time.time() + 20.0
-                while time.time() < camp_end:
-                    if writer.is_closing() or harvester.done():
-                        stats["camp_evictions"] += 1
-                        break
-                    await asyncio.sleep(0.5)
-            else:
-                while time.time() < session_end:
-                    attack = rng.choice(
-                        (
-                            "badsig",
-                            "overdraw",
-                            "replay",
-                            "cblock",
-                            "blocktxn",
-                            "addr_spam",
-                            "garbage",
-                        )
-                    )
-                    if attack == "replay" and not harvested_txs:
-                        attack = "garbage"  # nothing harvested yet
-                    if attack == "cblock" and not harvested_headers:
-                        attack = "garbage"
-                    if attack == "badsig":
-                        tx = Transaction.transfer(
-                            key, "p1deadbeefdeadbeef", 1, 1, 0, chain=tag
-                        )
-                        forged = dataclasses.replace(
-                            tx, sig=bytes(64)  # zeroed signature
-                        )
-                        await protocol.write_frame(
-                            writer, protocol.encode_tx(forged)
-                        )
-                    elif attack == "overdraw":
-                        tx = Transaction.transfer(
-                            key,
-                            "p1deadbeefdeadbeef",
-                            10**12,  # the attacker's balance is zero
-                            1,
-                            0,
-                            chain=tag,
-                        )
-                        await protocol.write_frame(writer, protocol.encode_tx(tx))
-                    elif attack == "replay":
-                        # A transfer harvested from gossip earlier: by now
-                        # confirmed on-chain — a definite nonce replay.
-                        await protocol.write_frame(
-                            writer, harvested_txs[rng.randrange(len(harvested_txs))]
-                        )
-                    elif attack == "cblock":
-                        # Real recent header with the nonce bumped: parent
-                        # known, PoW broken — must die at the work gate.
-                        h = harvested_headers[-1]
-                        fake = dataclasses.replace(h, nonce=h.nonce ^ 1)
-                        payload = (
-                            bytes([MsgType.CBLOCK])
-                            + struct.pack(">d", time.time())
-                            + fake.serialize()
-                            + struct.pack(">HH", 1, 0)
-                            + bytes(32)
-                        )
-                        await protocol.write_frame(writer, payload)
-                    elif attack == "blocktxn":
-                        await protocol.write_frame(
-                            writer,
-                            protocol.encode_blocktxn(
-                                rng.randbytes(32), [rng.randbytes(40)]
-                            ),
-                        )
-                    elif attack == "addr_spam":
-                        addrs = [
-                            (f"10.66.{rng.randrange(256)}.{rng.randrange(256)}",
-                             rng.randrange(1, 0xFFFF))
-                            for _ in range(64)
-                        ]
-                        await protocol.write_frame(
-                            writer, protocol.encode_addr(addrs)
-                        )
-                    else:  # garbage: malformed bytes — a scorable violation
-                        writer.write(
-                            (rng.randrange(1, 64)).to_bytes(4, "big")
-                            + rng.randbytes(rng.randrange(1, 64))
-                        )
-                        await writer.drain()
-                    bump(attack)
-                    await asyncio.sleep(0.05)
-                # Sign off with the canonical scorable violation so bans
-                # accumulate: a hostile length prefix.
-                writer.write((64 << 20).to_bytes(4, "big"))
-                await writer.drain()
-                bump("oversized")
-        except (ConnectionError, OSError, asyncio.TimeoutError):
-            pass  # node dropped us mid-attack: working as intended
-        finally:
-            if harvester is not None:
-                harvester.cancel()  # no-op if it already returned; its
-                # own except clause swallows disconnects, so no
-                # unretrieved-exception warnings either way
-            writer.close()
-        await asyncio.sleep(0.1)
-
-
-async def _net_drive(
-    ports, keys, difficulty, deadline, rate, n_byzantine, retarget=None
-):
-    """Run the benign economy and the byzantine actors concurrently."""
-    byz_stats = {
-        "attacks": {},
-        "refused_connects": 0,
-        "slow_hellos": 0,
-        "camp_evictions": 0,
-    }
-    tasks = []
-    if rate > 0:
-        tasks.append(
-            _inject_txs(ports, keys, difficulty, deadline, rate, retarget)
-        )
-    for actor in range(n_byzantine):
-        tasks.append(
-            _byzantine_actor(
-                actor, ports, difficulty, deadline, retarget, byz_stats
-            )
-        )
-    results = await asyncio.gather(*tasks, return_exceptions=True)
-    submitted = failed = 0
-    for r in results:
-        if isinstance(r, tuple):
-            submitted, failed = r
-        elif isinstance(r, BaseException):
-            raise r
-    return submitted, failed, byz_stats
+    return run_fsck(args.store, args.out)
 
 
 def cmd_net(args) -> int:
-    """Spawn N `p1_tpu node` subprocesses in a full mesh and check they
-    converge on one tip (benchmark config 4, BASELINE.json:10).  With
-    ``--tx-rate`` the run carries a live signed-transfer economy between
-    the miners' accounts, and the summary audits every node's ledger for
-    exact conservation — the whole consensus stack (signatures, nonces,
-    overdraw rejection, reorg undo) exercised under real concurrent
-    forks."""
-    import subprocess
+    from p1_tpu.node.netharness import run_net
 
-    from p1_tpu.core.keys import Keypair
-
-    # Validate the retarget flag pair up front: a bad pair must be ONE
-    # clean CLI error here, not N child-node tracebacks (or — for a lone
-    # --target-spacing — a silently fixed-difficulty run).
-    net_rule = _retarget_rule(args)
-    ports = [args.base_port + i for i in range(args.nodes)]
-    keys = [
-        Keypair.from_seed_text(f"p1-net-{args.base_port}-{i}")
-        for i in range(args.nodes)
-    ]
-    procs = []
-    for i, port in enumerate(ports):
-        cmd = [
-            sys.executable,
-            "-m",
-            "p1_tpu",
-            "node",
-            "--port",
-            str(port),
-            "--difficulty",
-            str(args.difficulty),
-            "--backend",
-            args.backend,
-            "--deadline",
-            "stdin",
-            "--miner-id",
-            keys[i].account if args.tx_rate > 0 else f"node{i}",
-        ]
-        if args.chunk:
-            cmd += ["--chunk", str(args.chunk)]
-        if args.batch:
-            cmd += ["--batch", str(args.batch)]
-        # Tight liveness deadlines for the localhost mesh: a silent
-        # camper (the byzantine "camp" attack, or any wedged peer) is
-        # probed within 10 s and evicted 5 s later, so soak statuses
-        # show the keepalive layer actually firing.  Honest miners
-        # gossip constantly and never get probed.
-        cmd += ["--ping-interval", "10", "--pong-timeout", "5"]
-        # Tight sync supervision to match: a localhost batch turns
-        # around in milliseconds, so a 5 s no-progress window on a
-        # catch-up is decisively a stall — soak statuses surface the
-        # failover layer under byzantine serve-and-starve peers while
-        # honest syncs (progress resets the deadline) never trip it.
-        cmd += ["--sync-stall-timeout", "5"]
-        if net_rule is not None:
-            cmd += [
-                "--retarget-window", str(net_rule.window),
-                "--target-spacing", str(net_rule.spacing),
-            ]
-        if args.no_compact_gossip:
-            cmd += ["--no-compact-gossip"]
-        if args.discover:
-            # One seed only; discovery must assemble the mesh.
-            peers = [f"127.0.0.1:{ports[0]}"] if i else []
-            cmd += ["--target-peers", str(args.nodes - 1)]
-        else:
-            peers = [f"127.0.0.1:{p}" for p in ports[:i]]
-        if peers:
-            cmd += ["--peers", *peers]
-        procs.append(
-            subprocess.Popen(
-                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True
-            )
-        )
-    statuses = []
-    try:
-        # Readiness handshake: interpreter startup can cost many seconds on
-        # a loaded host, so a deadline computed before the children exist
-        # could expire before they boot.  Every child prints a ready line;
-        # only then does the shared mining deadline start counting.
-        for proc in procs:
-            ready = json.loads(proc.stdout.readline())
-            assert "ready" in ready, ready
-        deadline = time.time() + args.duration
-        for proc in procs:
-            proc.stdin.write(f"{deadline!r}\n")
-            proc.stdin.flush()  # leave stdin open: communicate() closes it
-        txs_submitted = txs_failed = 0
-        byz_stats = None
-        n_byz = getattr(args, "byzantine", 0)
-        if args.tx_rate > 0 or n_byz > 0:
-            txs_submitted, txs_failed, byz_stats = asyncio.run(
-                _net_drive(
-                    ports,
-                    keys,
-                    args.difficulty,
-                    deadline,
-                    args.tx_rate,
-                    n_byz,
-                    retarget=net_rule,
-                )
-            )
-        for proc in procs:
-            out, _ = proc.communicate(timeout=args.duration + 120)
-            lines = (out or "").strip().splitlines()
-            if not lines:
-                raise RuntimeError(f"node pid {proc.pid} produced no status output")
-            statuses.append(json.loads(lines[-1]))
-    finally:
-        for proc in procs:  # never leave orphaned miners holding the ports
-            if proc.poll() is None:
-                proc.kill()
-    tips = {s["tip"] for s in statuses}
-    result = {
-        "config": "net",
-        "nodes": args.nodes,
-        "difficulty": args.difficulty,
-        "converged": len(tips) == 1,
-        "height": max(s["height"] for s in statuses),
-        "blocks_mined_total": sum(s["blocks_mined"] for s in statuses),
-        "reorgs_total": sum(s["reorgs"] for s in statuses),
-        # Gossip bandwidth elided by compact block relay, net-wide.
-        "compact_bytes_saved_total": sum(
-            s["compact"]["bytes_saved"] for s in statuses
-        ),
-        "compact_tx_hit_total": sum(
-            s["compact"]["tx_hits"] for s in statuses
-        ),
-        "compact_tx_fetched_total": sum(
-            s["compact"]["tx_fetched"] for s in statuses
-        ),
-        "wire_bytes_total": sum(
-            s["wire"]["bytes_sent"] for s in statuses
-        ),
-        # Network-level propagation delay (gossip send -> accept), the
-        # worst node's view: median of per-node medians would hide a slow
-        # peer, so report the max median and the max p95 across nodes.
-        "propagation_delay_ms": {
-            "max_median": max(
-                (s["propagation"]["median_ms"] or 0.0 for s in statuses),
-                default=0.0,
-            ),
-            "max_p95": max(
-                (s["propagation"]["p95_ms"] or 0.0 for s in statuses),
-                default=0.0,
-            ),
-            "samples_total": sum(s["propagation"]["samples"] for s in statuses),
-        },
-        "statuses": statuses,
-    }
-    if args.tx_rate > 0:
-        from p1_tpu.core.tx import BLOCK_REWARD
-
-        # Conservation: every block carries a coinbase and fees credit the
-        # miner, so each node's ledger must sum to exactly reward x its
-        # height — across hundreds of reorgs and a live spend stream.
-        conserved = all(
-            s["ledger_sum"] == BLOCK_REWARD * s["height"] for s in statuses
-        )
-        result["economy"] = {
-            "txs_submitted": txs_submitted,
-            "txs_failed": txs_failed,
-            "txs_accepted_total": sum(s["txs_accepted"] for s in statuses),
-            "ledger_conserved": conserved,
-        }
-        if not conserved:
-            result["converged"] = False  # fail loudly: consensus bug
-    if n_byz > 0 and byz_stats is not None:
-        # The byzantine soak's containment contract, asserted in the
-        # summary rather than left to log-reading: honest nodes must
-        # have (a) kept converging and conserving (checked above),
-        # (b) actually banned the attackers (their oversized/garbage
-        # frames are scorable, so refused connects must appear), and
-        # (c) stayed within their memory bounds — the address book and
-        # pool caps hold under spam.
-        from p1_tpu.mempool import Mempool
-        from p1_tpu.node.node import MAX_KNOWN_ADDRS, MAX_TRIED_ADDRS
-
-        attacks_sent = sum(byz_stats["attacks"].values())
-        bans_fired = byz_stats["refused_connects"] > 0
-        pool_cap = Mempool().max_txs  # the node's actual bound
-        memory_bounded = all(
-            s["known_addrs"] <= MAX_KNOWN_ADDRS + MAX_TRIED_ADDRS
-            and s["mempool"] <= pool_cap
-            for s in statuses
-        )
-        result["byzantine"] = {
-            "attackers": n_byz,
-            "attacks_sent": attacks_sent,
-            "attacks": byz_stats["attacks"],
-            "refused_connects": byz_stats["refused_connects"],
-            "slow_hellos": byz_stats["slow_hellos"],
-            # Silent-camper sessions the ATTACKERS saw torn down early
-            # (camping sessions send nothing after HELLO, so these are
-            # keepalive reaps), next to the nodes' aggregate idle-
-            # eviction telemetry — an upper bound that can also include
-            # an honest peer evicted during a GIL stall.
-            "camp_evictions": byz_stats["camp_evictions"],
-            "idle_evictions_total": sum(
-                s.get("liveness", {}).get("peers_evicted_idle", 0)
-                for s in statuses
-            ),
-            "bans_fired": bans_fired,
-            "memory_bounded": memory_bounded,
-            "contained": bool(
-                result["converged"] and bans_fired and memory_bounded
-            ),
-        }
-        if not result["byzantine"]["contained"]:
-            result["converged"] = False
-    print(json.dumps(result))
-    return 0 if result["converged"] else 1
+    return run_net(args)
 
 
 def cmd_bench(args) -> int:
@@ -2271,6 +1349,7 @@ def main(argv=None) -> int:
         "sweep": cmd_sweep,
         "replay": cmd_replay,
         "node": cmd_node,
+        "status": cmd_status,
         "tx": cmd_tx,
         "keygen": cmd_keygen,
         "account": cmd_account,

@@ -76,6 +76,29 @@ class NodeConfig:
     #: in place; with this set the node signals fatal instead (the CLI
     #: exits 4) for operators who prefer a supervisor restart.
     store_degraded_exit: bool = False
+    #: Overload resilience (node/governor.py).  ``admission_control``
+    #: gates the per-peer multi-class token buckets at the dispatch door
+    #: (blocks / txs / queries — floods are dropped and escalate to the
+    #: misbehavior score; solicited replies are never charged).  On by
+    #: default: the budgets sit far above any honest peer's rates.
+    admission_control: bool = True
+    #: High watermark (bytes) on the node's accounted memory gauge
+    #: (resident chain bodies + pending pool bytes + peer write
+    #: buffers).  Above it the node enters the SHED overload state —
+    #: low-priority gossip and mempool pages drop, mining pauses,
+    #: consensus-critical headers/blocks/proof service keeps running —
+    #: with hysteresis back to NORMAL below 80% of the mark.  0 (the
+    #: default) disables shedding; admission control and the per-peer
+    #: write-queue caps stay on regardless.
+    mem_watermark_bytes: int = 0
+    #: Memory-bounded operation: keep only the most recent N main-chain
+    #: block BODIES resident in the RAM index (headers and all metadata
+    #: stay), evicting older bodies once they are durably refetchable
+    #: from the append-only store and re-reading them on demand.  Cuts
+    #: steady-state and resume peak RSS from O(chain) to O(N)
+    #: (docs/PERF.md "Memory-bounded operation").  0 disables (fully
+    #: resident — the historical behavior); requires ``store_path``.
+    body_cache_blocks: int = 0
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

@@ -48,8 +48,18 @@ _PACK = struct.Struct(">I32s32sIII")
 assert _PACK.size == HEADER_SIZE
 
 
-@dataclasses.dataclass(frozen=True)
-class BlockHeader:
+class _HeaderCache:
+    """Slot home for the memoized encoding (``_raw``) and digest
+    (``_hash``).  A separate base because ``dataclass(slots=True)``
+    generates ``__slots__`` from the FIELDS only — the caches are not
+    fields (equality/replace must ignore them) but still need slots, or
+    the instance grows a dict and the whole point is lost."""
+
+    __slots__ = ("_raw", "_hash")
+
+
+@dataclasses.dataclass(frozen=True, slots=True)
+class BlockHeader(_HeaderCache):
     version: int
     prev_hash: bytes  # 32 raw bytes
     merkle_root: bytes  # 32 raw bytes
@@ -72,7 +82,7 @@ class BlockHeader:
             raise ValueError(f"difficulty={self.difficulty} out of range (0..255)")
 
     def serialize(self) -> bytes:
-        raw = self.__dict__.get("_raw")
+        raw = getattr(self, "_raw", None)
         if raw is None:
             raw = _PACK.pack(
                 self.version,
@@ -101,18 +111,17 @@ class BlockHeader:
         if difficulty > 255:
             raise ValueError(f"difficulty={difficulty} out of range (0..255)")
         header = object.__new__(cls)
-        header.__dict__.update(
-            version=version,
-            prev_hash=prev_hash,
-            merkle_root=merkle_root,
-            timestamp=timestamp,
-            difficulty=difficulty,
-            nonce=nonce,
-            # Seed the encoding cache with the exact wire bytes:
-            # fixed-width fields make re-packing byte-identical, so these
-            # ARE the canonical encoding and the header never repacks.
-            _raw=bytes(data),
-        )
+        set_ = object.__setattr__
+        set_(header, "version", version)
+        set_(header, "prev_hash", prev_hash)
+        set_(header, "merkle_root", merkle_root)
+        set_(header, "timestamp", timestamp)
+        set_(header, "difficulty", difficulty)
+        set_(header, "nonce", nonce)
+        # Seed the encoding cache with the exact wire bytes: fixed-width
+        # fields make re-packing byte-identical, so these ARE the
+        # canonical encoding and the header never repacks.
+        set_(header, "_raw", bytes(data))
         return header
 
     def with_nonce(self, nonce: int) -> "BlockHeader":
@@ -128,7 +137,7 @@ class BlockHeader:
     def block_hash(self) -> bytes:
         """SHA-256d of the serialized header (the block id) — computed
         once; gossip ingest, fork choice, and store resume all re-ask."""
-        digest = self.__dict__.get("_hash")
+        digest = getattr(self, "_hash", None)
         if digest is None:
             from p1_tpu.core.hashutil import sha256d
 

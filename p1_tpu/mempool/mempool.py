@@ -210,6 +210,11 @@ class Mempool:
         #: the node's periodic checkpoint skip the disk write when the
         #: pool hasn't changed since the last save.
         self.mutations = 0
+        #: Serialized bytes of every pending transaction, maintained on
+        #: add/drop — the pool's term in the node's overload memory
+        #: gauge (node/governor.py).  ``serialize`` is memoized, so the
+        #: tally is a cached-bytes len, never a re-pack.
+        self.bytes_pending = 0
 
     def __len__(self) -> int:
         return len(self._txs)
@@ -276,6 +281,7 @@ class Mempool:
             self._drop(self._txs[incumbent])
         self._txs[txid] = tx
         self._admitted_at[txid] = time.monotonic()
+        self.bytes_pending += len(tx.serialize())
         self._by_slot[slot] = txid
         self._pending_debit[tx.sender] = (
             self._pending_debit.get(tx.sender, 0) + tx.amount + tx.fee
@@ -288,7 +294,8 @@ class Mempool:
         """Remove a pending ``tx`` from the pool + its debit tally + the
         sync index."""
         txid = tx.txid()
-        self._txs.pop(txid, None)
+        if self._txs.pop(txid, None) is not None:
+            self.bytes_pending -= len(tx.serialize())
         self._admitted_at.pop(txid, None)
         d = self._pending_debit.get(tx.sender, 0) - (tx.amount + tx.fee)
         if d > 0:

@@ -1,0 +1,240 @@
+"""Byzantine actor suite: actively malicious net-soak participants.
+
+Extracted from ``cli.py`` (which keeps only parsing + dispatch): the
+whole hostile repertoire one `p1 net --byzantine N` actor cycles —
+invalid signatures, overdraws, replays of confirmed transfers, forged
+compact-block material, unsolicited BLOCKTXN, ADDR spam, oversized
+frames, random garbage, and the silent camping session the liveness
+layer exists to reap.  Test/soak infrastructure, not product: nothing in
+the node imports this.  It lives in the package (like ``testing.py``'s
+HostilePeer/GreedyPeer) so external rigs can drive the same adversaries
+against real nodes without vendoring CLI internals.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+def new_stats() -> dict:
+    """The shared mutable stats dict every actor feeds (one per soak)."""
+    return {
+        "attacks": {},
+        "refused_connects": 0,
+        "slow_hellos": 0,
+        "camp_evictions": 0,
+    }
+
+
+async def byzantine_actor(
+    actor: int, ports, difficulty, deadline, retarget, stats: dict
+) -> None:
+    """One actively malicious participant (VERDICT r4 weak #5): connects
+    to honest nodes from its own loopback alias (127.0.0.{10+actor}, so
+    misbehavior bans hit the attacker's address, not the honest mesh's)
+    and cycles the whole hostile repertoire.  Counts what it sent and how
+    often the node refused it at accept time (= an active ban).  Every
+    attack is fire-and-observe: the honest invariants are asserted from
+    the nodes' final statuses, not from here."""
+    import dataclasses
+    import random
+    import struct
+
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.core.header import BlockHeader
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.node import protocol
+    from p1_tpu.node.protocol import Hello, MsgType
+
+    rng = random.Random(0xBAD + actor)
+    source = f"127.0.0.{10 + actor}"
+    genesis = make_genesis(difficulty, retarget)
+    gh = genesis.block_hash()
+    tag = gh
+    key = Keypair.from_seed_text(f"p1-byz-{actor}")
+    harvested_txs: list[bytes] = []  # raw TX payloads seen in gossip
+    harvested_headers: list[BlockHeader] = []
+
+    def bump(name: str) -> None:
+        stats["attacks"][name] = stats["attacks"].get(name, 0) + 1
+
+    while time.time() < deadline - 1.0:
+        port = ports[rng.randrange(len(ports))]
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port, local_addr=(source, 0)
+            )
+        except OSError:
+            await asyncio.sleep(0.2)
+            continue
+        try:
+            first = await asyncio.wait_for(protocol.read_frame(reader), 5)
+            mtype, _ = protocol.decode(first)
+            assert mtype is MsgType.HELLO
+        except asyncio.TimeoutError:
+            # Slow HELLO ≠ ban: a GIL-loaded honest node can take
+            # seconds — counting it as a refusal would let bans_fired
+            # read true with the ban machinery broken.
+            stats["slow_hellos"] = stats.get("slow_hellos", 0) + 1
+            writer.close()
+            await asyncio.sleep(0.2)
+            continue
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            ValueError,
+        ):
+            # Immediate hang-up before HELLO: the accept-time ban said no.
+            stats["refused_connects"] += 1
+            writer.close()
+            await asyncio.sleep(0.2)
+            continue
+        harvester = None
+        try:
+            await protocol.write_frame(
+                writer, protocol.encode_hello(Hello(gh, 0, 0, 0))
+            )
+            session_end = min(deadline - 0.5, time.time() + 2.0)
+
+            async def harvest() -> None:
+                try:
+                    while True:
+                        payload = await protocol.read_frame(reader)
+                        if not payload:
+                            continue
+                        if (
+                            payload[0] == MsgType.TX
+                            and len(harvested_txs) < 64
+                        ):
+                            harvested_txs.append(payload)
+                        elif payload[0] == MsgType.BLOCK:
+                            try:
+                                _, (_ts, blk) = protocol.decode(payload)
+                                if len(harvested_headers) < 16:
+                                    harvested_headers.append(blk.header)
+                            except ValueError:
+                                pass
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    OSError,
+                ):
+                    return  # node hung up on us (a ban working) — done
+
+            harvester = asyncio.create_task(harvest())
+            if deadline - time.time() >= 25.0 and rng.random() < 0.25:
+                # A CAMPING session — the round-4 verdict's exact
+                # slot-pinning profile: hold the connection, reading but
+                # never sending, until the liveness layer reaps us.
+                # Decided ONCE per session with small probability (a
+                # per-iteration draw converted ~99% of sessions into
+                # camps and starved the ban machinery the containment
+                # contract asserts), and skipped near the deadline so
+                # short runs still exercise every other attack.  The
+                # session sends nothing after HELLO, so a teardown here
+                # is attributable to the keepalive probe (accept-time
+                # bans close pre-HELLO and never reach this point).
+                bump("camp")
+                camp_end = time.time() + 20.0
+                while time.time() < camp_end:
+                    if writer.is_closing() or harvester.done():
+                        stats["camp_evictions"] += 1
+                        break
+                    await asyncio.sleep(0.5)
+            else:
+                while time.time() < session_end:
+                    attack = rng.choice(
+                        (
+                            "badsig",
+                            "overdraw",
+                            "replay",
+                            "cblock",
+                            "blocktxn",
+                            "addr_spam",
+                            "garbage",
+                        )
+                    )
+                    if attack == "replay" and not harvested_txs:
+                        attack = "garbage"  # nothing harvested yet
+                    if attack == "cblock" and not harvested_headers:
+                        attack = "garbage"
+                    if attack == "badsig":
+                        tx = Transaction.transfer(
+                            key, "p1deadbeefdeadbeef", 1, 1, 0, chain=tag
+                        )
+                        forged = dataclasses.replace(
+                            tx, sig=bytes(64)  # zeroed signature
+                        )
+                        await protocol.write_frame(
+                            writer, protocol.encode_tx(forged)
+                        )
+                    elif attack == "overdraw":
+                        tx = Transaction.transfer(
+                            key,
+                            "p1deadbeefdeadbeef",
+                            10**12,  # the attacker's balance is zero
+                            1,
+                            0,
+                            chain=tag,
+                        )
+                        await protocol.write_frame(writer, protocol.encode_tx(tx))
+                    elif attack == "replay":
+                        # A transfer harvested from gossip earlier: by now
+                        # confirmed on-chain — a definite nonce replay.
+                        await protocol.write_frame(
+                            writer, harvested_txs[rng.randrange(len(harvested_txs))]
+                        )
+                    elif attack == "cblock":
+                        # Real recent header with the nonce bumped: parent
+                        # known, PoW broken — must die at the work gate.
+                        h = harvested_headers[-1]
+                        fake = dataclasses.replace(h, nonce=h.nonce ^ 1)
+                        payload = (
+                            bytes([MsgType.CBLOCK])
+                            + struct.pack(">d", time.time())
+                            + fake.serialize()
+                            + struct.pack(">HH", 1, 0)
+                            + bytes(32)
+                        )
+                        await protocol.write_frame(writer, payload)
+                    elif attack == "blocktxn":
+                        await protocol.write_frame(
+                            writer,
+                            protocol.encode_blocktxn(
+                                rng.randbytes(32), [rng.randbytes(40)]
+                            ),
+                        )
+                    elif attack == "addr_spam":
+                        addrs = [
+                            (f"10.66.{rng.randrange(256)}.{rng.randrange(256)}",
+                             rng.randrange(1, 0xFFFF))
+                            for _ in range(64)
+                        ]
+                        await protocol.write_frame(
+                            writer, protocol.encode_addr(addrs)
+                        )
+                    else:  # garbage: malformed bytes — a scorable violation
+                        writer.write(
+                            (rng.randrange(1, 64)).to_bytes(4, "big")
+                            + rng.randbytes(rng.randrange(1, 64))
+                        )
+                        await writer.drain()
+                    bump(attack)
+                    await asyncio.sleep(0.05)
+                # Sign off with the canonical scorable violation so bans
+                # accumulate: a hostile length prefix.
+                writer.write((64 << 20).to_bytes(4, "big"))
+                await writer.drain()
+                bump("oversized")
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass  # node dropped us mid-attack: working as intended
+        finally:
+            if harvester is not None:
+                harvester.cancel()  # no-op if it already returned; its
+                # own except clause swallows disconnects, so no
+                # unretrieved-exception warnings either way
+            writer.close()
+        await asyncio.sleep(0.1)

@@ -313,6 +313,33 @@ async def get_fees(
     return await asyncio.wait_for(_run(), timeout)
 
 
+async def get_status(
+    host: str,
+    port: int,
+    difficulty: int,
+    timeout: float = 10.0,
+    retarget=None,
+) -> dict:
+    """Fetch a running node's full status JSON (`p1 status`) — height,
+    peers, sync/storage health, and the overload block (governor state,
+    admission drops, memory gauge).  Served even while the node sheds
+    load, so the probe works exactly when an operator needs it most."""
+
+    async def _run() -> dict:
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+            await protocol.write_frame(writer, protocol.encode_getstatus())
+            while True:
+                mtype, body = await _read_msg(reader, writer)
+                if mtype is MsgType.STATUS:
+                    return body
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def get_account(
     host: str,
     port: int,

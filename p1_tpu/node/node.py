@@ -35,6 +35,14 @@ from p1_tpu.core.tx import Transaction
 from p1_tpu.mempool import Mempool
 from p1_tpu.miner import Miner
 from p1_tpu.node import protocol
+from p1_tpu.node.governor import (
+    CLASS_BLOCKS,
+    CLASS_QUERIES,
+    CLASS_TXS,
+    PENDING_CBLOCKS_PER_PEER,
+    WRITE_QUEUE_GOSSIP_MAX,
+    ResourceGovernor,
+)
 from p1_tpu.node.protocol import Hello, MsgType
 from p1_tpu.node.supervision import RequestSupervisor
 
@@ -113,6 +121,47 @@ ANCHOR_SLACK_S = 30 * 86_400
 class _Refused(Exception):
     """Session ended by OUR policy (peer cap, self-connect) — ends the
     connection like a ValueError but never scores against the remote."""
+
+
+#: Admission classes per message type (node/governor.py).  Only
+#: UNSOLICITED traffic is charged: pushes (BLOCK/CBLOCK/TX) and requests
+#: that make us compute or serve (the GET* family).  Reply frames
+#: (BLOCKS, MEMPOOL, HEADERS, BLOCKTXN, ACCOUNT, PROOF, FEES) are never
+#: charged — we asked for them, and charging them would let the budget
+#: starve our own IBD.  ADDR keeps its dedicated per-host book budget;
+#: PING/PONG stay free — liveness must never be rationed.
+_MSG_CLASS = {
+    MsgType.BLOCK: CLASS_BLOCKS,
+    MsgType.CBLOCK: CLASS_BLOCKS,
+    MsgType.TX: CLASS_TXS,
+    MsgType.GETBLOCKS: CLASS_QUERIES,
+    MsgType.GETHEADERS: CLASS_QUERIES,
+    MsgType.GETMEMPOOL: CLASS_QUERIES,
+    MsgType.GETACCOUNT: CLASS_QUERIES,
+    MsgType.GETPROOF: CLASS_QUERIES,
+    MsgType.GETFEES: CLASS_QUERIES,
+    MsgType.GETADDR: CLASS_QUERIES,
+    MsgType.GETBLOCKTXN: CLASS_QUERIES,
+    MsgType.GETSTATUS: CLASS_QUERIES,
+}
+
+#: Frames dropped while the node is in the SHED overload state.
+#: Consensus-critical service — block ingest, headers/blocks/proof
+#: serving, liveness, the status probe — stays up; the pool and the
+#: address book (pure capacity consumers, fully recoverable from peers
+#: later) go quiet first, exactly like the storage layer's serve-only
+#: mode sheds ingest but keeps serving.
+_SHED_DROPS = frozenset(
+    {
+        MsgType.TX,
+        MsgType.MEMPOOL,
+        MsgType.GETMEMPOOL,
+        MsgType.GETFEES,
+        MsgType.GETACCOUNT,
+        MsgType.GETADDR,
+        MsgType.ADDR,
+    }
+)
 
 
 @dataclasses.dataclass
@@ -265,6 +314,9 @@ class _Peer:
         self.host: str | None = (
             writer.get_extra_info("peername") or (None,)
         )[0]
+        #: Per-peer multi-class admission budget (node/governor.py),
+        #: assigned by the session once the governor is known.
+        self.budget = None
 
     async def send(self, payload: bytes) -> None:
         await protocol.write_frame(self.writer, payload)
@@ -341,6 +393,15 @@ class Node:
         #: Set when a store failure should end the process instead of
         #: degrading (``--store-degraded-exit``); the CLI watches it.
         self.store_fatal = asyncio.Event()
+        #: Overload resilience (node/governor.py): per-peer admission
+        #: budgets, the write-queue caps, and the SHED state machine over
+        #: the accounted memory gauge (``_memory_gauge``) — the third leg
+        #: of the degradation triad after sync-stall and disk-fault
+        #: handling.
+        self.governor = ResourceGovernor(
+            watermark_bytes=config.mem_watermark_bytes,
+            admission=config.admission_control,
+        )
         if miner is not None:
             self.miner = miner
         else:
@@ -574,14 +635,29 @@ class Node:
             # (not just from the first append): a second node on the same
             # store, or a compaction while we run, must fail loudly.
             self.store.acquire()
-            blocks = self.store.load_blocks()
-            if blocks and blocks[0].header.difficulty != self.config.difficulty:
+            body_cache = self.config.body_cache_blocks
+            if body_cache > 0:
+                # Memory-bounded resume: never materialize the whole
+                # block list — the store streams records through
+                # load_chain's eviction loop, so peak RSS is bounded by
+                # the keep window.  The difficulty pre-check reads just
+                # the first record's header.
+                blocks = None
+                held_difficulty = self.store.first_difficulty()
+            else:
+                blocks = self.store.load_blocks()
+                held_difficulty = (
+                    blocks[0].header.difficulty if blocks else None
+                )
+            if (
+                held_difficulty is not None
+                and held_difficulty != self.config.difficulty
+            ):
                 # Restarting with a different --difficulty would silently
                 # reject every persisted record and interleave a second,
                 # incompatible chain behind them.  Release the writer lock
                 # before raising: an in-process retry with the corrected
                 # difficulty must not find its own leaked flock (ADVICE r3).
-                held_difficulty = blocks[0].header.difficulty
                 self.store.close()
                 raise RuntimeError(
                     f"store {self.store.path} holds a difficulty-"
@@ -603,10 +679,15 @@ class Node:
                     # Our own flocked log of blocks we already validated:
                     # fast resume by default (store.py's trust argument).
                     trusted=not self.config.revalidate_store,
+                    body_cache=body_cache,
                 )
             except ValueError as e:
                 self.store.close()
                 raise RuntimeError(str(e)) from e
+            if body_cache > 0:
+                # Keep evicting as the chain grows past resume (the
+                # governor loop sweeps; the source survives the resume).
+                self.chain.body_source = self.store
             if self.chain.height:
                 log.info(
                     "resumed chain height=%d tip=%s",
@@ -634,6 +715,15 @@ class Node:
             # every multi-round fetch (0 disables, e.g. single-peer
             # tooling rigs that want no background re-requests).
             self._tasks.append(asyncio.create_task(self._supervision_loop()))
+        if (
+            self.config.mem_watermark_bytes > 0
+            or self.config.body_cache_blocks > 0
+        ):
+            # Overload governor tick: gauge observation (SHED
+            # hysteresis) and the body-eviction sweep.  Skipped when
+            # neither feature is configured — admission control and the
+            # write-queue caps are inline and need no loop.
+            self._tasks.append(asyncio.create_task(self._governor_loop()))
         if self.config.mine:
             self.start_mining()
 
@@ -838,6 +928,59 @@ class Node:
             )
             await self.request_sync()
             return
+
+    # -- overload resilience (node/governor.py) ---------------------------
+
+    def _memory_gauge(self) -> int:
+        """The node's accounted memory: resident chain bodies + pending
+        pool bytes + peer transport write buffers.  Deterministic and
+        reversible (unlike OS RSS, which CPython's allocator rarely
+        returns), so the SHED hysteresis can actually come back down
+        when the pressure goes away."""
+        write_buf = 0
+        for peer in self._peers.values():
+            transport = peer.writer.transport
+            if transport is not None and not transport.is_closing():
+                write_buf += transport.get_write_buffer_size()
+        return (
+            self.chain.resident_body_bytes
+            + getattr(self.mempool, "bytes_pending", 0)
+            + write_buf
+        )
+
+    async def _governor_loop(self) -> None:
+        """Gauge tick: feed the SHED state machine and run the body
+        eviction sweep.  A quarter second bounds both detection latency
+        under a flood and how far past the keep window the resident set
+        can grow between sweeps."""
+        while self._running:
+            await asyncio.sleep(0.25)
+            try:
+                if self.config.body_cache_blocks > 0:
+                    self.chain.evict_bodies(self.config.body_cache_blocks)
+                if self.governor.observe(self._memory_gauge()):
+                    if self.governor.shedding:
+                        log.warning(
+                            "overload: %d tracked bytes over the %d "
+                            "watermark — SHED state (low-priority gossip "
+                            "dropped, mining paused)",
+                            self.governor.tracked_bytes,
+                            self.governor.watermark_bytes,
+                        )
+                        # Stop burning CPU on a candidate we'd assemble
+                        # under pressure; the loop pauses itself while
+                        # shedding.
+                        self._abort_inflight_search()
+                    else:
+                        log.warning(
+                            "overload cleared: %d tracked bytes below the "
+                            "low watermark — back to NORMAL",
+                            self.governor.tracked_bytes,
+                        )
+            except Exception:
+                # The governor must never die of one bad tick — it is
+                # the layer that keeps overload survivable.
+                log.exception("governor tick failed")
 
     # -- p2p ------------------------------------------------------------
 
@@ -1337,6 +1480,7 @@ class Node:
         for at most the handshake window."""
         peer = _Peer(writer, label, self.metrics)
         peer.dial_addr = dial_addr
+        peer.budget = self.governor.budget()
         registered = False
         # All session reads go through one FrameReader: timeouts cancel
         # reads at arbitrary awaits, and only a reader that keeps partial
@@ -1509,6 +1653,37 @@ class Node:
 
     async def _dispatch(self, peer: _Peer, payload: bytes) -> None:
         mtype, body = protocol.decode(payload)
+        # Overload front door (node/governor.py), BEFORE any state or
+        # compute is spent on the frame.  SHED drops low-priority
+        # traffic wholesale; admission charges the peer's class budget
+        # for everything unsolicited and drops the excess — sustained
+        # flooding escalates to the ordinary misbehavior score (and so,
+        # eventually, to the accept-time ban).
+        if self.governor.shedding and mtype in _SHED_DROPS:
+            if mtype is MsgType.MEMPOOL:
+                # Not the peer's fault we refused its page: don't let the
+                # in-flight marker age into a stall demerit.
+                peer.mempool_inflight_since = None
+            self.governor.shed_drop()
+            return
+        cls = _MSG_CLASS.get(mtype)
+        if cls is not None and not self.governor.admit(peer.budget, cls):
+            if peer.budget.owes_violation(cls) and peer.host:
+                log.warning(
+                    "admission budget exceeded: dropping %s flood from %s",
+                    cls,
+                    peer.label,
+                )
+                self._record_violation(peer.host)
+                if self._is_banned(peer.host):
+                    # The score just crossed the ban threshold: sever the
+                    # live session too — the accept-time refusal alone
+                    # would let the flooder keep this socket for the
+                    # whole ban and never feel it.
+                    raise _Refused(
+                        f"{cls} flood from {peer.label}: banned"
+                    )
+            return
         if mtype is MsgType.BLOCK:
             sent_ts, block = body
             await self._handle_block(block, origin=peer, sent_ts=sent_ts)
@@ -1693,6 +1868,15 @@ class Node:
             await self._send_guarded(
                 peer, protocol.encode_proof(self.chain.tx_proof(body))
             )
+        elif mtype is MsgType.GETSTATUS:
+            # Operator probe (`p1 status`): the same JSON the node logs,
+            # served over the wire — deliberately NOT in _SHED_DROPS, so
+            # overload stays observable while it is happening.
+            await self._send_guarded(
+                peer, protocol.encode_status(self.status())
+            )
+        elif mtype is MsgType.STATUS:
+            pass  # reply frame: meaningful to querying clients only
         elif mtype is MsgType.PING:
             await self._send_guarded(peer, protocol.encode_pong(body))
         elif mtype is MsgType.PONG:
@@ -1714,7 +1898,28 @@ class Node:
         than ~1.6 MB/s and livelock its initial sync through the reconnect
         loop.  The floor stays at GOSSIP_SEND_TIMEOUT_S for small pushes;
         big replies get 1 s per 100 KB — still far faster than any link
-        worth keeping, but tolerant of a slow-but-live one."""
+        worth keeping, but tolerant of a slow-but-live one.
+
+        Write-queue squat guard (node/governor.py): a peer that keeps
+        ASKING while never READING grows our transport buffer without
+        ever tripping the send timeout (each send returns once the data
+        is buffered).  Past the hard cap the peer is disconnected — the
+        replies it refused to read are re-fetchable, the memory is
+        not."""
+        transport = peer.writer.transport if peer.writer is not None else None
+        if (
+            transport is not None
+            and transport.get_write_buffer_size() > self.governor.write_queue_max
+        ):
+            self.governor.peers_dropped_squat += 1
+            log.warning(
+                "write queue for %s over %d bytes — dropping the "
+                "squatting peer",
+                peer.label,
+                self.governor.write_queue_max,
+            )
+            peer.writer.close()  # reader loop will reap it
+            return
         timeout = GOSSIP_SEND_TIMEOUT_S + len(payload) / 100_000
         try:
             await asyncio.wait_for(peer.send(payload), timeout=timeout)
@@ -1724,8 +1929,24 @@ class Node:
     async def _gossip(self, payload: bytes, skip: _Peer | None = None) -> int:
         """Send to all peers concurrently; a stalled peer times out and is
         dropped instead of blocking propagation (and the mining loop).
-        Returns the number of peers targeted (metrics accounting)."""
-        targets = [p for p in self._peers.values() if p is not skip]
+        Returns the number of peers targeted (metrics accounting).
+
+        Best-effort sends additionally skip peers already sitting on
+        megabytes of unread replies (the soft write-queue bound): there
+        is no point queuing a push behind a backlog, and the skipped
+        peer heals through ordinary locator sync."""
+        targets = []
+        for p in self._peers.values():
+            if p is skip:
+                continue
+            transport = p.writer.transport
+            if (
+                transport is not None
+                and transport.get_write_buffer_size() > WRITE_QUEUE_GOSSIP_MAX
+            ):
+                self.governor.write_queue_drops += 1
+                continue
+            targets.append(p)
         if targets:
             await asyncio.gather(
                 *(self._send_guarded(p, payload) for p in targets)
@@ -1804,6 +2025,14 @@ class Node:
                 Block(header, tuple(txs)), origin=peer, sent_ts=cb.sent_ts
             )
             return
+        held = sum(1 for (_h, p) in self._pending_cblocks if p is peer)
+        if held >= PENDING_CBLOCKS_PER_PEER:
+            # One peer must not squat the reconstruction table: each slot
+            # pins a partially rebuilt block in RAM until the deadline
+            # reaps it.  The block is real (it passed the work gate), so
+            # locator sync recovers it — refusing the slot loses nothing.
+            self.governor.cblock_slot_drops += 1
+            return
         self._pending_cblocks[(bhash, peer)] = _PendingCompact(
             header, txs, want, cb.sent_ts, asked_at=time.monotonic()
         )
@@ -1868,6 +2097,14 @@ class Node:
             # served it: the supervised sync's deadline and attempt
             # budget reset (supervision.py — the honest-slow guarantee).
             self._sync.progress()
+            if gossip and getattr(origin, "budget", None) is not None:
+                # A pushed block that connected as NEW earns its charge
+                # back (governor.py): PoW makes new blocks self-limiting,
+                # so the blocks budget only ever throttles duplicates,
+                # stale relays, and orphan spray — an honest miner can
+                # never exhaust it, however fast the mesh mines.  Batch
+                # sync replies (gossip=False) were never charged.
+                origin.budget.refund(CLASS_BLOCKS)
             if sent_ts is not None:
                 # Push-gossip propagation delay (send -> accept), recorded
                 # only for blocks that actually connected: duplicates and
@@ -2023,11 +2260,11 @@ class Node:
 
         loop = asyncio.get_running_loop()
         while self._running:
-            if self._store_degraded:
-                # Serve-only: a sealed block would be refused at the
-                # door (it cannot be persisted), so don't burn the CPU
-                # sealing it.  Mining resumes the moment recovery clears
-                # the flag.
+            if self._store_degraded or self.governor.shedding:
+                # Serve-only / SHED: a sealed block would be refused at
+                # the door (degraded disk) or assembled under memory
+                # pressure the node is trying to shed — don't burn the
+                # CPU.  Mining resumes the moment the state clears.
                 await asyncio.sleep(0.25)
                 continue
             candidate = self._assemble()
@@ -2135,6 +2372,20 @@ class Node:
                 "healed": dict(self.store.healed)
                 if self.store is not None
                 else None,
+            },
+            # Overload resilience (node/governor.py): SHED state +
+            # hysteresis over the accounted memory gauge, per-peer
+            # admission drops, write-queue enforcement, and the
+            # memory-bounded operation telemetry (bodies evicted from
+            # the RAM index / refetched on demand from the store).
+            "overload": {
+                **self.governor.snapshot(),
+                "resident_body_bytes": self.chain.resident_body_bytes,
+                "bodies_evicted": self.chain.bodies_evicted,
+                "body_refetches": self.chain.body_refetches,
+                "body_cache_blocks": self.config.body_cache_blocks,
+                "mining_paused": self.governor.shedding
+                or self._store_degraded,
             },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly

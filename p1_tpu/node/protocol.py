@@ -94,6 +94,14 @@ Messages:
 - GETHEADERS: u16 count + count * 32-byte locator hashes — headers-first
              sync for light clients (`p1 headers`): same locator
              semantics as GETBLOCKS, but the reply carries bare headers.
+- GETSTATUS: empty body — operator probe (`p1 status`): ask a running
+             node for its full status JSON (height, peers, sync/storage/
+             overload state).  Served even in the SHED overload state:
+             overload must stay observable while it is happening.
+- STATUS:    the node's ``status()`` dict as canonical JSON (utf-8).
+             Deliberately JSON, not a packed layout — the status surface
+             grows every round and must not cost a version bump per
+             field.
 - HEADERS:   u16 count + count * 80-byte serialized headers, main chain
              ascending from the first recognized locator hash.  A light
              client iterates GETHEADERS until the reply is empty, then
@@ -146,8 +154,10 @@ _LEN = struct.Struct(">I")
 #: BLOCKTXN); v5 headers-first sync (GETHEADERS/HEADERS); v6 peer
 #: discovery (GETADDR/ADDR + the HELLO instance nonce); v7 fee
 #: estimation (GETFEES/FEES); v8 liveness (PING/PONG + handshake/idle
-#: deadlines — a v7 node would call the probe a protocol violation).
-PROTOCOL_VERSION = 8
+#: deadlines — a v7 node would call the probe a protocol violation); v9
+#: the operator status probe (GETSTATUS/STATUS — `p1 status` renders a
+#: running node's full status JSON, overload block included).
+PROTOCOL_VERSION = 9
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -174,6 +184,8 @@ class MsgType(enum.IntEnum):
     FEES = 20
     PING = 21
     PONG = 22
+    GETSTATUS = 23
+    STATUS = 24
 
 
 @dataclasses.dataclass(frozen=True)
@@ -357,6 +369,22 @@ def encode_fees(stats: FeeStats) -> bytes:
 
 def encode_getaddr() -> bytes:
     return bytes([MsgType.GETADDR])
+
+
+def encode_getstatus() -> bytes:
+    return bytes([MsgType.GETSTATUS])
+
+
+def encode_status(status: dict) -> bytes:
+    """The node's ``status()`` dict as canonical JSON (v9, `p1 status`).
+    JSON rather than a packed layout: the status surface grows every
+    round, and an operator probe should never be the reason a field
+    addition bumps the wire version."""
+    import json
+
+    return bytes([MsgType.STATUS]) + json.dumps(
+        status, separators=(",", ":")
+    ).encode("utf-8")
 
 
 def encode_ping(nonce: int) -> bytes:
@@ -604,6 +632,20 @@ def _decode(payload: bytes):
         if body:
             raise ValueError("bad GETADDR")
         return mtype, None
+    if mtype is MsgType.GETSTATUS:
+        if body:
+            raise ValueError("bad GETSTATUS")
+        return mtype, None
+    if mtype is MsgType.STATUS:
+        import json
+
+        try:
+            status = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise ValueError(f"bad STATUS payload: {e}") from e
+        if not isinstance(status, dict):
+            raise ValueError("bad STATUS payload: not an object")
+        return mtype, status
     if mtype in (MsgType.PING, MsgType.PONG):
         if len(body) != 8:
             raise ValueError(f"bad {mtype.name}")

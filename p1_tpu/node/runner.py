@@ -1,0 +1,115 @@
+"""The `p1 node` process runner: args namespace -> configured Node loop.
+
+Extracted from ``cli.py`` (which keeps only parsing + dispatch): builds
+the ``NodeConfig``, runs the node through its deadline/duration/status
+loop, and owns the quiesce dance and the ``--store-degraded-exit``
+watch.  `p1 pod`'s leader reuses it with its own arg namespace and a
+``PodMiner`` injected.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import sys
+import time
+
+
+async def run_node(args, miner=None) -> int:
+    from p1_tpu.config import NodeConfig
+    from p1_tpu.node import Node
+
+    config = NodeConfig(
+        difficulty=args.difficulty,
+        backend=args.backend,
+        host=args.host,
+        port=args.port,
+        peers=tuple(args.peers),
+        mine=not args.no_mine,
+        store_path=args.store,
+        batch=args.batch,
+        chunk=args.chunk,
+        miner_id=args.miner_id,
+        # getattr: `p1 pod` reuses this runner with its own arg namespace,
+        # which has no retarget or compact-gossip flags (pod mining is
+        # fixed-difficulty — config 5's shape).
+        retarget_window=getattr(args, "retarget_window", 0),
+        target_spacing=getattr(args, "target_spacing", 0),
+        compact_gossip=not getattr(args, "no_compact_gossip", False),
+        target_peers=getattr(args, "target_peers", 0),
+        mempool_ttl_s=getattr(args, "mempool_ttl", 3600.0),
+        handshake_timeout_s=getattr(args, "handshake_timeout", 10.0),
+        ping_interval_s=getattr(args, "ping_interval", 60.0),
+        pong_timeout_s=getattr(args, "pong_timeout", 20.0),
+        sync_stall_timeout_s=getattr(args, "sync_stall_timeout", 10.0),
+        sync_attempts_max=getattr(args, "sync_attempts", 8),
+        revalidate_store=getattr(args, "revalidate_store", False),
+        store_degraded_exit=getattr(args, "store_degraded_exit", False),
+        # Overload resilience (node/governor.py): the watermark flag is
+        # MB on the command line, bytes in the config.
+        admission_control=not getattr(args, "no_admission_control", False),
+        mem_watermark_bytes=int(
+            getattr(args, "mem_watermark_mb", 0.0) * (1 << 20)
+        ),
+        body_cache_blocks=getattr(args, "body_cache", 0),
+    )
+    node = Node(config, miner=miner)
+    await node.start()
+    # --store-degraded-exit watch: the node signals instead of exiting
+    # itself so teardown (final status line, mempool save, store close)
+    # still runs through the one path below.  Exit code 4.
+    fatal = asyncio.ensure_future(node.store_fatal.wait())
+    rc = 0
+    try:
+        if args.deadline is not None or args.duration is not None:
+            if args.deadline == "stdin":
+                print(json.dumps({"ready": node.port}), flush=True)
+                loop = asyncio.get_running_loop()
+                line = await loop.run_in_executor(None, sys.stdin.readline)
+                deadline = float(line.strip())
+            elif args.deadline is not None:
+                deadline = float(args.deadline)
+            else:
+                deadline = time.time() + args.duration
+            window = max(0.0, deadline - time.time())
+            logging.info("mining window: %.2fs until deadline", window)
+            await asyncio.wait({fatal}, timeout=window)
+            if fatal.done():
+                rc = 4
+            else:
+                # Quiesce: stop producing, then wait for the gossip
+                # backlog to drain (GIL-bound mining starves the event
+                # loop, so a fixed sleep can undershoot): exit once the
+                # chain has been stable for a full second, or after 20s
+                # regardless.
+                await node.stop_mining()
+                await node.request_sync()
+                t_end = time.monotonic() + 20.0
+                stable = (node.chain.tip_hash, node.metrics.blocks_accepted)
+                stable_since = time.monotonic()
+                while time.monotonic() < t_end:
+                    await asyncio.sleep(0.1)
+                    now_state = (
+                        node.chain.tip_hash,
+                        node.metrics.blocks_accepted,
+                    )
+                    if now_state != stable:
+                        stable, stable_since = now_state, time.monotonic()
+                        await node.request_sync()
+                    elif time.monotonic() - stable_since >= 1.0:
+                        break
+        else:
+            while True:
+                await asyncio.wait({fatal}, timeout=args.status_interval)
+                if fatal.done():
+                    rc = 4
+                    break
+                print(json.dumps(node.status()), flush=True)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        fatal.cancel()
+        print(json.dumps(node.status()), flush=True)
+        await node.stop()
+    return rc

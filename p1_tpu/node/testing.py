@@ -44,7 +44,7 @@ from p1_tpu.core.tx import Transaction
 from p1_tpu.node import protocol
 from p1_tpu.node.protocol import Hello, MsgType
 
-__all__ = ["FaultPlan", "HostilePeer", "make_blocks"]
+__all__ = ["FaultPlan", "FloodPlan", "GreedyPeer", "HostilePeer", "make_blocks"]
 
 #: Request types whose replies the fault machinery can intercept — the
 #: multi-round fetches request supervision covers, exactly.
@@ -133,6 +133,177 @@ class FaultPlan:
     hello_height: int | None = None
     #: MEMPOOL reply shape: the ``more`` flag on served pages.
     mempool_more: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class FloodPlan:
+    """One scripted resource-exhaustion profile for a ``GreedyPeer``.
+
+    Everything a GreedyPeer sends is PROTOCOL-VALID — well-formed frames,
+    real PoW where blocks are involved, decodable transactions.  That is
+    the point: the misbehavior score cannot see these floods (nothing is
+    malformed), so only the governor's admission budgets, slot caps, and
+    write-queue enforcement stand between a handful of greedy peers and
+    node memory.  The complement of ``FaultPlan``: faults starve, floods
+    drown."""
+
+    #: Push the served chain's blocks over and over (full BLOCK frames —
+    #: valid work, instant duplicates after round one): index/dedup
+    #: pressure plus raw blocks-class traffic.
+    blocks: bool = False
+    #: Spray valid-PoW blocks whose parent the victim cannot know (the
+    #: connecting block is withheld): orphan-pool pressure.
+    orphans: bool = False
+    #: Loop these raw TX payload frames (caller signs them; admission
+    #: may refuse them for affordability, but each one still costs the
+    #: victim a decode + signature check unless dropped at the door).
+    tx_frames: tuple = ()
+    #: Hammer GETBLOCKS/GETHEADERS with genesis locators — each reply is
+    #: a full sync batch the victim must assemble and serve.
+    queries: bool = False
+    #: The write-queue squat: keep asking for sync batches and NEVER
+    #: read the socket — the victim's transport buffer grows until its
+    #: write-queue cap drops us (or its memory does not survive).
+    squat: bool = False
+    #: Frames per burst between event-loop yields.
+    burst: int = 32
+    #: Sleep between bursts (0 = as fast as the loop allows).
+    pause_s: float = 0.0
+
+
+class GreedyPeer:
+    """A protocol-valid flooder: dials the victim, completes a real
+    HELLO, then runs its ``FloodPlan`` until stopped — reconnecting
+    (counted) whenever the victim drops or bans it, exactly like a real
+    attacker would.
+
+    Usage::
+
+        peer = GreedyPeer(make_blocks(12, difficulty=8),
+                          plan=FloodPlan(queries=True))
+        await peer.start("127.0.0.1", victim.port)
+        ...
+        await peer.stop()
+        assert peer.sent > 0
+
+    ``sent`` counts frames written, ``disconnects`` how often the victim
+    (or its ban layer) cut us off, ``refused`` connects that never got a
+    HELLO back (an accept-time ban working)."""
+
+    def __init__(
+        self,
+        blocks: list[Block],
+        plan: FloodPlan = FloodPlan(),
+        source: str | None = None,
+    ):
+        assert blocks, "need at least a genesis block"
+        self.blocks = list(blocks)
+        self.plan = plan
+        #: Local address to dial FROM (a loopback alias like 127.0.0.66),
+        #: so the victim's per-host scoring lands on the attacker, not on
+        #: every other localhost peer — same trick as the byzantine suite.
+        self.source = source
+        self.nonce = secrets.randbits(64) | 1
+        self.sent = 0
+        self.disconnects = 0
+        self.refused = 0
+        self._task: asyncio.Task | None = None
+        self._stopping = False
+
+    async def start(self, host: str, port: int) -> None:
+        self._task = asyncio.create_task(self._run(host, port))
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def _frames(self) -> list[bytes]:
+        plan = self.plan
+        out: list[bytes] = []
+        if plan.blocks:
+            out += [protocol.encode_block(b) for b in self.blocks[1:]]
+        if plan.orphans:
+            # Withhold the connecting block: everything from [2:] parks
+            # in the victim's orphan pool (valid PoW, unknown parent).
+            out += [protocol.encode_block(b) for b in self.blocks[2:]]
+        out += list(plan.tx_frames)
+        if plan.queries:
+            genesis_locator = [self.blocks[0].block_hash()]
+            out += [
+                protocol.encode_getblocks(genesis_locator),
+                protocol.encode_getheaders(genesis_locator),
+            ]
+        if plan.squat:
+            out += [protocol.encode_getblocks([self.blocks[0].block_hash()])]
+        assert out, "empty FloodPlan"
+        return out
+
+    async def _run(self, host: str, port: int) -> None:
+        frames = self._frames()
+        hello = protocol.encode_hello(
+            Hello(
+                self.blocks[0].block_hash(),
+                len(self.blocks) - 1,
+                0,
+                self.nonce,
+            )
+        )
+        while not self._stopping:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    host,
+                    port,
+                    local_addr=(self.source, 0) if self.source else None,
+                )
+            except OSError:
+                await asyncio.sleep(0.1)
+                continue
+            drain_task = None
+            try:
+                await protocol.write_frame(writer, hello)
+                try:
+                    await asyncio.wait_for(protocol.read_frame(reader), 5.0)
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+                    self.refused += 1
+                    continue
+                if not self.plan.squat:
+                    # Keep the socket's inbound side drained so OUR read
+                    # buffer never backpressures the victim's replies
+                    # into its own send timeout — a squatter does the
+                    # opposite on purpose.
+                    async def _drain():
+                        while True:
+                            if not await reader.read(1 << 16):
+                                return
+
+                    drain_task = asyncio.create_task(_drain())
+                i = 0
+                while not self._stopping:
+                    for _ in range(self.plan.burst):
+                        writer.write(
+                            struct.pack(">I", len(frames[i % len(frames)]))
+                            + frames[i % len(frames)]
+                        )
+                        self.sent += 1
+                        i += 1
+                    await writer.drain()
+                    if self.plan.pause_s:
+                        await asyncio.sleep(self.plan.pause_s)
+                    else:
+                        await asyncio.sleep(0)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                self.disconnects += 1
+                await asyncio.sleep(0.05)
+            finally:
+                if drain_task is not None:
+                    drain_task.cancel()
+                writer.close()
 
 
 class _Session:
