@@ -189,6 +189,36 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Untrusted-path validation (round 8): quick same-session
+    # revalidation measurement — serial vs batched signature lane on a
+    # small store — reported against the ONE recorded constant
+    # (perf_record.py RECORDED_REVALIDATE_BPS), the same
+    # denominator-pinning convention as the ratios above.
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_REVALIDATE_BPS,
+        REVALIDATE_DEGRADED_FRACTION,
+    )
+
+    try:
+        from benchmarks.sig_verify import bench_revalidate
+
+        reval = bench_revalidate(400, repeats=3)
+        extra["revalidate_bps"] = reval["revalidate_bps"]
+        extra["revalidate_speedup"] = reval["revalidate_speedup"]
+        extra["revalidate_vs_recorded"] = round(
+            reval["revalidate_bps"] / RECORDED_REVALIDATE_BPS, 2
+        )
+        if (
+            reval["revalidate_bps"]
+            < REVALIDATE_DEGRADED_FRACTION * RECORDED_REVALIDATE_BPS
+        ):
+            extra["revalidate_degraded"] = True
+        from p1_tpu.core.keys import BACKEND as SIG_BACKEND
+
+        extra["sig_backend"] = SIG_BACKEND
+    except ImportError:
+        pass
+
     from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
 
     print(

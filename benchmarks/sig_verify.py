@@ -1,0 +1,210 @@
+"""Signature-verification microbench: serial vs batch vs cached.
+
+The untrusted-path validation fast lane (round 8) rests on three claims:
+batched Ed25519 verification beats one-at-a-time calls, the verify-once
+cache makes re-checks free, and the pure-Python fallback's batch path —
+one multi-scalar multiplication per window — closes a useful fraction of
+the gap to the native wheel.  This harness measures all three on THIS
+machine, same contract as ``bench.py``: one JSON line, measured, no
+estimates.
+
+Rows cover both crypto backends where available: the ACTIVE backend
+(whatever ``core/keys.py`` resolved — the wheel when present) and the
+pure-Python fallback explicitly, so a wheel-equipped host reports both
+and a wheel-less CI image still shows the fallback's serial→batch gain
+next to the recorded constants the one-time warning cites
+(``_ed25519.RECORDED_SERIAL_MS`` / ``RECORDED_BATCH_MS``).
+
+Optionally (``--store-blocks N``) builds an on-disk store and measures
+full untrusted revalidation three ways — serial (fast lane disabled),
+batched, and batched+cache-warm — the microscale version of docs/PERF.md
+"Untrusted-path validation".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _make_triples(n: int, keypairs):
+    out = []
+    for i in range(n):
+        kp = keypairs[i % len(keypairs)]
+        msg = b"sig-verify-bench-%d" % i
+        out.append((kp.pubkey, kp.sign(msg), msg))
+    return out
+
+
+def _rate(fn, payload_sigs: int, repeats: int = 3) -> float:
+    """Best-of-N signatures/second for ``fn()`` covering ``payload_sigs``."""
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        best = max(best, payload_sigs / dt)
+    return best
+
+
+def bench_micro(batch_sizes=(64, 256, 1024, 4096), serial_n=64) -> dict:
+    from p1_tpu.core import _ed25519, keys
+
+    keypairs = [keys.Keypair.from_seed_text(f"sigbench-{i}") for i in range(8)]
+    out: dict = {"backend": keys.BACKEND, "workers": keys.verify_workers()}
+
+    triples = _make_triples(serial_n, keypairs)
+    out["serial_us"] = round(
+        1e6 / _rate(lambda: all(keys.verify(*t) for t in triples), serial_n), 1
+    )
+    if keys.BACKEND != "pure-python":
+        out["fallback_serial_us"] = round(
+            1e6
+            / _rate(
+                lambda: all(_ed25519.verify(*t) for t in triples), serial_n
+            ),
+            1,
+        )
+
+    for n in batch_sizes:
+        tr = _make_triples(n, keypairs)
+        _ed25519._pubkey_point.cache_clear()
+        out[f"batch{n}_us"] = round(
+            1e6 / _rate(lambda: keys.verify_batch(tr), n), 1
+        )
+        if keys.BACKEND != "pure-python":
+            _ed25519._pubkey_point.cache_clear()
+            out[f"fallback_batch{n}_us"] = round(
+                1e6 / _rate(lambda: _ed25519.verify_batch(tr), n), 1
+            )
+    biggest = max(batch_sizes)
+    out["batch_speedup"] = round(
+        out["serial_us"] / out[f"batch{biggest}_us"], 1
+    )
+
+    # Cached path: the verify-once memo a block connect hits for
+    # mempool-resident transfers (txid-keyed, core/sigcache.py).
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.sigcache import SignatureCache
+    from p1_tpu.core.tx import Transaction
+
+    cache = SignatureCache()
+    tag = genesis_hash(8)
+    txs = [
+        Transaction.transfer(keypairs[0], "r", 1, 0, i, chain=tag)
+        for i in range(256)
+    ]
+    for tx in txs:
+        tx.verify_signature(cache=cache)  # populate
+    out["cached_us"] = round(
+        1e6
+        / _rate(
+            lambda: all(tx.verify_signature(cache=cache) for tx in txs),
+            len(txs),
+        ),
+        2,
+    )
+    return out
+
+
+def bench_revalidate(n_blocks: int, repeats: int = 3) -> dict:
+    """Store revalidation three ways (median-of-``repeats`` each)."""
+    from benchmarks.host_ingest import build_blocks
+    from p1_tpu.chain import validate
+    from p1_tpu.chain.store import ChainStore, save_chain
+    from p1_tpu.core import keys
+    from p1_tpu.core.sigcache import SignatureCache
+
+    chain, _raws = build_blocks(n_blocks, 2, difficulty=1)
+    out: dict = {"store_blocks": n_blocks}
+    with tempfile.TemporaryDirectory() as td:
+        path = Path(td) / "bench.chain"
+        save_chain(chain, path)
+
+        def run(serial: bool, warm_cache=None) -> float:
+            import p1_tpu.chain.store as store_mod
+
+            store = ChainStore(path)
+            times = []
+            for _ in range(repeats):
+                cache = warm_cache if warm_cache is not None else SignatureCache()
+                old_min = keys.BATCH_MIN
+                old_pre = validate.preverify_signatures
+                old_stream = store_mod._preverify_stream
+                if serial:
+                    # Disable the fast lane: per-tx backend calls, the
+                    # pre-round-8 cost model.
+                    keys.BATCH_MIN = 1 << 30
+                    validate.preverify_signatures = (
+                        lambda txs, tag, sig_cache=None: 0
+                    )
+                    store_mod._preverify_stream = (
+                        lambda blocks, tag, cache: blocks
+                    )
+                try:
+                    t0 = time.perf_counter()
+                    store.load_chain(1, trusted=False, sig_cache=cache)
+                    times.append(time.perf_counter() - t0)
+                finally:
+                    keys.BATCH_MIN = old_min
+                    validate.preverify_signatures = old_pre
+                    store_mod._preverify_stream = old_stream
+            store.close()
+            return statistics.median(times)
+
+        t_serial = run(serial=True)
+        t_batch = run(serial=False)
+        warm = SignatureCache()
+        run(serial=False, warm_cache=warm)  # populate
+        t_cached = run(serial=False, warm_cache=warm)
+        t_trusted_store = ChainStore(path)
+        t0 = time.perf_counter()
+        t_trusted_store.load_chain(1, trusted=True)
+        t_trusted = time.perf_counter() - t0
+        t_trusted_store.close()
+    out["revalidate_serial_s"] = round(t_serial, 3)
+    out["revalidate_batch_s"] = round(t_batch, 3)
+    out["revalidate_cached_s"] = round(t_cached, 3)
+    out["trusted_resume_s"] = round(t_trusted, 3)
+    out["revalidate_speedup"] = round(t_serial / t_batch, 2)
+    out["revalidate_bps"] = round(n_blocks / t_batch)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--batch-sizes", type=int, nargs="*", default=[64, 256, 1024, 4096]
+    )
+    ap.add_argument(
+        "--store-blocks",
+        type=int,
+        default=0,
+        help="also build an N-block store (1 signed transfer every other "
+        "block) and measure full revalidation serial vs batch vs cached",
+    )
+    args = ap.parse_args()
+
+    result = bench_micro(tuple(args.batch_sizes))
+    if args.store_blocks:
+        result.update(bench_revalidate(args.store_blocks))
+    try:
+        load_1m, load_5m, _ = os.getloadavg()
+        result["load_avg_1m"] = round(load_1m, 2)
+        result["load_avg_5m"] = round(load_5m, 2)
+    except OSError:
+        pass
+    result["cpu_count"] = os.cpu_count()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

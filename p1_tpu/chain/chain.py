@@ -50,6 +50,7 @@ import dataclasses
 import enum
 from typing import Iterator
 
+from p1_tpu.core import sigcache
 from p1_tpu.core.block import Block, merkle_branch
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.core.header import BlockHeader
@@ -147,6 +148,12 @@ class Chain:
             ghash: _Entry(self.genesis, self.genesis.header, 0, 1 << difficulty)
         }
         self._tip_hash = ghash
+        #: Verify-once signature cache consulted by every ``check_block``
+        #: this index runs (core/sigcache.py).  The process default by
+        #: default; a Node wires its own instance in so admission-time
+        #: verifies are what block connect hits, and its telemetry is
+        #: per-node.
+        self.sig_cache = sigcache.DEFAULT
         #: Memory-bounded operation (node/governor.py): an object with
         #: ``has_body(bhash)`` / ``read_body(bhash)`` — the ChainStore —
         #: that can re-serve an evicted block body on demand.  None (the
@@ -596,6 +603,7 @@ class Chain:
                     block,
                     expected,
                     chain_tag=self.genesis.block_hash(),
+                    sig_cache=self.sig_cache,
                 )
             except ValidationError as e:
                 return AddStatus.REJECTED, str(e)
@@ -713,7 +721,12 @@ class Chain:
             # work (same floor as proof.py's SPV check).
             return AddStatus.REJECTED, "difficulty-0 block carries no work"
         try:
-            check_block(block, claimed, chain_tag=self.genesis.block_hash())
+            check_block(
+                block,
+                claimed,
+                chain_tag=self.genesis.block_hash(),
+                sig_cache=self.sig_cache,
+            )
         except ValidationError as e:
             return AddStatus.REJECTED, str(e)
         self._orphans.setdefault(block.header.prev_hash, []).append(block)

@@ -157,6 +157,16 @@ def build_parser() -> argparse.ArgumentParser:
         "resume (the store is this node's own validated, flocked log)",
     )
     p.add_argument(
+        "--verify-workers",
+        type=int,
+        default=0,
+        help="worker threads for batched Ed25519 verification on the "
+        "untrusted validation paths (--revalidate-store, deep sync); "
+        "0 = auto (P1_VERIFY_WORKERS env, else cpu count).  With the "
+        "cryptography wheel, threads verify in parallel (OpenSSL "
+        "releases the GIL); never changes validation outcomes",
+    )
+    p.add_argument(
         "--store-degraded-exit",
         action="store_true",
         help="exit (code 4) on the first store write failure instead of "

@@ -99,6 +99,16 @@ class NodeConfig:
     #: (docs/PERF.md "Memory-bounded operation").  0 disables (fully
     #: resident — the historical behavior); requires ``store_path``.
     body_cache_blocks: int = 0
+    #: Validation fast lane (core/keys.py): worker-pool size for batched
+    #: Ed25519 verification on the untrusted paths (revalidation,
+    #: foreign-store loads, deep-sync batches).  0 = auto (the
+    #: ``P1_VERIFY_WORKERS`` env var, else ``os.cpu_count()``) — with
+    #: the ``cryptography`` wheel the backend releases the GIL inside
+    #: OpenSSL, so workers give real multi-core parallelism; the
+    #: pure-Python fallback batches via one multi-scalar multiplication
+    #: per window instead.  Worker count NEVER changes validation
+    #: outcomes, only where the verify cost is paid.
+    verify_workers: int = 0
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

@@ -11,16 +11,48 @@ byte-identical keys and signatures to the wheel — Ed25519 signing is
 fully deterministic, so the two backends are interchangeable per key.
 
 Performance: a few milliseconds per sign/verify (extended-coordinate
-double-and-add over Python ints) vs ~100 µs native.  That is fine where
-this runs: ``keys.verify`` memoizes verification per (pubkey, sig,
-message), so each transaction pays the cost once per process no matter
-how many times gossip, block validation, and reorg resurrection
-re-check it.
+double-and-add over Python ints) vs ~100 µs native.  Two things keep
+that affordable on the hot paths: the tx-level verify-once cache
+(core/sigcache.py) pays the cost once per transaction per process, and
+``verify_batch`` below amortizes what DOES have to be verified —
+untrusted-path validation (`--revalidate-store`, foreign stores, deep
+sync) verifies whole windows of signatures in one multi-scalar
+multiplication instead of one double-and-add ladder each (measured
+7.4–8.4× per signature at window sizes 256–4096 on the 1-vCPU bench
+host; benchmarks/sig_verify.py).
+
+Batch semantics, stated precisely (the "Taming the many EdDSAs"
+trade-off): the batch checks the COFACTORED equation ``[8][Σ z_i s_i]B
+= [8]Σ z_i R_i + [8]Σ z_i k_i A_i`` with per-process-random 128-bit
+coefficients ``z_i`` — the only linear form that is sound to batch.
+Every signature the serial (cofactorless) check accepts also passes the
+batch, and any signature failing the cofactored equation makes the
+batch fail with probability 1 − 2⁻¹²⁸, after which callers bisect down
+to the serial verdict (``keys.first_invalid``) — so accept/reject and
+error text match the serial path for every honestly-generated or
+randomly-corrupted input (property-tested at every position,
+tests/test_sigbatch.py).  The one reachable divergence: a signer who
+deliberately crafts a small-order torsion component into their OWN
+public key or nonce point can make a signature the serial check rejects
+and the batch accepts.  Honest keys are torsion-free by construction
+(clamped scalars are ≡ 0 mod 8), the craft risks only the crafter's own
+account, and random corruption lands there with probability ~2⁻²⁵⁰ —
+the same superset Zcash consensus standardized on when it adopted
+batched Ed25519.
 """
 
 from __future__ import annotations
 
+import functools
 import hashlib
+import secrets
+
+#: Recorded fallback verify costs on the 1-vCPU bench host (2026-08-04,
+#: benchmarks/sig_verify.py) — what keys.py's one-time "fallback active
+#: for a batch path" warning names, so CI-without-wheel numbers are
+#: never mistaken for regressions against the wheel-based records.
+RECORDED_SERIAL_MS = 3.1
+RECORDED_BATCH_MS = 0.36
 
 _P = 2**255 - 19  # field prime
 _Q = 2**252 + 27742317777372353535851937790883648493  # group order
@@ -90,13 +122,24 @@ def _pt_compress(pt) -> bytes:
 def _recover_x(y: int, sign: int) -> int | None:
     if y >= _P:
         return None
-    x2 = (y * y - 1) * pow(_D * y * y + 1, _P - 2, _P) % _P
-    if x2 == 0:
+    # RFC 8032 §5.1.3's combined inversion+square-root: x² = u/v with
+    # u = y²−1, v = d·y²+1, and x = u·v³·(u·v⁷)^((p−5)/8) — ONE modular
+    # exponentiation where the naive u·v⁻¹ then sqrt pays two.  Point
+    # decompression is the per-signature fixed cost of batch
+    # verification (R is unique per signature), so this halves its floor.
+    y2 = y * y % _P
+    u = (y2 - 1) % _P
+    v = (_D * y2 + 1) % _P
+    if u == 0:
         return None if sign else 0
-    x = pow(x2, (_P + 3) // 8, _P)
-    if (x * x - x2) % _P != 0:
+    v3 = v * v % _P * v % _P
+    x = u * v3 % _P * pow(u * v3 % _P * v3 % _P * v % _P, (_P - 5) // 8, _P) % _P
+    vx2 = v * x % _P * x % _P
+    if vx2 != u:
+        if vx2 != _P - u:
+            return None
         x = x * _SQRT_M1 % _P
-    if (x * x - x2) % _P != 0:
+    if x == 0 and sign:
         return None
     if (x & 1) != sign:
         x = _P - x
@@ -155,3 +198,117 @@ def verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
         return False
     k = int.from_bytes(_sha512(sig[:32] + pubkey + message), "little") % _Q
     return _pt_equal(_pt_mul(s, _B), _pt_add(r_pt, _pt_mul(k, a_pt)))
+
+
+# -- batch verification (untrusted-path fast lane) -----------------------
+#
+# One multi-scalar multiplication over all (R_i, A_i, B) replaces 2n
+# double-and-add ladders: Pippenger's bucket method costs roughly
+# (bits/c)·(n + 2^c) point additions for the whole batch vs ~770
+# additions per signature serially, so per-signature cost falls from
+# ~3.1 ms to ~360–420 µs at window sizes 256–4096 on this host (the
+# remaining floor is one R-point decompression per signature).  The
+# equation checked and its exact relationship to serial verification
+# are documented in the module docstring above.
+
+
+@functools.lru_cache(maxsize=4096)
+def _pubkey_point(pubkey: bytes):
+    """Decompressed public-key point, cached: senders repeat across the
+    transactions of a window (one account signs many spends), and a
+    decompression costs two ~250-bit modular exponentiations.  R points
+    are unique per signature and are never cached."""
+    return _pt_decompress(pubkey)
+
+
+def _msm(pairs) -> tuple:
+    """Σ scalar·point over ``pairs`` (Pippenger bucket method).
+
+    Scalars are plain non-negative integers — deliberately NOT reduced
+    mod the group order by this function: R and A points supplied by a
+    hostile signer may carry 8-torsion components, where arithmetic
+    mod q is invalid.  The caller multiplies the result by the cofactor
+    before comparing, which is what makes the mixed-width scalars here
+    sound.
+    """
+    pairs = [(s, p) for s, p in pairs if s > 0]
+    if not pairs:
+        return _IDENT
+    maxbits = max(s.bit_length() for s, _ in pairs)
+    n = len(pairs)
+    # Window width: minimize (maxbits/c)·(n + 2^(c+1)) — the point pass
+    # is n adds per window, the running-sum bucket aggregation 2·2^c.
+    c = min(
+        range(2, 16),
+        key=lambda w: -(-maxbits // w) * (n + (2 << w)),
+    )
+    nbuckets = 1 << c
+    mask = nbuckets - 1
+    result = _IDENT
+    for shift in range(((maxbits + c - 1) // c) - 1, -1, -1):
+        if result is not _IDENT:
+            for _ in range(c):
+                result = _pt_double(result)
+        buckets = [None] * nbuckets
+        base = shift * c
+        for s, p in pairs:
+            idx = (s >> base) & mask
+            if idx:
+                b = buckets[idx]
+                buckets[idx] = p if b is None else _pt_add(b, p)
+        # Running-sum aggregation: Σ idx·bucket[idx] with 2·(2^c) adds.
+        running = None
+        acc = None
+        for idx in range(nbuckets - 1, 0, -1):
+            b = buckets[idx]
+            if b is not None:
+                running = b if running is None else _pt_add(running, b)
+            if running is not None:
+                acc = running if acc is None else _pt_add(acc, running)
+        if acc is not None:
+            result = acc if result is _IDENT else _pt_add(result, acc)
+    return result
+
+
+def verify_batch(triples) -> bool:
+    """True iff every ``(pubkey, sig, message)`` triple verifies, checked
+    as ONE cofactored random-linear-combination equation (module
+    docstring).  False means at least one signature is bad (up to the
+    2⁻¹²⁸ soundness bound) — callers bisect to find which, so the
+    per-signature verdict and error reporting stay the serial path's.
+    """
+    pairs = []  # (scalar, point) terms of the combination
+    s_total = 0  # coefficient of the base point, mod Q (B has order Q)
+    for pubkey, sig, message in triples:
+        if len(pubkey) != 32 or len(sig) != 64:
+            return False
+        a_pt = _pubkey_point(bytes(pubkey))
+        if a_pt is None:
+            return False
+        r_pt = _pt_decompress(sig[:32])
+        if r_pt is None:
+            return False
+        s = int.from_bytes(sig[32:], "little")
+        if s >= _Q:
+            return False
+        k = int.from_bytes(_sha512(sig[:32] + pubkey + message), "little") % _Q
+        # Unpredictable per-batch coefficients: an adversary must not be
+        # able to craft signatures whose errors cancel in the sum.
+        z = secrets.randbits(128) | 1
+        s_total = (s_total + z * s) % _Q
+        pairs.append((z, r_pt))
+        # z·k reduced mod Q: for a torsioned A the reduction perturbs the
+        # sum only by a multiple of Q·A — a pure torsion term, which the
+        # final cofactor multiplication clears anyway.  Keeps every MSM
+        # scalar ≤ 253 bits instead of ~381.
+        pairs.append((z * k % _Q, a_pt))
+    if not pairs:
+        return True
+    # Check  Σ z_i·R_i + Σ z_i·k_i·A_i − (Σ z_i·s_i)·B == torsion,
+    # i.e. the cofactor-cleared sum is the identity.
+    if s_total:
+        pairs.append((_Q - s_total, _B))
+    total = _msm(pairs)
+    for _ in range(3):  # multiply by the cofactor (8 = 2³)
+        total = _pt_double(total)
+    return _pt_equal(total, _IDENT)

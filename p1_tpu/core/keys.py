@@ -20,18 +20,39 @@ a ledger mean anything.  Design (tpu rebuild, round 4):
 - Deterministic from a 32-byte seed, so tests can use fixed keys and the
   CLI can persist one JSON file per identity (``p1 keygen``).
 
-Verification is memoized (bounded LRU): a transaction is typically seen
-several times (gossip admission, block validation, reorg resurrection) and
-Ed25519 verify costs ~100 µs native (a few ms pure-Python) — the cache
-makes every re-check O(1).
+Validation fast lane (round 8).  Ed25519 verify costs ~100 µs native and
+~3 ms pure-Python, and it dominates every untrusted validation path, so
+this module carries three speed layers on top of the plain ``verify``:
+
+- ``verify_batch(triples)`` — verify many (pubkey, sig, message) triples
+  at once.  With the ``cryptography`` wheel the triples are chunked over
+  a ``concurrent.futures`` thread pool (``set_verify_workers`` /
+  ``config.verify_workers``; OpenSSL releases the GIL, so threads give
+  real parallelism on multi-core).  Without the wheel the pure-Python
+  fallback uses a genuine batch-verification equation — one multi-scalar
+  multiplication for the whole window (``_ed25519.verify_batch``), ~8×
+  per signature at revalidation window sizes — chunked so memory stays
+  bounded.
+- ``first_invalid(triples)`` — bisecting locator used when a batch
+  fails: verifies sub-batches and finishes serially, so the REJECTED
+  signature (and the error text consensus reports) is byte-identical to
+  the serial path's.
+- The verify-once signature cache lives one level up
+  (core/sigcache.py, keyed by txid) — this module stays a pure function
+  of the three byte strings; ``STATS`` counts how work reached the
+  backend (serial vs batched) for ``status()["validation"]`` and the
+  no-double-verify regression tests.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import hashlib
 import json
+import logging
 import os
+import threading
 
 try:  # pragma: no cover - exercised implicitly by whichever env runs
     from cryptography.exceptions import InvalidSignature
@@ -143,8 +164,38 @@ class Keypair:
         return kp
 
 
-@functools.lru_cache(maxsize=65_536)
-def _verify_cached(pubkey: bytes, sig: bytes, message: bytes) -> bool:
+log = logging.getLogger(__name__)
+
+#: The active verification backend, named for telemetry
+#: (``status()["validation"]``) and the fallback's one-time warning.
+BACKEND = "cryptography" if HAVE_CRYPTOGRAPHY else "pure-python"
+
+
+@dataclasses.dataclass
+class VerifyStats:
+    """Process-wide backend-call accounting.  ``serial`` counts
+    signatures that reached the backend one at a time, ``batched`` the
+    ones that went through ``verify_batch`` — together they are the
+    node's "how much Ed25519 did we actually pay for" figure, and the
+    no-double-verify regression tests assert their deltas are zero on
+    cache-hit paths (a cache hit touches neither counter)."""
+
+    serial: int = 0
+    batched: int = 0
+    batches: int = 0
+    pool_dispatches: int = 0
+
+    def reset(self) -> None:
+        self.serial = self.batched = self.batches = self.pool_dispatches = 0
+
+
+STATS = VerifyStats()
+
+
+def _backend_verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
+    """THE single-signature backend dispatch — every serial verify in
+    the process funnels through here (tests spy on it)."""
+    STATS.serial += 1
     if not HAVE_CRYPTOGRAPHY:
         return _py_ed25519.verify(pubkey, sig, message)
     try:
@@ -156,8 +207,206 @@ def _verify_cached(pubkey: bytes, sig: bytes, message: bytes) -> bool:
 
 def verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
     """True iff ``sig`` is ``pubkey``'s valid Ed25519 signature over
-    ``message``.  Memoized — safe because the answer is a pure function
-    of the three byte strings."""
+    ``message``.  A pure function of the three byte strings; the
+    verify-once memo lives at the transaction layer (core/sigcache.py),
+    keyed by txid, so this stays the uncached ground truth the batch
+    and cache paths are tested against."""
     if len(pubkey) != PUBKEY_SIZE or len(sig) != SIG_SIZE:
         return False
-    return _verify_cached(pubkey, sig, message)
+    return _backend_verify(pubkey, sig, message)
+
+
+# -- batch verification (untrusted-path fast lane, round 8) --------------
+
+#: Below this many cache-missing signatures a batch call just runs
+#: serially: thread dispatch and the MSM setup both cost more than they
+#: save on a handful of signatures.  A constant, NOT configuration —
+#: validation behavior must not vary with local tuning.
+BATCH_MIN = 8
+
+#: Signatures per worker chunk (wheel path) / per MSM window (fallback).
+#: Bounds both the pool task granularity and the fallback's per-window
+#: memory; the MSM's per-signature gain is nearly flat past ~1k.
+BATCH_CHUNK = 1024
+
+_workers_lock = threading.Lock()
+_workers: int | None = None  # explicit set_verify_workers override
+_executor = None
+_executor_size = 0
+_fallback_warned = False
+
+
+def set_verify_workers(n: int | None) -> None:
+    """Pin the verification worker-pool size (None/0 = auto: the
+    ``P1_VERIFY_WORKERS`` env var, else ``os.cpu_count()``).  Takes
+    effect on the next batch; an existing pool of a different size is
+    drained and replaced lazily."""
+    global _workers
+    _workers = int(n) if n else None
+
+
+def verify_workers() -> int:
+    """The resolved worker count batches will use."""
+    if _workers is not None:
+        return max(1, _workers)
+    env = os.environ.get("P1_VERIFY_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, os.cpu_count() or 1)
+
+
+def shutdown_verify_pool(cancel: bool = False) -> None:
+    """Tear down the lazy worker pool (tests, interpreter exit).  Safe
+    to call any time: in-flight batches fall back to in-thread
+    verification when their futures are cancelled."""
+    global _executor, _executor_size
+    with _workers_lock:
+        ex, _executor, _executor_size = _executor, None, 0
+    if ex is not None:
+        ex.shutdown(wait=not cancel, cancel_futures=cancel)
+
+
+def _pool(size: int):
+    """The shared verification executor, (re)built at ``size``."""
+    global _executor, _executor_size
+    with _workers_lock:
+        if _executor is None or _executor_size != size:
+            old = _executor
+            from concurrent.futures import ThreadPoolExecutor
+
+            _executor = ThreadPoolExecutor(
+                max_workers=size, thread_name_prefix="sigverify"
+            )
+            _executor_size = size
+        else:
+            old = None
+    if old is not None:
+        old.shutdown(wait=False, cancel_futures=True)
+    return _executor
+
+
+def _verify_chunk(triples) -> bool:
+    """Serial chunk worker: exact single-signature semantics.  Used by
+    the wheel path (OpenSSL releases the GIL, so chunks verify in
+    parallel) and as the cancellation fallback everywhere."""
+    for pubkey, sig, message in triples:
+        if len(pubkey) != PUBKEY_SIZE or len(sig) != SIG_SIZE:
+            return False
+        if not HAVE_CRYPTOGRAPHY:
+            if not _py_ed25519.verify(pubkey, sig, message):
+                return False
+            continue
+        try:
+            ed25519.Ed25519PublicKey.from_public_bytes(pubkey).verify(
+                sig, message
+            )
+        except (InvalidSignature, ValueError):
+            return False
+    return True
+
+
+def _warn_fallback_once() -> None:
+    global _fallback_warned
+    if _fallback_warned:
+        return
+    _fallback_warned = True
+    log.warning(
+        "pure-Python Ed25519 fallback is the active backend for batch "
+        "verification: ~%.1f ms/signature serial, ~%.2f ms batched "
+        "(recorded on the 1-vCPU bench host) vs ~0.1 ms with the "
+        "`cryptography` wheel — roughly %d× slower end to end.  "
+        "Numbers measured without the wheel are NOT comparable to the "
+        "wheel-based records in docs/PERF.md.",
+        _py_ed25519.RECORDED_SERIAL_MS,
+        _py_ed25519.RECORDED_BATCH_MS,
+        int(_py_ed25519.RECORDED_BATCH_MS / 0.1),
+    )
+
+
+def verify_batch(triples) -> bool:
+    """True iff EVERY (pubkey, sig, message) triple verifies.
+
+    False tells the caller at least one signature is bad — use
+    ``first_invalid`` to locate it with serial-identical semantics.
+    Dispatch: wheel → per-signature verifies chunked across the worker
+    pool (exact serial semantics, parallel on multi-core); fallback →
+    the pure-Python batch equation per chunk (cofactored
+    random-linear-combination — see _ed25519.py's docstring for the
+    precise relationship to serial verification).
+    """
+    triples = list(triples)
+    if not triples:
+        return True
+    STATS.batches += 1
+    STATS.batched += len(triples)
+    if not HAVE_CRYPTOGRAPHY:
+        _warn_fallback_once()
+    if len(triples) < BATCH_MIN:
+        STATS.batched -= len(triples)  # accounted as serial below
+        return _verify_serial_counted(triples)
+    chunks = [
+        triples[i : i + BATCH_CHUNK]
+        for i in range(0, len(triples), BATCH_CHUNK)
+    ]
+    worker = (
+        _verify_chunk if HAVE_CRYPTOGRAPHY else _py_ed25519.verify_batch
+    )
+    n = verify_workers()
+    if n <= 1 or len(chunks) == 1:
+        return all(worker(chunk) for chunk in chunks)
+    from concurrent.futures import CancelledError
+
+    STATS.pool_dispatches += 1
+    pool = _pool(n)
+    futures = []
+    for chunk in chunks:
+        try:
+            futures.append(pool.submit(worker, chunk))
+        except RuntimeError:
+            # Pool shut down mid-submission: the rest verify in-thread.
+            futures.append(None)
+    ok = True
+    for fut, chunk in zip(futures, chunks):
+        if fut is None:
+            ok &= worker(chunk)
+            continue
+        try:
+            ok &= fut.result()
+        except (CancelledError, RuntimeError):
+            # Pool torn down mid-batch (shutdown, interpreter exit):
+            # finish in this thread — the answer must not depend on
+            # executor lifecycle.
+            ok &= worker(chunk)
+    return ok
+
+
+def _verify_serial_counted(triples) -> bool:
+    for pubkey, sig, message in triples:
+        if not verify(pubkey, sig, message):
+            return False
+    return True
+
+
+def first_invalid(triples) -> int | None:
+    """Index of the FIRST triple serial verification rejects, or None.
+
+    Bisecting: sub-batches narrow the window (cheap — a batch over the
+    valid prefix passes), and the final few candidates are verified one
+    by one with ``verify`` itself, so the identified signature and the
+    resulting error are exactly what the serial path would produce.
+    """
+    triples = list(triples)
+    lo, hi = 0, len(triples)
+    while hi - lo > BATCH_MIN:
+        mid = (lo + hi) // 2
+        if verify_batch(triples[lo:mid]):
+            lo = mid  # bad signature(s) all in the right half
+        else:
+            hi = mid  # first bad one is in the left half
+    for i in range(lo, hi):
+        if not verify(*triples[i]):
+            return i
+    return None

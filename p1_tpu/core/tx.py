@@ -40,6 +40,7 @@ import dataclasses
 import struct
 
 from p1_tpu.core import keys as _keys
+from p1_tpu.core import sigcache as _sigcache
 
 _MAX_ID_LEN = 255
 _NUMS = struct.Struct(">QQQ")
@@ -198,22 +199,32 @@ class Transaction:
     def is_coinbase(self) -> bool:
         return self.sender == COINBASE_SENDER
 
-    def verify_signature(self) -> bool:
+    def verify_signature(self, cache=None) -> bool:
         """True iff this transaction proves ownership of its sender account.
 
         Coinbase: must be bare (no pubkey/sig/chain) — minted, not spent.
         Transfer: sender id must be the carried pubkey's fingerprint and the
         signature must verify over ``signing_bytes()`` (which commits to the
         ``chain`` tag — whether the tag names the RIGHT chain is the
-        caller's contextual check).  Memoized inside ``keys.verify`` so
-        gossip + block validation + resurrection re-checks are O(1) after
-        the first.
+        caller's contextual check).  Memoized through the verify-once
+        signature cache (core/sigcache.py — ``cache`` names one
+        explicitly, None uses the process default) so gossip admission +
+        block validation + resurrection re-checks are O(1) after the
+        first; the txid key commits to every byte the check depends on.
         """
         if self.is_coinbase:
             return not self.pubkey and not self.sig and not self.chain
         if self.sender != _keys.account_id_or_none(self.pubkey):
             return False
-        return _keys.verify(self.pubkey, self.sig, self.signing_bytes())
+        if cache is None:
+            cache = _sigcache.DEFAULT
+        txid = self.txid()
+        if cache.hit(txid, self.pubkey, self.sig):
+            return True
+        if not _keys.verify(self.pubkey, self.sig, self.signing_bytes()):
+            return False
+        cache.add(txid, self.pubkey, self.sig)
+        return True
 
     @classmethod
     def transfer(

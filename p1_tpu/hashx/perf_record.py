@@ -43,3 +43,19 @@ RECORDED_HOST_INGEST_BPS = 22_000.0
 #: measurement as a regression in its JSON output.  Looser than the TPU
 #: guard: host rates on the shared 1-vCPU box wobble with co-tenants.
 HOST_INGEST_DEGRADED_FRACTION = 0.5
+
+#: Untrusted-path revalidation (round 8): blocks/s through
+#: ``ChainStore.load_chain(trusted=False)`` on the bench shape (400
+#: blocks × 2 signed transfers, difficulty 1) with the batched-signature
+#: fast lane, measured 2026-08-04 on the 1-vCPU bench host with the
+#: pure-Python Ed25519 fallback active (the wheel is absent in this
+#: image — keys.py's one-time warning names the backend; a wheel-
+#: equipped host runs several times faster and should re-record).
+#: ``bench.py`` emits ``revalidate_vs_recorded`` against this figure —
+#: the denominator-pinning convention of RECORDED_CPU_BASELINE_HPS.
+RECORDED_REVALIDATE_BPS = 1_100.0
+
+#: Same-session fraction below which the revalidation measurement is
+#: flagged degraded in the bench JSON (same tolerance rationale as the
+#: ingest guard).
+REVALIDATE_DEGRADED_FRACTION = 0.5

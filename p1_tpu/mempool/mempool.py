@@ -157,8 +157,15 @@ class Mempool:
         balance_of=None,
         chain_tag=None,
         nonce_of=None,
+        sig_cache=None,
     ):
         self.max_txs = max_txs
+        #: Verify-once signature cache (core/sigcache.py) admission
+        #: populates: a transfer verified here is NOT re-verified when
+        #: the block carrying it connects (or when mining re-assembles
+        #: it) — the sigcache double-verify fix.  None = the process
+        #: default; a Node wires its own instance, shared with its Chain.
+        self.sig_cache = sig_cache
         #: Optional ``account -> confirmed nonce`` callable (wire it to
         #: ``Chain.nonce``).  When set, admission refuses transfers whose
         #: seq is already consumed on the chain (definite replays), and
@@ -251,9 +258,11 @@ class Mempool:
             return False  # signed for a different chain (replay)
         if self.nonce_of is not None and tx.seq < self.nonce_of(tx.sender):
             return False  # seq already consumed on-chain (replay)
-        if not tx.verify_signature():
-            # Unowned spends never enter the pool; re-admissions from reorg
-            # resurrection re-check for free (keys.verify is memoized).
+        if not tx.verify_signature(cache=self.sig_cache):
+            # Unowned spends never enter the pool; re-admissions from
+            # reorg resurrection re-check for free (verify-once cache),
+            # and the block that later carries this transfer connects
+            # without re-paying the backend at all.
             return False
         txid = tx.txid()
         if txid in self._txs:

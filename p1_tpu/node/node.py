@@ -28,9 +28,12 @@ import time
 from pathlib import Path
 
 from p1_tpu.chain import AddResult, AddStatus, Chain, ChainStore
+from p1_tpu.chain.validate import preverify_signatures
 from p1_tpu.config import NodeConfig
+from p1_tpu.core import keys
 from p1_tpu.core.block import Block, merkle_root
 from p1_tpu.core.header import BlockHeader
+from p1_tpu.core.sigcache import SignatureCache
 from p1_tpu.core.tx import Transaction
 from p1_tpu.mempool import Mempool
 from p1_tpu.miner import Miner
@@ -351,6 +354,19 @@ class Node:
         #: fork-choice machinery is actually exercised at network level.
         self.miner_id = config.miner_id or f"m-{secrets.token_hex(4)}"
         self.chain = Chain(config.difficulty, retarget=config.retarget_rule())
+        #: Verify-once signature cache (core/sigcache.py): ONE instance
+        #: shared by this node's mempool admission and its chain's block
+        #: validation, so a transfer verified at relay/admission connects
+        #: (and mines) without re-paying the Ed25519 backend — and the
+        #: hit/miss telemetry in ``status()["validation"]`` is this
+        #: node's own, not the process default's.
+        self.sig_cache = SignatureCache()
+        self.chain.sig_cache = self.sig_cache
+        if config.verify_workers > 0:
+            # Explicit pin only: the lazy default (env, else cpu_count)
+            # must survive multi-node test processes where the conftest
+            # knob pinned workers=1 for determinism.
+            keys.set_verify_workers(config.verify_workers)
         # balance_of is a bound-late lambda (not a bound method) so the
         # store-resume path in start(), which REPLACES self.chain, keeps
         # the pool pointed at the live chain's ledger.  The chain tag is
@@ -361,6 +377,7 @@ class Node:
             balance_of=lambda acct: self.chain.balance(acct),
             nonce_of=lambda acct: self.chain.nonce(acct),
             chain_tag=self.chain.genesis.block_hash(),
+            sig_cache=self.sig_cache,
         )
         self.metrics = NodeMetrics()
         #: ``store`` is injectable (tests pass a fault-injecting
@@ -678,8 +695,12 @@ class Node:
                     retarget=self.config.retarget_rule(),
                     # Our own flocked log of blocks we already validated:
                     # fast resume by default (store.py's trust argument).
+                    # A revalidation (trusted=False) runs through the
+                    # batched signature fast lane against THIS node's
+                    # verify-once cache.
                     trusted=not self.config.revalidate_store,
                     body_cache=body_cache,
+                    sig_cache=self.sig_cache,
                 )
             except ValueError as e:
                 self.store.close()
@@ -933,10 +954,11 @@ class Node:
 
     def _memory_gauge(self) -> int:
         """The node's accounted memory: resident chain bodies + pending
-        pool bytes + peer transport write buffers.  Deterministic and
-        reversible (unlike OS RSS, which CPython's allocator rarely
-        returns), so the SHED hysteresis can actually come back down
-        when the pressure goes away."""
+        pool bytes + peer transport write buffers + the verify-once
+        signature cache.  Deterministic and reversible (unlike OS RSS,
+        which CPython's allocator rarely returns), so the SHED
+        hysteresis can actually come back down when the pressure goes
+        away."""
         write_buf = 0
         for peer in self._peers.values():
             transport = peer.writer.transport
@@ -946,6 +968,7 @@ class Node:
             self.chain.resident_body_bytes
             + getattr(self.mempool, "bytes_pending", 0)
             + write_buf
+            + self.sig_cache.bytes_used
         )
 
     async def _governor_loop(self) -> None:
@@ -1709,6 +1732,18 @@ class Node:
             batch_fsync = self.store is not None and self.store.fsync
             if batch_fsync:
                 self.store.fsync = False
+            # Validation fast lane: prove the whole batch's transfer
+            # signatures into the verify-once cache with one batched
+            # call before the per-block connect loop — a deep-sync reply
+            # of 500 tx-bearing blocks pays the Ed25519 backend once,
+            # not per transfer.  Purely a cache-warmer: per-block
+            # check_block still decides, with identical outcomes
+            # (chain/validate.py preverify_signatures).
+            preverify_signatures(
+                (tx for block in body for tx in block.txs),
+                self.chain.genesis.block_hash(),
+                self.sig_cache,
+            )
             accepted_any = False
             try:
                 for block in body:
@@ -1762,6 +1797,12 @@ class Node:
         elif mtype is MsgType.MEMPOOL:
             more, txs = body
             peer.mempool_inflight_since = None  # page landed: not stalled
+            # Batch the page's signatures into the verify-once cache
+            # before per-tx admission (same fast lane as deep-sync
+            # block batches; outcomes unchanged).
+            preverify_signatures(
+                txs, self.chain.genesis.block_hash(), self.sig_cache
+            )
             for tx in txs:
                 await self._handle_tx(tx, origin=peer)
             if more and txs:
@@ -2386,6 +2427,21 @@ class Node:
                 "body_cache_blocks": self.config.body_cache_blocks,
                 "mining_paused": self.governor.shedding
                 or self._store_degraded,
+            },
+            # Validation fast lane (round 8): the verify-once signature
+            # cache (this node's instance — hits are blocks connecting
+            # without re-paying Ed25519 for mempool-resident transfers)
+            # plus the process-wide backend accounting (how many
+            # signatures went through batch calls vs one-at-a-time, and
+            # on which backend).
+            "validation": {
+                **self.sig_cache.snapshot(),
+                "batched": keys.STATS.batched,
+                "batches": keys.STATS.batches,
+                "serial": keys.STATS.serial,
+                "pool_dispatches": keys.STATS.pool_dispatches,
+                "backend": keys.BACKEND,
+                "workers": keys.verify_workers(),
             },
             # Conservation probe: with a coinbase in every block (ours) and
             # fees credited to miners, the ledger must sum to exactly

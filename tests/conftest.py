@@ -29,6 +29,21 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_addoption(parser):
+    # Validation fast lane: pin the Ed25519 batch-verification worker
+    # count for the whole run.  Default 1 keeps tier-1 deterministic on
+    # the 1-vCPU CI host (no thread-pool scheduling in the mix); the
+    # slow soak set re-exercises workers>1 explicitly
+    # (tests/test_sigbatch.py's pool lifecycle soak).
+    parser.addoption(
+        "--verify-workers",
+        type=int,
+        default=int(os.environ.get("P1_VERIFY_WORKERS", "1")),
+        help="Ed25519 batch-verification worker threads for this run "
+        "(env P1_VERIFY_WORKERS; default 1 for determinism)",
+    )
+
+
 def pytest_configure(config):
     # Tier-1 runs `-m 'not slow'` (ROADMAP.md): the marker must be
     # registered or every slow-marked soak raises an unknown-mark warning.
@@ -36,6 +51,9 @@ def pytest_configure(config):
         "markers",
         "slow: long-running soaks excluded from the tier-1 `-m 'not slow'` run",
     )
+    from p1_tpu.core import keys
+
+    keys.set_verify_workers(config.getoption("--verify-workers"))
 
 
 def pytest_sessionstart(session):

@@ -46,6 +46,7 @@ def test_walk_found_the_tree():
     for expected in (
         "p1_tpu.core.keys",
         "p1_tpu.core._ed25519",
+        "p1_tpu.core.sigcache",
         "p1_tpu.chain.replay",
         "p1_tpu.node.node",
         "p1_tpu.hashx.pallas_backend",

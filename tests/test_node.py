@@ -707,7 +707,9 @@ class TestMempoolUnit:
         from p1_tpu.mempool import Mempool, mempool as mempool_mod
 
         monkeypatch.setattr(
-            mempool_mod.Transaction, "verify_signature", lambda self: True
+            mempool_mod.Transaction,
+            "verify_signature",
+            lambda self, cache=None: True,
         )
         pool = Mempool(max_txs=200_000)
         t0 = time_mod.perf_counter()
