@@ -9,14 +9,19 @@ Validation fast lane (round 8): signature checking is **batch-first**.
 Cheap hash/structure checks still gate exactly as before, then every
 signature the verify-once cache (core/sigcache.py) cannot vouch for is
 verified as ONE batch (``keys.verify_batch`` — threaded with the
-``cryptography`` wheel, one multi-scalar multiplication in the
-pure-Python fallback).  Equivalence with the serial path is a hard
-contract, held two ways:
+``cryptography`` wheel, one subgroup-gated multi-scalar multiplication
+in the pure-Python fallback).  Equivalence with the serial path is a
+hard contract, held two ways:
 
-- **Outcome**: a batch failure falls back through ``keys.first_invalid``
-  bisection, so the rejected transaction and the raised error text are
-  byte-identical to what the old per-tx loop produced — property-tested
-  with corrupted signatures at every position (tests/test_sigbatch.py).
+- **Outcome**: batch acceptance implies serial acceptance of every
+  member, and a batch failure is settled by ``keys.first_invalid``'s
+  serial confirmation — which may conclude NO signature is serially
+  invalid (the fallback gate rejects torsion-crafted inputs the serial
+  equation tolerates), in which case the block is accepted exactly as
+  the serial path would.  Rejected transaction and raised error text
+  are byte-identical to what the old per-tx loop produced —
+  property-tested with corrupted signatures at every position and with
+  torsion-crafted fixtures (tests/test_sigbatch.py).
 - **Ordering**: serial validation interleaves per-tx structural checks
   with per-tx signature checks, and every signature failure raises the
   same text regardless of index — so running the structural walk first
@@ -131,6 +136,13 @@ def check_block(
         ]
         if len(pending) >= _keys.BATCH_MIN:
             ok = _keys.verify_batch(triples)
+            if not ok:
+                # A failed batch is not yet a verdict: the fallback's
+                # subgroup gate also rejects torsion-crafted inputs the
+                # serial equation tolerates, so the serial confirmation
+                # decides — identical outcome AND identical error text
+                # to the per-tx loop, whichever way it lands.
+                ok = _keys.first_invalid(triples) is None
         else:
             ok = all(
                 _keys.verify(*t) for t in triples
@@ -186,6 +198,9 @@ def preverify_signatures(txs, chain_tag: bytes, sig_cache=None) -> int:
                 sig_cache.add(tx.txid(), tx.pubkey, tx.sig)
             proven += len(group)
         elif len(group) == 1:
+            # Settled serially (a singleton batch IS the serial path —
+            # size < BATCH_MIN), so an uncached leftover here really is
+            # a serial reject, never a torsion false-negative.
             continue  # genuinely bad: leave uncached for the serial path
         else:
             # Bisect: cache the valid side(s), isolate the bad ones.

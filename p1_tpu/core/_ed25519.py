@@ -17,28 +17,39 @@ that affordable on the hot paths: the tx-level verify-once cache
 ``verify_batch`` below amortizes what DOES have to be verified —
 untrusted-path validation (`--revalidate-store`, foreign stores, deep
 sync) verifies whole windows of signatures in one multi-scalar
-multiplication instead of one double-and-add ladder each (measured
-7.4–8.4× per signature at window sizes 256–4096 on the 1-vCPU bench
-host; benchmarks/sig_verify.py).
+multiplication instead of one double-and-add ladder each
+(benchmarks/sig_verify.py; see RECORDED_* below for the measured
+per-signature costs on the 1-vCPU bench host).
 
-Batch semantics, stated precisely (the "Taming the many EdDSAs"
-trade-off): the batch checks the COFACTORED equation ``[8][Σ z_i s_i]B
-= [8]Σ z_i R_i + [8]Σ z_i k_i A_i`` with per-process-random 128-bit
-coefficients ``z_i`` — the only linear form that is sound to batch.
-Every signature the serial (cofactorless) check accepts also passes the
-batch, and any signature failing the cofactored equation makes the
-batch fail with probability 1 − 2⁻¹²⁸, after which callers bisect down
-to the serial verdict (``keys.first_invalid``) — so accept/reject and
-error text match the serial path for every honestly-generated or
-randomly-corrupted input (property-tested at every position,
-tests/test_sigbatch.py).  The one reachable divergence: a signer who
-deliberately crafts a small-order torsion component into their OWN
-public key or nonce point can make a signature the serial check rejects
-and the batch accepts.  Honest keys are torsion-free by construction
-(clamped scalars are ≡ 0 mod 8), the craft risks only the crafter's own
-account, and random corruption lands there with probability ~2⁻²⁵⁰ —
-the same superset Zcash consensus standardized on when it adopted
-batched Ed25519.
+Batch semantics, stated precisely: the batch accepts iff (a) every
+``R_i`` and ``A_i`` point lies in the prime-order subgroup — checked
+EXACTLY via ``[q]·point == identity`` (``_in_prime_subgroup``), cached
+per pubkey, per signature for the unique ``R_i`` — and (b) the random
+linear combination ``Σ z_i·([s_i]B − R_i − [k_i]A_i)`` with 128-bit
+per-batch-random coefficients ``z_i`` is the identity.  With every
+point torsion-free, each term of (b) is exactly the serial
+(cofactorless) equation ``[S]B = R + [k]A``, so **batch acceptance
+implies serial acceptance** of every triple up to the 2⁻¹²⁸ soundness
+bound of the coefficients.  The converse does NOT hold: a signer who
+plants a small-order torsion component in their own public key or
+nonce point can build a signature the serial equation tolerates (the
+torsion terms cancel) which the subgroup gate here rejects — callers
+recover the exact serial verdict through ``keys.first_invalid``'s
+serial confirmation, so the OUTCOME of every validation path is
+byte-identical to serial verification for every input, honest or
+crafted (property-tested, tests/test_sigbatch.py).
+
+The subgroup gate is what keeps ONE validity rule on every node: the
+cofactored-only batch (the "Taming the many EdDSAs" superset this
+module previously shipped) accepts torsion-crafted signatures the
+serial path — and every node running the ``cryptography`` wheel —
+rejects, which a hostile signer could use to split wheel-less nodes
+from wheel nodes deterministically.  The gate costs one scalar
+multiplication by q per signature (the dominant per-signature batch
+cost; windowed, ``_in_prime_subgroup``), which is why the fallback
+batch gain is ~2× rather than the ~8× the ungated equation measured.
+Honest signatures are torsion-free by construction, so the gate never
+rejects honest input.
 """
 
 from __future__ import annotations
@@ -51,8 +62,8 @@ import secrets
 #: benchmarks/sig_verify.py) — what keys.py's one-time "fallback active
 #: for a batch path" warning names, so CI-without-wheel numbers are
 #: never mistaken for regressions against the wheel-based records.
-RECORDED_SERIAL_MS = 3.1
-RECORDED_BATCH_MS = 0.36
+RECORDED_SERIAL_MS = 3.2
+RECORDED_BATCH_MS = 1.45
 
 _P = 2**255 - 19  # field prime
 _Q = 2**252 + 27742317777372353535851937790883648493  # group order
@@ -205,31 +216,58 @@ def verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
 # One multi-scalar multiplication over all (R_i, A_i, B) replaces 2n
 # double-and-add ladders: Pippenger's bucket method costs roughly
 # (bits/c)·(n + 2^c) point additions for the whole batch vs ~770
-# additions per signature serially, so per-signature cost falls from
-# ~3.1 ms to ~360–420 µs at window sizes 256–4096 on this host (the
-# remaining floor is one R-point decompression per signature).  The
-# equation checked and its exact relationship to serial verification
-# are documented in the module docstring above.
+# additions per signature serially.  The per-signature floor is the
+# exact prime-subgroup check on R (one windowed scalar multiplication
+# by q) plus one R-point decompression — see the module docstring for
+# why the subgroup gate is not optional.
+
+#: q in 4-bit windows, most-significant first, for ``_in_prime_subgroup``.
+_Q_WINDOWS = tuple(
+    (_Q >> (4 * i)) & 15 for i in reversed(range((_Q.bit_length() + 3) // 4))
+)
+
+
+def _in_prime_subgroup(pt) -> bool:
+    """True iff ``pt`` lies in the prime-order subgroup, i.e. carries no
+    small-order torsion component: ``[q]·pt == identity``, computed
+    exactly (no probabilistic shortcut exists — the torsion group is
+    Z/8, far too small for random-linear-combination soundness).  Fixed
+    4-bit windows: 14 setup additions buy ~¼ the adds of plain
+    double-and-add over the 253-bit q."""
+    mults = [_IDENT, pt]
+    for _ in range(14):
+        mults.append(_pt_add(mults[-1], pt))
+    acc = _IDENT
+    for w in _Q_WINDOWS:
+        for _ in range(4):
+            acc = _pt_double(acc)
+        if w:
+            acc = _pt_add(acc, mults[w])
+    return _pt_equal(acc, _IDENT)
 
 
 @functools.lru_cache(maxsize=4096)
 def _pubkey_point(pubkey: bytes):
-    """Decompressed public-key point, cached: senders repeat across the
-    transactions of a window (one account signs many spends), and a
-    decompression costs two ~250-bit modular exponentiations.  R points
-    are unique per signature and are never cached."""
-    return _pt_decompress(pubkey)
+    """``(point, in_prime_subgroup)`` for a compressed public key —
+    ``(None, False)`` when undecodable.  Cached: senders repeat across
+    the transactions of a window (one account signs many spends), and
+    the subgroup check costs a scalar multiplication by q on top of the
+    two ~250-bit exponentiations of decompression.  R points are unique
+    per signature, so their checks are paid per signature, uncached."""
+    pt = _pt_decompress(pubkey)
+    if pt is None:
+        return None, False
+    return pt, _in_prime_subgroup(pt)
 
 
 def _msm(pairs) -> tuple:
     """Σ scalar·point over ``pairs`` (Pippenger bucket method).
 
-    Scalars are plain non-negative integers — deliberately NOT reduced
-    mod the group order by this function: R and A points supplied by a
-    hostile signer may carry 8-torsion components, where arithmetic
-    mod q is invalid.  The caller multiplies the result by the cofactor
-    before comparing, which is what makes the mixed-width scalars here
-    sound.
+    Scalars are plain non-negative integers of any width; the caller
+    may reduce them mod the group order q only where the paired point
+    is proven to lie in the prime-order subgroup (``verify_batch``
+    checks exactly that before building its pairs — for a point with a
+    torsion component, mod-q scalar arithmetic would be invalid).
     """
     pairs = [(s, p) for s, p in pairs if s > 0]
     if not pairs:
@@ -271,22 +309,25 @@ def _msm(pairs) -> tuple:
 
 
 def verify_batch(triples) -> bool:
-    """True iff every ``(pubkey, sig, message)`` triple verifies, checked
-    as ONE cofactored random-linear-combination equation (module
-    docstring).  False means at least one signature is bad (up to the
-    2⁻¹²⁸ soundness bound) — callers bisect to find which, so the
-    per-signature verdict and error reporting stay the serial path's.
+    """True only if every ``(pubkey, sig, message)`` triple passes the
+    SERIAL check (up to the 2⁻¹²⁸ soundness bound): subgroup-gated
+    points plus one random-linear-combination equation (module
+    docstring).  False does NOT imply a serial reject — the gate also
+    rejects torsion-crafted inputs the serial equation tolerates — so
+    callers settle a failed batch with ``keys.first_invalid``'s serial
+    confirmation, keeping per-signature verdicts and error reporting
+    exactly the serial path's.
     """
     pairs = []  # (scalar, point) terms of the combination
     s_total = 0  # coefficient of the base point, mod Q (B has order Q)
     for pubkey, sig, message in triples:
         if len(pubkey) != 32 or len(sig) != 64:
             return False
-        a_pt = _pubkey_point(bytes(pubkey))
-        if a_pt is None:
+        a_pt, a_in_subgroup = _pubkey_point(bytes(pubkey))
+        if not a_in_subgroup:
             return False
         r_pt = _pt_decompress(sig[:32])
-        if r_pt is None:
+        if r_pt is None or not _in_prime_subgroup(r_pt):
             return False
         s = int.from_bytes(sig[32:], "little")
         if s >= _Q:
@@ -297,18 +338,16 @@ def verify_batch(triples) -> bool:
         z = secrets.randbits(128) | 1
         s_total = (s_total + z * s) % _Q
         pairs.append((z, r_pt))
-        # z·k reduced mod Q: for a torsioned A the reduction perturbs the
-        # sum only by a multiple of Q·A — a pure torsion term, which the
-        # final cofactor multiplication clears anyway.  Keeps every MSM
-        # scalar ≤ 253 bits instead of ~381.
+        # z·k reduced mod Q is exact here: A is proven to have order q,
+        # so the reduction shifts the term by a multiple of [q]A = O.
+        # Keeps every MSM scalar ≤ 253 bits instead of ~381.
         pairs.append((z * k % _Q, a_pt))
     if not pairs:
         return True
-    # Check  Σ z_i·R_i + Σ z_i·k_i·A_i − (Σ z_i·s_i)·B == torsion,
-    # i.e. the cofactor-cleared sum is the identity.
+    # Check  Σ z_i·R_i + Σ z_i·k_i·A_i − (Σ z_i·s_i)·B == identity.
+    # Every point in the sum is proven torsion-free, so this cofactorless
+    # comparison is exactly the serial equation's linear combination —
+    # no cofactor clearing, nothing for torsion to hide in.
     if s_total:
         pairs.append((_Q - s_total, _B))
-    total = _msm(pairs)
-    for _ in range(3):  # multiply by the cofactor (8 = 2³)
-        total = _pt_double(total)
-    return _pt_equal(total, _IDENT)
+    return _pt_equal(_msm(pairs), _IDENT)

@@ -30,22 +30,31 @@ this module carries three speed layers on top of the plain ``verify``:
   ``config.verify_workers``; OpenSSL releases the GIL, so threads give
   real parallelism on multi-core).  Without the wheel the pure-Python
   fallback uses a genuine batch-verification equation — one multi-scalar
-  multiplication for the whole window (``_ed25519.verify_batch``), ~8×
-  per signature at revalidation window sizes — chunked so memory stays
-  bounded.
-- ``first_invalid(triples)`` — bisecting locator used when a batch
-  fails: verifies sub-batches and finishes serially, so the REJECTED
-  signature (and the error text consensus reports) is byte-identical to
-  the serial path's.
+  multiplication for the whole window plus an exact prime-subgroup gate
+  on every point (``_ed25519.verify_batch``), ~2× per signature at
+  revalidation window sizes — run in the calling thread (the fallback
+  holds the GIL, so a pool would add overhead, not parallelism) and
+  chunked so memory stays bounded.  Batch TRUE implies every triple is
+  serially valid; batch FALSE is not yet a verdict (the fallback gate
+  also rejects torsion-crafted inputs the serial equation tolerates).
+- ``first_invalid(triples)`` — serial-confirming locator used when a
+  batch fails: sub-batches that pass are skipped (acceptance implies
+  serial validity), everything else is settled by ``verify`` itself, so
+  the REJECTED signature (and the error text consensus reports) — or
+  the conclusion that there is none — is byte-identical to the serial
+  path's.
 - The verify-once signature cache lives one level up
-  (core/sigcache.py, keyed by txid) — this module stays a pure function
-  of the three byte strings; ``STATS`` counts how work reached the
-  backend (serial vs batched) for ``status()["validation"]`` and the
-  no-double-verify regression tests.
+  (core/sigcache.py, keyed by txid) — positive results are memoized
+  there, never here.  ``verify`` keeps only a small bounded NEGATIVE
+  memo (deterministic function, so semantics-free) to absorb peers
+  replaying a known-bad signature; ``STATS`` counts how work reached
+  the backend (serial vs batched) for ``status()["validation"]`` and
+  the no-double-verify regression tests.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import hashlib
@@ -205,15 +214,49 @@ def _backend_verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
         return False
 
 
+#: Bounded negative-verify memo.  Positive results are memoized at the
+#: transaction layer (core/sigcache.py, keyed by txid); without a
+#: negative counterpart, a peer replaying the same invalid tx or block
+#: forces a full backend verify every time (~3 ms on the pure-Python
+#: backend) where the pre-round-8 lru_cache was O(1).  Failures only:
+#: ``verify`` is a deterministic function of the three byte strings, so
+#: memoizing a FALSE can never change an outcome, and the key is a
+#: salted digest of the exact bytes so an entry can't shadow any other
+#: (pubkey, sig, message).  Single-threaded by design, like sigcache:
+#: consulted on the event-loop/serial paths only — pool workers go
+#: through ``_verify_chunk``, which never touches it.
+_NEG_CACHE_MAX = 4096
+_neg_salt = os.urandom(16)
+_neg_cache: collections.OrderedDict = collections.OrderedDict()
+
+
+def _neg_key(pubkey: bytes, sig: bytes, message: bytes) -> bytes:
+    h = hashlib.sha256(_neg_salt)
+    h.update(pubkey)
+    h.update(sig)
+    h.update(message)
+    return h.digest()[:16]
+
+
 def verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
     """True iff ``sig`` is ``pubkey``'s valid Ed25519 signature over
-    ``message``.  A pure function of the three byte strings; the
-    verify-once memo lives at the transaction layer (core/sigcache.py),
-    keyed by txid, so this stays the uncached ground truth the batch
-    and cache paths are tested against."""
+    ``message``.  A deterministic function of the three byte strings;
+    the verify-once memo for VALID signatures lives at the transaction
+    layer (core/sigcache.py, keyed by txid), and known-bad triples are
+    absorbed by the bounded negative memo above — a memo hit touches no
+    STATS counter because no backend work happened."""
     if len(pubkey) != PUBKEY_SIZE or len(sig) != SIG_SIZE:
         return False
-    return _backend_verify(pubkey, sig, message)
+    key = _neg_key(pubkey, sig, message)
+    if key in _neg_cache:
+        _neg_cache.move_to_end(key)
+        return False
+    ok = _backend_verify(pubkey, sig, message)
+    if not ok:
+        _neg_cache[key] = None
+        while len(_neg_cache) > _NEG_CACHE_MAX:
+            _neg_cache.popitem(last=False)
+    return ok
 
 
 # -- batch verification (untrusted-path fast lane, round 8) --------------
@@ -308,6 +351,17 @@ def _verify_chunk(triples) -> bool:
     return True
 
 
+def _use_pool(n_chunks: int) -> bool:
+    """Whether a batch's chunks go to the thread pool.  Only the wheel
+    path benefits: OpenSSL releases the GIL inside each verify, so
+    chunks genuinely overlap.  The pure-Python fallback holds the GIL
+    for its whole MSM — dispatching it to workers buys no parallelism,
+    just executor overhead and pool churn — so fallback chunks run in
+    the calling thread.  Tests monkeypatch this to force the pool and
+    exercise its shutdown/cancellation machinery without the wheel."""
+    return HAVE_CRYPTOGRAPHY and n_chunks > 1 and verify_workers() > 1
+
+
 def _warn_fallback_once() -> None:
     global _fallback_warned
     if _fallback_warned:
@@ -327,15 +381,17 @@ def _warn_fallback_once() -> None:
 
 
 def verify_batch(triples) -> bool:
-    """True iff EVERY (pubkey, sig, message) triple verifies.
+    """True only if EVERY (pubkey, sig, message) triple is serially
+    valid (batch acceptance implies serial acceptance).
 
-    False tells the caller at least one signature is bad — use
-    ``first_invalid`` to locate it with serial-identical semantics.
+    False means "not proven": usually a bad signature, but the fallback
+    batch also rejects torsion-crafted inputs the serial equation
+    tolerates (_ed25519.py's docstring) — use ``first_invalid`` to
+    settle a failed batch with serial-identical semantics.
     Dispatch: wheel → per-signature verifies chunked across the worker
     pool (exact serial semantics, parallel on multi-core); fallback →
-    the pure-Python batch equation per chunk (cofactored
-    random-linear-combination — see _ed25519.py's docstring for the
-    precise relationship to serial verification).
+    the pure-Python subgroup-gated batch equation per chunk, in the
+    calling thread (``_use_pool``).
     """
     triples = list(triples)
     if not triples:
@@ -354,9 +410,9 @@ def verify_batch(triples) -> bool:
     worker = (
         _verify_chunk if HAVE_CRYPTOGRAPHY else _py_ed25519.verify_batch
     )
-    n = verify_workers()
-    if n <= 1 or len(chunks) == 1:
+    if not _use_pool(len(chunks)):
         return all(worker(chunk) for chunk in chunks)
+    n = verify_workers()
     from concurrent.futures import CancelledError
 
     STATS.pool_dispatches += 1
@@ -393,20 +449,32 @@ def _verify_serial_counted(triples) -> bool:
 def first_invalid(triples) -> int | None:
     """Index of the FIRST triple serial verification rejects, or None.
 
-    Bisecting: sub-batches narrow the window (cheap — a batch over the
-    valid prefix passes), and the final few candidates are verified one
-    by one with ``verify`` itself, so the identified signature and the
-    resulting error are exactly what the serial path would produce.
+    None after a failed ``verify_batch`` is a legitimate answer —
+    batch False does not imply a serial reject (the fallback's subgroup
+    gate also turns away torsion-crafted inputs the serial equation
+    tolerates), so callers must treat None as "all serially valid".
+    Left-first scan: a sub-batch that PASSES proves all its members
+    serially valid and is skipped wholesale; a sub-batch that fails is
+    split, and windows of ≤ BATCH_MIN are settled one by one with
+    ``verify`` itself — so the identified signature (or the conclusion
+    that none exists) is exactly what the serial path would produce.
+    The old bisection assumed "batch failed ⇒ a serial reject inside",
+    which the gate broke: a torsion reject in one half would steer the
+    search away from a genuinely bad signature in the other.
     """
     triples = list(triples)
-    lo, hi = 0, len(triples)
-    while hi - lo > BATCH_MIN:
+
+    def scan(lo: int, hi: int, known_failed: bool) -> int | None:
+        if hi - lo <= BATCH_MIN:
+            for i in range(lo, hi):
+                if not verify(*triples[i]):
+                    return i
+            return None
+        if not known_failed and verify_batch(triples[lo:hi]):
+            return None
         mid = (lo + hi) // 2
-        if verify_batch(triples[lo:mid]):
-            lo = mid  # bad signature(s) all in the right half
-        else:
-            hi = mid  # first bad one is in the left half
-    for i in range(lo, hi):
-        if not verify(*triples[i]):
-            return i
-    return None
+        found = scan(lo, mid, False)
+        return found if found is not None else scan(mid, hi, False)
+
+    # Callers reach here right after a failed full batch: don't re-run it.
+    return scan(0, len(triples), True)

@@ -3,10 +3,11 @@
 The round-8 contract under test: the batch/cache paths may change WHERE
 signature-verification cost is paid, never WHAT is accepted — identical
 accept/reject decisions and identical exception text against the serial
-path for every honestly-generated or corrupted input, with the one
-deliberate (and here pinned) exception of crafted small-order torsion
-components, where the batch accepts the cofactored superset the module
-docstring documents.
+path for EVERY input, including crafted small-order torsion components:
+the fallback batch subgroup-gates every point (batch acceptance implies
+serial acceptance), and a failed batch is settled by serial
+confirmation, so torsion crafts can slow validation down but never
+change its verdict (the chain-split review fix, docs/ROUND8.md).
 """
 
 import dataclasses
@@ -52,6 +53,70 @@ def _corrupt(triple, how):
     if how == "s_range":  # scalar ≥ group order: serial rejects pre-math
         return (pubkey, sig[:32] + _ed25519._Q.to_bytes(32, "little"), msg)
     raise AssertionError(how)
+
+
+# -- small-order torsion crafts (the round-8 review fix's fixtures) ------
+
+_T2_ENC = (_ed25519._P - 1).to_bytes(32, "little")  # (0, -1): order 2
+_T4_ENC = (0).to_bytes(32, "little")  # (sqrt(-1), 0): order 4
+
+
+def _torsion_sign(msg: bytes, *, cancel: bool):
+    """``(pubkey, sig_or_None)`` carrying small-order torsion over ``msg``.
+
+    cancel=True: order-2 torsion planted in BOTH A and R; with k odd the
+    torsion terms cancel in the serial equation, so SERIAL verification
+    ACCEPTS (sig is None when k comes out even — vary the message and
+    retry).  cancel=False: honest A, order-4 torsion in R — serial
+    always rejects, while the pre-fix cofactored batch accepted: the
+    chain-split craft.
+    """
+    a, prefix = _ed25519._secret_expand(bytes(32))
+    B = _ed25519._B
+    T = _ed25519._pt_decompress(_T2_ENC if cancel else _T4_ENC)
+    a_pt = _ed25519._pt_mul(a, B)
+    pub = _ed25519._pt_compress(_ed25519._pt_add(a_pt, T) if cancel else a_pt)
+    r = int.from_bytes(_ed25519._sha512(prefix + msg), "little") % _ed25519._Q
+    r_enc = _ed25519._pt_compress(_ed25519._pt_add(_ed25519._pt_mul(r, B), T))
+    k = int.from_bytes(_ed25519._sha512(r_enc + pub + msg), "little") % _ed25519._Q
+    if cancel and k % 2 == 0:
+        return pub, None
+    sig = r_enc + ((r + k * a) % _ed25519._Q).to_bytes(32, "little")
+    return pub, sig
+
+
+def _torsion_triple(*, cancel: bool, salt: bytes = b""):
+    for i in range(200):
+        msg = b"torsion-%d-" % i + salt
+        pub, sig = _torsion_sign(msg, cancel=cancel)
+        if sig is not None:
+            return pub, sig, msg
+    raise AssertionError("no usable k in 200 tries")
+
+
+def _torsion_tx(tag: bytes, *, cancel: bool):
+    """A transfer whose ownership proof is a torsion craft (see
+    ``_torsion_sign``), structurally sound for ``check_block``."""
+    from p1_tpu.core import keys as _k
+
+    pub, _ = _torsion_sign(b"probe", cancel=cancel)
+    sender = _k.account_id(pub)
+    for seq in range(200):
+        tx = Transaction(
+            sender=sender,
+            recipient=account("bob"),
+            amount=1,
+            fee=1,
+            seq=seq,
+            pubkey=pub,
+            sig=b"",
+            chain=tag,
+        )
+        pub2, sig = _torsion_sign(tx.signing_bytes(), cancel=cancel)
+        assert pub2 == pub
+        if sig is not None:
+            return dataclasses.replace(tx, sig=sig)
+    raise AssertionError("no usable k in 200 sequence numbers")
 
 
 class TestEd25519Batch:
@@ -121,34 +186,60 @@ class TestEd25519Batch:
             assert keys.first_invalid(bad) == min(positions)
         assert keys.first_invalid(base) is None
 
-    def test_torsion_craft_is_the_documented_superset(self):
-        # The ONE deliberate serial/batch divergence (_ed25519.py
-        # docstring): a signer who plants a small-order component in
-        # their OWN public key can make a signature the cofactorless
-        # serial check rejects and the cofactored batch accepts.  Pinned
-        # here so any change to the batch equation that silently widens
-        # or narrows the documented semantics fails a test.
-        T = _ed25519._pt_decompress((0).to_bytes(32, "little"))  # order 4
-        seed = bytes(32)
-        a, prefix = _ed25519._secret_expand(seed)
-        pub = _ed25519._pt_compress(
-            _ed25519._pt_add(_ed25519._pt_mul(a, _ed25519._B), T)
-        )
-        for i in range(50):
-            msg = b"torsion-%d" % i
-            r = int.from_bytes(_ed25519._sha512(prefix + msg), "little") % _ed25519._Q
-            r_enc = _ed25519._pt_compress(_ed25519._pt_mul(r, _ed25519._B))
-            k = (
-                int.from_bytes(_ed25519._sha512(r_enc + pub + msg), "little")
-                % _ed25519._Q
-            )
-            if k % 4 == 0:
-                continue  # torsion term vanishes: not a divergence case
-            sig = r_enc + ((r + k * a) % _ed25519._Q).to_bytes(32, "little")
-            assert not _ed25519.verify(pub, sig, msg)
-            assert _ed25519.verify_batch([(pub, sig, msg)] * 8)
-            return
-        raise AssertionError("no usable k found in 50 messages")
+    def test_subgroup_gate_is_exact(self):
+        # The gate must agree with the definition ([q]P == identity) on
+        # torsion points, torsioned composites, and honest points.
+        B = _ed25519._B
+        T4 = _ed25519._pt_decompress((0).to_bytes(32, "little"))  # order 4
+        T2 = _ed25519._pt_decompress(
+            (_ed25519._P - 1).to_bytes(32, "little")
+        )  # order 2
+        assert _ed25519._in_prime_subgroup(B)
+        assert _ed25519._in_prime_subgroup(_ed25519._IDENT)
+        assert not _ed25519._in_prime_subgroup(T4)
+        assert not _ed25519._in_prime_subgroup(T2)
+        rng = random.Random(25519)
+        for _ in range(8):
+            honest = _ed25519._pt_mul(rng.randrange(1, _ed25519._Q), B)
+            assert _ed25519._in_prime_subgroup(honest)
+            for t in (T2, T4):
+                mixed = _ed25519._pt_add(honest, t)
+                assert not _ed25519._in_prime_subgroup(mixed)
+                assert _ed25519._in_prime_subgroup(mixed) == _ed25519._pt_equal(
+                    _ed25519._pt_mul(_ed25519._Q, mixed), _ed25519._IDENT
+                )
+
+    def test_torsion_craft_cannot_split_batch_from_serial(self):
+        # The review fix: batch acceptance implies serial acceptance.
+        # A torsion craft that serial rejects must NEVER pass the batch.
+        pub, sig, msg = _torsion_triple(cancel=False)
+        assert not _ed25519.verify(pub, sig, msg)
+        assert not _ed25519.verify_batch([(pub, sig, msg)] * 8)
+        # ...and one that serial ACCEPTS is gate-rejected by the batch,
+        # then settled (accepted) by the serial confirmation.
+        pub2, sig2, msg2 = _torsion_triple(cancel=True)
+        assert _ed25519.verify(pub2, sig2, msg2)
+        assert not _ed25519.verify_batch([(pub2, sig2, msg2)] * 8)
+        assert keys.first_invalid([(pub2, sig2, msg2)] * 8) is None
+
+    def test_first_invalid_not_steered_by_torsion_reject(self):
+        # Regression for the old bisection: a torsion gate-reject in the
+        # left half used to steer the search away from a genuinely bad
+        # signature in the right half, returning None for a batch the
+        # serial path rejects.
+        base = _triples(24, salt="steer")
+        tors = _torsion_triple(cancel=True)  # serially VALID, gate-rejected
+        mixed = list(base)
+        mixed[2] = tors
+        bad_pos = 20
+        mixed[bad_pos] = _corrupt(mixed[bad_pos], "sig")
+        assert not _ed25519.verify_batch(mixed)
+        assert keys.first_invalid(mixed) == bad_pos
+        # With no genuinely bad signature, None is the (correct) verdict
+        # even though the batch as a whole fails.
+        mixed[bad_pos] = base[bad_pos]
+        assert not _ed25519.verify_batch(mixed)
+        assert keys.first_invalid(mixed) is None
 
 
 class TestVerifyBatchDispatch:
@@ -167,7 +258,31 @@ class TestVerifyBatchDispatch:
         assert keys.STATS.batched == len(tr)
         assert keys.STATS.serial == 0
 
-    def test_pool_path_and_shutdown_cycle(self):
+    def test_fallback_never_dispatches_pool(self):
+        # The pure-Python backend holds the GIL for its whole MSM, so
+        # fanning its chunks out to worker threads is pure overhead —
+        # fallback batches must run in the calling thread even when
+        # workers > 1 and the batch spans multiple chunks.
+        if keys.HAVE_CRYPTOGRAPHY:
+            pytest.skip("wheel present: pool dispatch is the intended path")
+        old = keys._workers
+        try:
+            keys.set_verify_workers(2)
+            tr = _triples(16, salt="nopool") * ((keys.BATCH_CHUNK // 16) + 1)
+            keys.STATS.reset()
+            assert keys.verify_batch(tr)
+            assert keys.STATS.pool_dispatches == 0
+            assert keys._executor is None  # never even built
+        finally:
+            keys.set_verify_workers(old)
+            keys.shutdown_verify_pool()
+
+    def test_pool_path_and_shutdown_cycle(self, monkeypatch):
+        # Exercises the dispatch/shutdown/rebuild machinery on every
+        # backend: the wheel path hits it naturally; without the wheel,
+        # _use_pool is forced so the executor lifecycle still runs.
+        if not keys.HAVE_CRYPTOGRAPHY:
+            monkeypatch.setattr(keys, "_use_pool", lambda n_chunks: n_chunks > 1)
         old = keys._workers
         try:
             keys.set_verify_workers(2)
@@ -196,13 +311,15 @@ class TestVerifyBatchDispatch:
         assert "ms" in hits[0].getMessage()  # names the measured slowdown
 
     @pytest.mark.slow
-    def test_pool_cancellation_mid_batch(self):
+    def test_pool_cancellation_mid_batch(self, monkeypatch):
         # The soak the conftest knob (workers=1 default) excludes from
         # tier-1: a pool torn down with futures in flight must not
         # change the batch's answer — cancelled chunks re-verify in the
         # calling thread.
         import threading
 
+        if not keys.HAVE_CRYPTOGRAPHY:
+            monkeypatch.setattr(keys, "_use_pool", lambda n_chunks: n_chunks > 1)
         old = keys._workers
         try:
             keys.set_verify_workers(3)
@@ -344,6 +461,26 @@ class TestCheckBlockEquivalence:
                 m.setattr(keys, "BATCH_MIN", 1 << 30)
                 serial_err = self._outcome(chain, block, SignatureCache())
             assert batch_err == serial_err == expected, txs
+
+    def test_torsion_tx_outcomes_identical(self, monkeypatch):
+        # End to end: a block carrying a torsion-crafted ownership proof
+        # must land the SAME way on the batch lane (gate reject → serial
+        # confirmation) as on the pure serial lane — on every node,
+        # whichever backend it has.  cancel=True is serially VALID (the
+        # block is accepted despite the failed batch); cancel=False is
+        # the old chain-split craft (rejected everywhere, same text).
+        cases = [
+            (_torsion_tx(TAG, cancel=True), None),
+            (_torsion_tx(TAG, cancel=False), "bad transaction signature"),
+        ]
+        for crafted, expected in cases:
+            txs = [*_transfers(keys.BATCH_MIN), crafted]  # batch lane engages
+            chain, block = self._block_with(txs)
+            batch_err = self._outcome(chain, block, SignatureCache())
+            with monkeypatch.context() as m:
+                m.setattr(keys, "BATCH_MIN", 1 << 30)
+                serial_err = self._outcome(chain, block, SignatureCache())
+            assert batch_err == serial_err == expected
 
     def test_fingerprint_mismatch_identical(self, monkeypatch):
         victim = _transfers(9)
@@ -502,6 +639,47 @@ class TestSignatureCache:
         assert len(cache) == 0
         assert tx.verify_signature(cache=cache)
         assert len(cache) == 1
+
+
+class TestNegativeVerifyCache:
+    """keys.verify's bounded negative memo (round-8 review, finding 3):
+    a peer replaying a known-bad signature must not buy a fresh backend
+    verify every time."""
+
+    def test_replayed_invalid_costs_one_backend_call(self):
+        kp = key_for("sigbatch-negcache")
+        msg = b"neg-memo"
+        bad_sig = bytes(64)
+        keys._neg_cache.clear()
+        keys.STATS.reset()
+        assert not keys.verify(kp.pubkey, bad_sig, msg)
+        assert keys.STATS.serial == 1
+        for _ in range(5):
+            assert not keys.verify(kp.pubkey, bad_sig, msg)
+        assert keys.STATS.serial == 1  # the memo absorbed the replays
+        # Positive results are NOT memoized here (that's sigcache's job,
+        # keyed by txid): each valid verify still reaches the backend.
+        good = kp.sign(msg)
+        assert keys.verify(kp.pubkey, good, msg)
+        assert keys.verify(kp.pubkey, good, msg)
+        assert keys.STATS.serial == 3
+
+    def test_negative_memo_is_bounded(self, monkeypatch):
+        monkeypatch.setattr(keys, "_NEG_CACHE_MAX", 4)
+        keys._neg_cache.clear()
+        kp = key_for("sigbatch-negbound")
+        for i in range(8):
+            assert not keys.verify(kp.pubkey, bytes(64), b"m%d" % i)
+        assert len(keys._neg_cache) <= 4
+        keys._neg_cache.clear()
+
+    def test_memo_key_commits_to_exact_bytes(self):
+        kp = key_for("sigbatch-negexact")
+        keys._neg_cache.clear()
+        assert not keys.verify(kp.pubkey, bytes(64), b"a")
+        # Same pubkey, different message: its own verdict, not a shadow.
+        msg = b"b"
+        assert keys.verify(kp.pubkey, kp.sign(msg), msg)
 
 
 class TestNoDoubleVerify:
