@@ -219,6 +219,31 @@ def main() -> None:
     except ImportError:
         pass
 
+    # Query serving plane (round 9): quick same-session measurement of
+    # cached proofs/s (benchmarks/query_plane.py), with the serial
+    # baseline from the SAME run so the speedup is never a cross-session
+    # artifact — reported against the ONE recorded constant
+    # (perf_record.py RECORDED_QUERY_QPS), same convention as above.
+    from p1_tpu.hashx.perf_record import (
+        QUERY_DEGRADED_FRACTION,
+        RECORDED_QUERY_QPS,
+    )
+
+    try:
+        from benchmarks.query_plane import bench_quick
+
+        qp = bench_quick(repeats=3)
+        extra["query_qps"] = qp["proof_cached_qps"]
+        extra["query_serial_qps"] = qp["proof_serial_qps"]
+        extra["query_batched_qps"] = qp["proof_batched_qps"]
+        extra["query_vs_recorded"] = round(
+            qp["proof_cached_qps"] / RECORDED_QUERY_QPS, 2
+        )
+        if qp["proof_cached_qps"] < QUERY_DEGRADED_FRACTION * RECORDED_QUERY_QPS:
+            extra["query_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
 
     print(

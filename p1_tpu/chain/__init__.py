@@ -1,6 +1,13 @@
 from p1_tpu.chain.chain import AddResult, AddStatus, Chain
+from p1_tpu.chain.filters import FilterIndex, block_filter, matches_any
 from p1_tpu.chain.ledger import balances
-from p1_tpu.chain.proof import SPVError, TxProof, verify_tx_proof
+from p1_tpu.chain.proof import (
+    ProofCache,
+    SPVError,
+    TxProof,
+    build_block_proofs,
+    verify_tx_proof,
+)
 from p1_tpu.chain.replay import (
     ReplayReport,
     generate_headers,
@@ -20,6 +27,11 @@ __all__ = [
     "AddStatus",
     "Chain",
     "ChainStore",
+    "FilterIndex",
+    "ProofCache",
+    "block_filter",
+    "build_block_proofs",
+    "matches_any",
     "ReplayReport",
     "SPVError",
     "TxProof",

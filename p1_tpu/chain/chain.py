@@ -51,12 +51,13 @@ import enum
 from typing import Iterator
 
 from p1_tpu.core import sigcache
-from p1_tpu.core.block import Block, merkle_branch
+from p1_tpu.core.block import Block
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.core.header import BlockHeader
 from p1_tpu.core.retarget import RetargetRule
+from p1_tpu.chain.filters import FilterIndex
 from p1_tpu.chain.ledger import Ledger, LedgerError
-from p1_tpu.chain.proof import TxProof
+from p1_tpu.chain.proof import ProofCache, TxProof, build_block_proofs
 from p1_tpu.chain.validate import ValidationError, check_block
 
 
@@ -200,6 +201,15 @@ class Chain:
         self._tx_index: dict[bytes, bytes] = {
             tx.txid(): ghash for tx in self.genesis.txs
         }
+        #: Serving plane (round 9).  ``proof_cache`` memoizes the
+        #: reorg-stable part of inclusion proofs, filled a whole block at
+        #: a time (one merkle tree amortized over every tx in the block)
+        #: and invalidated per abandoned block on reorg; ``filter_index``
+        #: caches per-block compact filters (chain/filters.py), built at
+        #: connect and rebuilt on demand for deep history.  Both are
+        #: bytes-bounded LRUs the node charges to its memory gauge.
+        self.proof_cache = ProofCache()
+        self.filter_index = FilterIndex()
 
     # -- queries ---------------------------------------------------------
 
@@ -361,22 +371,64 @@ class Chain:
     def tx_proof(self, txid: bytes) -> TxProof | None:
         """SPV inclusion proof for a main-chain-confirmed transaction, or
         ``None`` if ``txid`` is not confirmed at the current tip.  Served
-        from the txid index: O(containing block) per query."""
+        from the txid index (O(containing block) worst case) through the
+        proof cache: a miss builds proof templates for the WHOLE
+        containing block with one merkle tree (amortizing the tree over
+        every tx in it — the batch economics of chain/proof.py), a hit
+        is a dict lookup plus a tip-height stamp."""
+        entry = self.tx_proof_entry(txid)
+        return None if entry is None else entry.at_tip(self.height)
+
+    def tx_proof_entry(self, txid: bytes):
+        """The cached (tip-height-free) proof entry for ``txid``, or None
+        when it is not confirmed on the current main chain.  The wire
+        layer uses this to memoize serialized payloads on the entry
+        (node/node.py, node/queryplane.py)."""
         bhash = self._tx_index.get(txid)
         if bhash is None:
             return None
-        entry = self._index[bhash]
+        cached = self.proof_cache.get(bhash, txid)
+        if cached is not None:
+            return cached
+        # Miss: build every proof for the containing block at once —
+        # requests cluster by block (a wallet checking a payment batch,
+        # a reorg re-audit), so the amortized fill is the common win.
         block = self._block_at(bhash)
+        height = self._index[bhash].height
         txids = [tx.txid() for tx in block.txs]
-        index = txids.index(txid)
-        return TxProof(
-            tx=block.txs[index],
-            header=block.header,
-            height=entry.height,
-            tip_height=self.height,
-            index=index,
-            branch=merkle_branch(txids, index),
-        )
+        for tid, proof in build_block_proofs(block, height, txids).items():
+            entry = self.proof_cache.add(bhash, tid, proof)
+            if tid == txid:
+                cached = entry
+        return cached
+
+    def tx_proofs(self, txids) -> dict[bytes, TxProof | None]:
+        """Batch proof lookup: one ``TxProof`` (or None) per requested
+        txid, sharing a single merkle-tree construction per distinct
+        containing block via the proof cache.  The serving plane's
+        amortized API (benchmarks/query_plane.py measures it against
+        the serial per-proof baseline)."""
+        tip = self.height
+        out: dict[bytes, TxProof | None] = {}
+        for txid in txids:
+            entry = self.tx_proof_entry(txid)
+            out[txid] = None if entry is None else entry.at_tip(tip)
+        return out
+
+    def block_filter(self, block_hash: bytes) -> bytes | None:
+        """The compact filter for an indexed block (chain/filters.py),
+        from the filter index — rebuilt on demand from the (possibly
+        evicted, store-refetchable) body for deep history."""
+        if block_hash not in self._index:
+            return None
+        return self.filter_index.get_or_build(block_hash, self._block_at)
+
+    def main_hash_at(self, height: int) -> bytes | None:
+        """The main-chain block hash at ``height`` (None above the tip)
+        — the filter-serving path's height → hash step."""
+        if 0 <= height < len(self._main_hashes):
+            return self._main_hashes[height]
+        return None
 
     def main_chain(self) -> Iterator[Block]:
         """Genesis-first iteration of the current best chain."""
@@ -469,6 +521,11 @@ class Chain:
         for b in removed:
             for tx in b.txs:
                 self._tx_index.pop(tx.txid(), None)
+            # Reorg event path: proofs cut for an abandoned block must
+            # not linger (chain/proof.py's invalidation layer — the tx
+            # index above already makes them unreachable; this makes
+            # them also stop existing, the property the reorg test pins).
+            self.proof_cache.invalidate_block(b.block_hash())
         for b in added:
             bh = b.block_hash()
             for tx in b.txs:

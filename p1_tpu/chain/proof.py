@@ -30,6 +30,7 @@ O(chain).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 
 from p1_tpu.core.block import verify_merkle_branch
@@ -153,3 +154,176 @@ def verify_tx_proof(
             raise SPVError("transaction signed for a different chain")
         if not tx.verify_signature():
             raise SPVError("bad transaction signature")
+
+
+# -- the serving plane's proof cache (round 9) ---------------------------
+
+
+class CachedProof:
+    """One cached inclusion proof: the reorg-STABLE part of a ``TxProof``.
+
+    Everything here — the transaction, its block's header, the block's
+    height (a pure function of its ancestor chain, immutable however
+    fork choice moves), the tx index, the merkle branch — is fixed the
+    moment the block exists.  The one field that moves with every new
+    block, ``tip_height``, is deliberately NOT cached: the serving path
+    stamps the current tip into a ``dataclasses.replace`` (object path)
+    or patches four bytes of the memoized wire payload (hot path), so a
+    cache entry stays byte-correct across any number of tip advances.
+
+    ``payload`` is a slot the WIRE layer fills lazily (the serialized
+    PROOF frame with tip_height zeroed — node/protocol.py owns the
+    encoding; this module stays protocol-free).  ``ProofCache`` charges
+    it to the entry's size when notified.
+    """
+
+    __slots__ = ("proof", "payload")
+
+    def __init__(self, proof: TxProof):
+        self.proof = proof  # tip_height == 0 template
+        self.payload: bytes | None = None
+
+    def at_tip(self, tip_height: int) -> TxProof:
+        return dataclasses.replace(self.proof, tip_height=tip_height)
+
+    def approx_bytes(self) -> int:
+        p = self.proof
+        return (
+            len(p.tx.serialize())
+            + 80  # header
+            + 32 * len(p.branch)
+            + 96  # object/key overhead estimate
+            + (len(self.payload) if self.payload is not None else 0)
+        )
+
+
+class ProofCache:
+    """Bounded LRU of ``CachedProof`` entries keyed ``(block hash, txid)``.
+
+    Reorg safety has two independent layers:
+
+    - the LOOKUP layer: ``Chain.tx_proof`` resolves txid → containing
+      main-chain block through ``_tx_index``, which every tip move
+      rewrites — so a cached proof for an orphaned block is unreachable
+      the instant the reorg lands, whatever this cache holds;
+    - the INVALIDATION layer: the chain's reorg event path
+      (``add_block``'s removed list) explicitly drops every entry for
+      each abandoned block (``invalidate_block``), so stale entries
+      also stop costing memory — and the "never served stale" property
+      does not depend on a single index staying coherent (tested:
+      tests/test_queryplane.py's reorg case asserts both layers).
+
+    Bounded by bytes, LRU evicted; ``bytes_used`` is charged to the
+    node's accounted memory gauge (node/node.py ``_memory_gauge``) like
+    every other cache the governor watches.
+    """
+
+    def __init__(self, max_bytes: int = 8 << 20):
+        self.max_bytes = int(max_bytes)
+        self._lru: "collections.OrderedDict[tuple[bytes, bytes], CachedProof]" = (
+            collections.OrderedDict()
+        )
+        #: block hash -> set of txids cached under it (O(block) reorg
+        #: invalidation without scanning the whole LRU).
+        self._by_block: dict[bytes, set[bytes]] = {}
+        self.bytes_used = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidated = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def get(self, block_hash: bytes, txid: bytes) -> CachedProof | None:
+        entry = self._lru.get((block_hash, txid))
+        if entry is None:
+            self.misses += 1
+            return None
+        self._lru.move_to_end((block_hash, txid))
+        self.hits += 1
+        return entry
+
+    def add(self, block_hash: bytes, txid: bytes, proof: TxProof) -> CachedProof:
+        """Cache ``proof`` (tip_height is zeroed here — templates never
+        embed a tip) and return the entry."""
+        key = (block_hash, txid)
+        entry = self._lru.get(key)
+        if entry is not None:
+            self._lru.move_to_end(key)
+            return entry
+        if proof.tip_height:
+            proof = dataclasses.replace(proof, tip_height=0)
+        entry = CachedProof(proof)
+        self._lru[key] = entry
+        self._by_block.setdefault(block_hash, set()).add(txid)
+        self.bytes_used += entry.approx_bytes()
+        self._evict()
+        return entry
+
+    def note_payload(self, entry: CachedProof, payload: bytes) -> None:
+        """The wire layer memoized ``entry``'s serialized form — account
+        for the extra bytes (and re-run eviction against the budget)."""
+        if entry.payload is None:
+            entry.payload = payload
+            self.bytes_used += len(payload)
+            self._evict()
+
+    def _evict(self) -> None:
+        while self.bytes_used > self.max_bytes and len(self._lru) > 1:
+            (bhash, txid), entry = self._lru.popitem(last=False)
+            self.bytes_used -= entry.approx_bytes()
+            txids = self._by_block.get(bhash)
+            if txids is not None:
+                txids.discard(txid)
+                if not txids:
+                    del self._by_block[bhash]
+
+    def invalidate_block(self, block_hash: bytes) -> int:
+        """Drop every entry for ``block_hash`` (the reorg event path);
+        returns how many were dropped."""
+        txids = self._by_block.pop(block_hash, None)
+        if not txids:
+            return 0
+        n = 0
+        for txid in txids:
+            entry = self._lru.pop((block_hash, txid), None)
+            if entry is not None:
+                self.bytes_used -= entry.approx_bytes()
+                n += 1
+        self.invalidated += n
+        return n
+
+    def snapshot(self) -> dict:
+        return {
+            "entries": len(self._lru),
+            "bytes": self.bytes_used,
+            "hits": self.hits,
+            "misses": self.misses,
+            "invalidated": self.invalidated,
+        }
+
+
+def build_block_proofs(
+    block, height: int, txids: list[bytes] | None = None
+) -> dict[bytes, TxProof]:
+    """Tip-height-0 proof templates for EVERY transaction in ``block`` —
+    the batch primitive: one ``merkle_levels`` tree construction
+    amortized across all of the block's proofs (vs one O(ntx) hashing
+    pass per proof on the serial path).  ``txids`` may carry the
+    precomputed txid list when the caller already has it."""
+    from p1_tpu.core.block import branch_from_levels, merkle_levels
+
+    if txids is None:
+        txids = [tx.txid() for tx in block.txs]
+    levels = merkle_levels(txids)
+    return {
+        txid: TxProof(
+            tx=block.txs[i],
+            header=block.header,
+            height=height,
+            tip_height=0,
+            index=i,
+            branch=branch_from_levels(levels, i),
+        )
+        for i, txid in enumerate(txids)
+    }

@@ -486,6 +486,46 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the salvaged store here instead of replacing in place",
     )
 
+    p = sub.add_parser(
+        "serve",
+        help="read-only replica worker(s): serve headers/filters/proof "
+        "queries from a chain store over mmap, WITHOUT the writer lock "
+        "— attach any number to a live node's store and scale query "
+        "QPS with cores while the node only mines and validates",
+    )
+    p.add_argument("--store", required=True, help="chain persistence path")
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port",
+        type=int,
+        default=9555,
+        help="listen port (0 = ephemeral; --workers > 1 needs a real "
+        "port, shared via SO_REUSEPORT)",
+    )
+    p.add_argument(
+        "--workers",
+        type=int,
+        default=1,
+        help="replica processes to run against this store on this port "
+        "(SO_REUSEPORT fan-out; each worker holds its own mmap and "
+        "caches)",
+    )
+    p.add_argument(
+        "--refresh-interval",
+        type=float,
+        default=0.25,
+        help="seconds between tail rescans for blocks the node appended",
+    )
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        help="exit after this many seconds (tests/harnesses); default: "
+        "serve until interrupted",
+    )
+    _add_retarget(p)
+
     p = sub.add_parser("net", help="N-node localhost net (config 4)")
     _add_common(p)
     p.add_argument("--nodes", type=int, default=4)
@@ -1326,6 +1366,88 @@ def cmd_fsck(args) -> int:
     return run_fsck(args.store, args.out)
 
 
+def cmd_serve(args) -> int:
+    """Read-only replica worker(s) over a chain store (`p1 serve`).
+
+    Each worker mmaps the store WITHOUT the writer flock (a live node
+    keeps appending underneath; the worker's refresh loop follows the
+    tail) and answers headers/filters/proof/blocks/status queries behind
+    governor admission — node/queryplane.py.  ``--workers N`` forks N
+    processes sharing one port via SO_REUSEPORT, so query throughput
+    scales with cores.  Prints one JSON line per worker with the bound
+    port once serving."""
+    import os
+
+    from p1_tpu.node.queryplane import serve_replica
+
+    retarget = _retarget_rule(args)
+    if args.workers > 1 and args.port == 0:
+        print("--workers > 1 needs an explicit --port", file=sys.stderr)
+        return 2
+    if args.workers < 1:
+        print("--workers must be >= 1", file=sys.stderr)
+        return 2
+
+    def _worker() -> int:
+        async def _run() -> int:
+            try:
+                srv = await serve_replica(
+                    args.store,
+                    args.difficulty,
+                    retarget=retarget,
+                    host=args.host,
+                    port=args.port,
+                    refresh_interval_s=args.refresh_interval,
+                    reuse_port=args.workers > 1,
+                )
+            except (OSError, ValueError) as e:
+                print(f"serve failed: {e}", file=sys.stderr)
+                return 1
+            print(
+                json.dumps(
+                    {
+                        "config": "serve",
+                        "port": srv.port,
+                        "height": srv.view.tip_height,
+                        "records": srv.view.records,
+                        "pid": os.getpid(),
+                    }
+                ),
+                flush=True,
+            )
+            try:
+                if args.deadline is not None:
+                    await asyncio.sleep(args.deadline)
+                else:
+                    while True:
+                        await asyncio.sleep(3600)
+            except asyncio.CancelledError:
+                pass
+            finally:
+                await srv.stop()
+            return 0
+
+        try:
+            return asyncio.run(_run())
+        except KeyboardInterrupt:
+            return 0
+
+    procs = []
+    if args.workers > 1:
+        import multiprocessing
+
+        for _ in range(args.workers - 1):
+            proc = multiprocessing.Process(target=_worker, daemon=True)
+            proc.start()
+            procs.append(proc)
+    try:
+        return _worker()
+    finally:
+        for proc in procs:
+            proc.terminate()
+            proc.join(timeout=5)
+
+
 def cmd_net(args) -> int:
     from p1_tpu.node.netharness import run_net
 
@@ -1369,6 +1491,7 @@ def main(argv=None) -> int:
         "balances": cmd_balances,
         "compact": cmd_compact,
         "fsck": cmd_fsck,
+        "serve": cmd_serve,
         "pod": cmd_pod,
         "net": cmd_net,
         "bench": cmd_bench,

@@ -39,31 +39,58 @@ def merkle_root(txids: list[bytes]) -> bytes:
     return level[0]
 
 
+def merkle_levels(txids: list[bytes]) -> list[list[bytes]]:
+    """Every level of the merkle tree, leaves (level 0, with the odd-tail
+    duplication applied per level) up to the root level.
+
+    The batched-proof primitive (chain/proof.py): building the tree once
+    costs the same ~2N hashes as one ``merkle_branch`` call, but with
+    the levels held, EVERY transaction's branch is then O(log N) slice
+    picks — amortizing the tree across all proofs for one block is what
+    turns per-proof merkle reconstruction from the serving plane's
+    dominant cost into noise (benchmarks/query_plane.py).
+    """
+    if not txids:
+        raise ValueError("no txids")
+    from p1_tpu.core.hashutil import sha256d
+
+    level = list(txids)
+    levels = []
+    while len(level) > 1:
+        if len(level) % 2:
+            level.append(level[-1])
+        levels.append(level)
+        level = [
+            sha256d(level[i] + level[i + 1]) for i in range(0, len(level), 2)
+        ]
+    levels.append(level)
+    return levels
+
+
+def branch_from_levels(levels: list[list[bytes]], index: int) -> tuple[bytes, ...]:
+    """The sibling path for leaf ``index`` out of a prebuilt
+    ``merkle_levels`` tree — one slice pick per level, no hashing."""
+    branch: list[bytes] = []
+    i = index
+    for level in levels[:-1]:
+        branch.append(level[i ^ 1])
+        i //= 2
+    return tuple(branch)
+
+
 def merkle_branch(txids: list[bytes], index: int) -> tuple[bytes, ...]:
     """The sibling path proving ``txids[index]`` is under ``merkle_root(txids)``.
 
     One 32-byte sibling per tree level, leaf-to-root order — the compact
     inclusion proof an SPV client checks with ``verify_merkle_branch``
-    without seeing the other transactions.  Mirrors ``merkle_root``'s
-    construction exactly (including the odd-tail duplication), so the two
-    functions agree for every (txids, index).
+    without seeing the other transactions.  Built via ``merkle_levels``
+    (ONE tree construction shared with the batched-proof path), so the
+    root and branch functions agree for every (txids, index) by
+    construction.
     """
     if not 0 <= index < len(txids):
         raise ValueError(f"index {index} out of range for {len(txids)} txids")
-    from p1_tpu.core.hashutil import sha256d
-
-    branch: list[bytes] = []
-    level = list(txids)
-    i = index
-    while len(level) > 1:
-        if len(level) % 2:
-            level.append(level[-1])
-        branch.append(level[i ^ 1])
-        level = [
-            sha256d(level[j] + level[j + 1]) for j in range(0, len(level), 2)
-        ]
-        i //= 2
-    return tuple(branch)
+    return branch_from_levels(merkle_levels(txids), index)
 
 
 def verify_merkle_branch(

@@ -64,3 +64,19 @@ RECORDED_REVALIDATE_BPS = 329.0
 #: flagged degraded in the bench JSON (same tolerance rationale as the
 #: ingest guard).
 REVALIDATE_DEGRADED_FRACTION = 0.5
+
+#: Query serving plane (round 9): cached proofs/s through the proof
+#: cache's steady state — LRU payload hit + 4-byte tip patch per serve
+#: (benchmarks/query_plane.py ``bench_quick``: 60 blocks x 24 signed
+#: transfers, difficulty 1).  Measured 2026-08-04 on the 1-vCPU bench
+#: host at 1-minute loadavg 0.46; the same run measured the serial
+#: per-proof baseline at ~29k/s and the cold batched path at ~136k/s —
+#: the ROADMAP ≥50k/s bar is cleared by the batched path alone, before
+#: the cache or any `p1 serve` process fan-out.  ``bench.py`` emits
+#: ``query_vs_recorded`` against this figure — the denominator-pinning
+#: convention of RECORDED_CPU_BASELINE_HPS.
+RECORDED_QUERY_QPS = 980_000.0
+
+#: Same-session fraction below which the query-plane measurement is
+#: flagged degraded in the bench JSON (host-load tolerance, as above).
+QUERY_DEGRADED_FRACTION = 0.5

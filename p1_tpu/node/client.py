@@ -366,3 +366,191 @@ async def get_account(
                     return body
 
     return await asyncio.wait_for(_run(), timeout)
+
+
+async def get_filters(
+    host: str,
+    port: int,
+    start_height: int,
+    count: int,
+    difficulty: int,
+    timeout: float = 30.0,
+    retarget=None,
+) -> list[tuple[bytes, bytes]]:
+    """Fetch compact block filters for a main-chain height range: (block
+    hash, filter bytes) pairs ascending from ``start_height``.  The
+    server caps the range — fewer entries than asked means ask again
+    from where the reply ended (or the chain ended there)."""
+
+    async def _run():
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+            await protocol.write_frame(
+                writer, protocol.encode_getfilters(start_height, count)
+            )
+            while True:
+                mtype, body = await _read_msg(reader, writer)
+                if mtype is MsgType.FILTERS:
+                    start, entries = body
+                    if start != start_height:
+                        raise ValueError(
+                            "FILTERS reply for a different start height"
+                        )
+                    return entries
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
+async def filter_scan(
+    host: str,
+    port: int,
+    watch_items,
+    difficulty: int,
+    timeout: float = 120.0,
+    retarget=None,
+    fetch_blocks: bool = True,
+    start_height: int = 1,
+    page: int = 500,
+):
+    """Light-client sync by filter match (the round-9 serving plane's
+    wallet flow): ONE session that
+
+    1. syncs the peer's header chain (GETHEADERS locator rounds — the
+       ~80 B/block skeleton),
+    2. pages the compact filter stream (GETFILTERS) and matches
+       ``watch_items`` (account ids as utf-8 bytes, and/or txids)
+       locally — the peer never learns WHICH accounts the wallet
+       watches, and the wallet asks zero per-address questions,
+    3. fetches only the matching blocks (rare: the designed false-
+       positive rate per absent item is ~1/M ≈ 1.3e-6) and pins each to
+       the header chain by hash, dropping any filter false positives
+       after inspection.
+
+    Returns ``(headers, matches)`` where matches is a list of
+    ``(height, block)`` — or ``(headers, [(height, block_hash), ...])``
+    with ``fetch_blocks=False`` for callers that only want locations.
+    Zero false negatives is the filter construction's guarantee
+    (chain/filters.py): every block that actually touches a watched
+    item IS in the matches (property-tested against full block scans).
+
+    Trust model: same as ``get_headers`` — the header chain should be
+    verified by the caller (``replay_host``); filters and blocks are
+    pinned to it by hash, and fetched blocks are checked against their
+    header's merkle commitment here, so a lying peer can omit service
+    but cannot substitute content.
+    """
+    from p1_tpu.chain.chain import locator_hashes
+    from p1_tpu.chain.filters import matches_any
+
+    items = [
+        it.encode("utf-8") if isinstance(it, str) else bytes(it)
+        for it in watch_items
+    ]
+
+    async def _run():
+        genesis = make_genesis(difficulty, retarget)
+        headers = [genesis.header]
+        hashes = [genesis.block_hash()]
+        pos = {hashes[0]: 0}
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+
+            async def _reply(want):
+                while True:
+                    mtype, body = await _read_msg(reader, writer)
+                    if mtype is want:
+                        return body
+
+            # 1. headers skeleton (single-session variant of get_headers;
+            # the supervised multi-peer fetch lives there — this scan is
+            # one wallet round against one chosen peer).
+            while True:
+                await protocol.write_frame(
+                    writer, protocol.encode_getheaders(locator_hashes(hashes))
+                )
+                batch = await _reply(MsgType.HEADERS)
+                new = [h for h in batch if h.block_hash() not in pos]
+                if not new:
+                    break
+                at = pos.get(new[0].prev_hash)
+                if at is None:
+                    raise ValueError(
+                        "HEADERS reply does not link to the known chain"
+                    )
+                if at != len(headers) - 1:
+                    for h in hashes[at + 1 :]:
+                        del pos[h]
+                    del headers[at + 1 :]
+                    del hashes[at + 1 :]
+                for h in new:
+                    if h.prev_hash != hashes[-1]:
+                        raise ValueError("HEADERS batch is not contiguous")
+                    headers.append(h)
+                    hashes.append(h.block_hash())
+                    pos[hashes[-1]] = len(hashes) - 1
+
+            # 2. filter stream + local match.
+            matched: list[tuple[int, bytes]] = []
+            h = max(1, start_height)
+            while h < len(hashes):
+                await protocol.write_frame(
+                    writer,
+                    protocol.encode_getfilters(
+                        h, min(page, len(hashes) - h)
+                    ),
+                )
+                start, entries = await _reply(MsgType.FILTERS)
+                if not entries:
+                    break
+                for i, (bhash, fbytes) in enumerate(entries):
+                    height = start + i
+                    if height >= len(hashes):
+                        break  # peer's chain ran ahead of our skeleton
+                    if bhash != hashes[height]:
+                        # The peer reorged between the header sync and
+                        # this page; the stale tail's filters are for
+                        # blocks we did not pin — stop at the divergence
+                        # (a fuller client would re-sync headers).
+                        break
+                    if items and matches_any(fbytes, bhash, items):
+                        matched.append((height, bhash))
+                h = start + len(entries)
+
+            if not fetch_blocks:
+                return headers, matched
+
+            # 3. fetch ONLY the matched blocks, pinned by hash; drop
+            # false positives after inspection (a block whose filter
+            # matched but that touches none of the watched items).
+            out = []
+            for height, bhash in matched:
+                await protocol.write_frame(
+                    writer,
+                    protocol.encode_getblocks([hashes[height - 1]]),
+                )
+                blocks = await _reply(MsgType.BLOCKS)
+                if not blocks or blocks[0].block_hash() != bhash:
+                    raise ValueError(
+                        "peer did not serve the filter-matched block"
+                    )
+                block = blocks[0]
+                if not block.merkle_ok():
+                    raise ValueError(
+                        "matched block fails its merkle commitment"
+                    )
+                touched = set()
+                for tx in block.txs:
+                    touched.add(tx.txid())
+                    touched.add(tx.sender.encode("utf-8"))
+                    touched.add(tx.recipient.encode("utf-8"))
+                if any(it in touched for it in items):
+                    out.append((height, block))
+            return headers, out
+
+    return await asyncio.wait_for(_run(), timeout)

@@ -77,6 +77,9 @@ READDR_INTERVAL_S = 30.0
 #: HEADERS_BATCH, a peer must not be able to drive O(chain) scans on the
 #: event loop by asking big.
 FEE_WINDOW_MAX = 1024
+#: Filters per GETFILTERS reply (a filter is a few bytes per tx; 1000
+#: keeps the frame well under MAX_FRAME even for full blocks).
+FILTER_BATCH = 1000
 #: Pending compact-block reconstructions awaiting a BLOCKTXN reply.  Small
 #: and FIFO-capped: entries exist only for the one GETBLOCKTXN round trip;
 #: anything stranded (peer died mid-answer) is evicted by newer blocks and
@@ -146,6 +149,7 @@ _MSG_CLASS = {
     MsgType.GETADDR: CLASS_QUERIES,
     MsgType.GETBLOCKTXN: CLASS_QUERIES,
     MsgType.GETSTATUS: CLASS_QUERIES,
+    MsgType.GETFILTERS: CLASS_QUERIES,
 }
 
 #: Frames dropped while the node is in the SHED overload state.
@@ -220,6 +224,13 @@ class NodeMetrics:
     store_retries: int = 0
     store_recoveries: int = 0
     store_blocks_deferred: int = 0
+    #: Query serving plane (round 9): inclusion proofs served (found
+    #: replies only) and compact block filters served, with the filter
+    #: payload bytes — the read-traffic telemetry ``status()["queries"]``
+    #: reports next to the proof/filter cache hit rates.
+    proofs_served: int = 0
+    filters_served: int = 0
+    filter_bytes_served: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -969,6 +980,11 @@ class Node:
             + getattr(self.mempool, "bytes_pending", 0)
             + write_buf
             + self.sig_cache.bytes_used
+            # Serving-plane caches (round 9): bounded LRUs, but bounded
+            # is not free — the gauge must see them or a proof/filter
+            # query storm becomes untracked RAM under the watermark.
+            + self.chain.proof_cache.bytes_used
+            + self.chain.filter_index.bytes_used
         )
 
     async def _governor_loop(self) -> None:
@@ -1905,10 +1921,33 @@ class Node:
         elif mtype is MsgType.GETPROOF:
             # SPV query: serve the inclusion proof (or not-found) from the
             # chain's txid index; the client verifies it, we just attest
-            # our main-chain view.
-            await self._send_guarded(
-                peer, protocol.encode_proof(self.chain.tx_proof(body))
+            # our main-chain view.  Served through the proof cache
+            # (chain/proof.py): a repeat query is a payload memo hit plus
+            # a 4-byte tip-height patch, a cold one fills proof templates
+            # for the whole containing block in one merkle pass.
+            await self._send_guarded(peer, self._proof_payload(body))
+        elif mtype is MsgType.GETFILTERS:
+            # Light-client filter sync (chain/filters.py): the compact
+            # filters for a main-chain height range, each pinned to its
+            # block hash.  Range-capped like GETBLOCKS/GETHEADERS so one
+            # query can't drive an O(chain) scan on the event loop.
+            start, count = body
+            entries = []
+            for h in range(start, start + min(count, FILTER_BATCH)):
+                bhash = self.chain.main_hash_at(h)
+                if bhash is None:
+                    break
+                fbytes = self.chain.block_filter(bhash)
+                entries.append((bhash, fbytes))
+            self.metrics.filters_served += len(entries)
+            self.metrics.filter_bytes_served += sum(
+                len(f) for _, f in entries
             )
+            await self._send_guarded(
+                peer, protocol.encode_filters(start, entries)
+            )
+        elif mtype is MsgType.FILTERS:
+            pass  # reply frame: meaningful to light clients only
         elif mtype is MsgType.GETSTATUS:
             # Operator probe (`p1 status`): the same JSON the node logs,
             # served over the wire — deliberately NOT in _SHED_DROPS, so
@@ -1926,6 +1965,22 @@ class Node:
             pass  # reply frames: meaningful to querying clients only
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
+
+    def _proof_payload(self, txid: bytes) -> bytes:
+        """The wire PROOF reply for ``txid``, through the chain's proof
+        cache: the serialized payload (tip zeroed) is memoized on the
+        cache entry on first serve, so repeats cost one dict lookup and
+        a 4-byte tip patch — the verify-once economics of the sigcache
+        applied to the proof path."""
+        entry = self.chain.tx_proof_entry(txid)
+        if entry is None:
+            return protocol.encode_proof(None)
+        if entry.payload is None:
+            self.chain.proof_cache.note_payload(
+                entry, protocol.encode_proof(entry.proof)
+            )
+        self.metrics.proofs_served += 1
+        return protocol.patch_proof_tip(entry.payload, self.chain.height)
 
     async def _send_guarded(self, peer: _Peer, payload: bytes) -> None:
         """Reply/continuation send with a timeout: a peer that stops
@@ -2157,6 +2212,11 @@ class Node:
             # incl. cascaded orphans; a failing disk degrades, never
             # unwinds this handler (_store_append).
             self._store_append(res.connected)
+            for b in res.connected:
+                # Serving plane: build each connected block's compact
+                # filter while its body is hot (incremental-at-connect;
+                # anything LRU-evicted later rebuilds from the store).
+                self.chain.filter_index.add_block(b)
             if res.tip_changed:
                 if res.removed:
                     self.metrics.reorgs += 1
@@ -2427,6 +2487,18 @@ class Node:
                 "body_cache_blocks": self.config.body_cache_blocks,
                 "mining_paused": self.governor.shedding
                 or self._store_degraded,
+            },
+            # Query serving plane (round 9): read-traffic counters (how
+            # many proofs/filters this node served and at what cache hit
+            # rate) — the host-side view of the tier benchmarks/
+            # query_plane.py measures; replica workers (`p1 serve`)
+            # report their own copy of this block over GETSTATUS.
+            "queries": {
+                "proofs_served": self.metrics.proofs_served,
+                "filters_served": self.metrics.filters_served,
+                "filter_bytes_served": self.metrics.filter_bytes_served,
+                "proof_cache": self.chain.proof_cache.snapshot(),
+                "filter_cache": self.chain.filter_index.snapshot(),
             },
             # Validation fast lane (round 8): the verify-once signature
             # cache (this node's instance — hits are blocks connecting

@@ -108,6 +108,20 @@ Messages:
              verifies the whole chain itself (replay_host — PoW, linkage,
              and the retarget difficulty schedule), needing ~80 B/block
              instead of full blocks and trusting nothing but work.
+- GETFILTERS: u32 start height + u16 count — request the compact block
+             filters (chain/filters.py, BIP158 analog) for a main-chain
+             height range.  A light client that has synced headers
+             downloads the filter stream, matches its own accounts/txids
+             LOCALLY, and fetches only the (rare) matching blocks — sync
+             by filter match instead of per-address queries.
+- FILTERS:   u32 start height + u16 count + count * (32-byte block hash
+             + u32 filter len + filter bytes), heights ascending from
+             the requested start.  The block hash lets the client pin
+             each filter to its independently verified header chain; the
+             filter itself is a Golomb-coded set over the block's txids
+             and account ids with zero false negatives (a non-match is
+             proof of absence).  The server caps ``count`` like the
+             other range queries — ask again from where the reply ended.
 """
 
 from __future__ import annotations
@@ -156,8 +170,10 @@ _LEN = struct.Struct(">I")
 #: estimation (GETFEES/FEES); v8 liveness (PING/PONG + handshake/idle
 #: deadlines — a v7 node would call the probe a protocol violation); v9
 #: the operator status probe (GETSTATUS/STATUS — `p1 status` renders a
-#: running node's full status JSON, overload block included).
-PROTOCOL_VERSION = 9
+#: running node's full status JSON, overload block included); v10 the
+#: query serving plane (GETFILTERS/FILTERS — compact block filters for
+#: light-client sync by filter match, chain/filters.py).
+PROTOCOL_VERSION = 10
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -186,6 +202,8 @@ class MsgType(enum.IntEnum):
     PONG = 22
     GETSTATUS = 23
     STATUS = 24
+    GETFILTERS = 25
+    FILTERS = 26
 
 
 @dataclasses.dataclass(frozen=True)
@@ -425,6 +443,79 @@ def encode_headers(headers: list[BlockHeader]) -> bytes:
         bytes([MsgType.HEADERS])
         + struct.pack(">H", len(headers))
         + b"".join(h.serialize() for h in headers)
+    )
+
+
+def encode_headers_raw(raw_headers: list[bytes]) -> bytes:
+    """HEADERS from pre-serialized 80-byte header slices — the read
+    replica's zero-parse serving path (node/queryplane.py): headers come
+    straight off the mmap'd store, no BlockHeader objects anywhere."""
+    if len(raw_headers) > 0xFFFF:
+        raise ValueError("too many headers for one HEADERS frame")
+    for raw in raw_headers:
+        if len(raw) != HEADER_SIZE:
+            raise ValueError("raw header must be exactly 80 bytes")
+    return (
+        bytes([MsgType.HEADERS])
+        + struct.pack(">H", len(raw_headers))
+        + b"".join(raw_headers)
+    )
+
+
+def encode_blocks_raw(raw_blocks: list[bytes]) -> bytes:
+    """BLOCKS from pre-serialized block records — the replica serves the
+    store's exact record bytes without a Block object round trip."""
+    if len(raw_blocks) > 0xFFFF:
+        raise ValueError("too many blocks for one BLOCKS frame")
+    parts = [bytes([MsgType.BLOCKS]), struct.pack(">H", len(raw_blocks))]
+    for raw in raw_blocks:
+        parts.append(_LEN.pack(len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def encode_getfilters(start_height: int, count: int) -> bytes:
+    if not 0 <= start_height <= 0xFFFFFFFF:
+        raise ValueError("bad filter start height")
+    if not 0 < count <= 0xFFFF:
+        raise ValueError("need 1..65535 filters")
+    return bytes([MsgType.GETFILTERS]) + struct.pack(">IH", start_height, count)
+
+
+def encode_filters(start_height: int, entries: list[tuple[bytes, bytes]]) -> bytes:
+    """``entries`` are (block hash, filter bytes) pairs for consecutive
+    main-chain heights ascending from ``start_height``."""
+    if len(entries) > 0xFFFF:
+        raise ValueError("too many filters for one FILTERS frame")
+    parts = [
+        bytes([MsgType.FILTERS]),
+        struct.pack(">IH", start_height, len(entries)),
+    ]
+    for bhash, fbytes in entries:
+        if len(bhash) != 32:
+            raise ValueError("block hash must be 32 bytes")
+        parts.append(bhash)
+        parts.append(_LEN.pack(len(fbytes)))
+        parts.append(fbytes)
+    return b"".join(parts)
+
+
+#: Byte offset of ``tip_height`` inside an encoded found-PROOF payload:
+#: type byte + found byte + u32 height puts the u32 tip at bytes 6..10
+#: (encode_proof's ">III" pack).  ``patch_proof_tip`` below is what
+#: makes serialized proofs cacheable at all: everything else in the
+#: payload is reorg-stable (chain/proof.py CachedProof), so serving a
+#: cached proof is one 4-byte splice instead of a re-encode.
+_PROOF_TIP_OFF = 6
+
+
+def patch_proof_tip(payload: bytes, tip_height: int) -> bytes:
+    """A copy of a cached found-PROOF payload with the current tip height
+    stamped in — the hot serving path for repeat proof queries."""
+    return (
+        payload[:_PROOF_TIP_OFF]
+        + struct.pack(">I", tip_height)
+        + payload[_PROOF_TIP_OFF + 4 :]
     )
 
 
@@ -687,6 +778,32 @@ def _decode(payload: bytes):
             )
             for i in range(n)
         ]
+    if mtype is MsgType.GETFILTERS:
+        if len(body) != 6:
+            raise ValueError("bad GETFILTERS")
+        start, count = struct.unpack(">IH", body)
+        if count == 0:
+            raise ValueError("bad GETFILTERS count")
+        return mtype, (start, count)
+    if mtype is MsgType.FILTERS:
+        if len(body) < 6:
+            raise ValueError("bad FILTERS")
+        start, n = struct.unpack_from(">IH", body)
+        off = 6
+        entries = []
+        for _ in range(n):
+            if len(body) < off + 36:
+                raise ValueError("truncated FILTERS")
+            bhash = body[off : off + 32]
+            (flen,) = _LEN.unpack_from(body, off + 32)
+            off += 36
+            if len(body) < off + flen:
+                raise ValueError("truncated FILTERS entry")
+            entries.append((bhash, body[off : off + flen]))
+            off += flen
+        if off != len(body):
+            raise ValueError("trailing bytes in FILTERS")
+        return mtype, (start, entries)
     if mtype is MsgType.GETPROOF:
         if len(body) != 32:
             raise ValueError("bad GETPROOF")
@@ -791,12 +908,16 @@ class FrameReader:
     never evicted while ``overdue`` is not) from one that has gone silent.
     """
 
-    def __init__(self, reader: asyncio.StreamReader):
+    def __init__(self, reader: asyncio.StreamReader, clock=time.monotonic):
         self._reader = reader
         self._buf = bytearray()
         self._need: int | None = None  # body length once the prefix parsed
         self._progress = False
         self._started: float | None = None  # first byte of current frame
+        #: Injectable monotonic clock (tests drive the delivery-budget
+        #: math without real sleeps — the round-9 liveness deflake; the
+        #: governor's TokenBucket set the pattern).
+        self._clock = clock
 
     def progressed(self) -> bool:
         """True if bytes arrived mid-frame since the last completed frame
@@ -812,7 +933,7 @@ class FrameReader:
         if self._started is None:
             return False
         budget = grace + (self._need or _LEN.size) / MIN_FRAME_RATE
-        return time.monotonic() - self._started > budget
+        return self._clock() - self._started > budget
 
     async def read(self) -> bytes:
         while True:
@@ -822,7 +943,7 @@ class FrameReader:
                 if not chunk:
                     raise asyncio.IncompleteReadError(bytes(self._buf), target)
                 if self._started is None:
-                    self._started = time.monotonic()
+                    self._started = self._clock()
                 self._progress = True
                 self._buf += chunk
             if self._need is None:

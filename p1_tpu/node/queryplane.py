@@ -1,0 +1,682 @@
+"""Read-only replica workers: the query serving plane (`p1 serve`).
+
+The scaling problem (ROADMAP open item 1): every headers/filters/proof
+query a node answers runs on its single consensus asyncio thread — the
+same thread that validates blocks, settles reorgs, and feeds the miner.
+Query fan-out therefore could not scale past one core, and a heavy read
+load was indistinguishable from an attack.  This module moves the READ
+side of the protocol into separate processes that share nothing with
+the consensus loop but the append-only store file itself:
+
+- **No writer flock, ever.**  A replica opens the store read-only and
+  never calls ``ChainStore.acquire`` — the live node (or ``p1 fsck`` /
+  ``p1 compact``) keeps exclusive writership, and any number of
+  replicas attach concurrently.  The append-only discipline is what
+  makes this safe: a record, once checksum-valid at offset X, never
+  changes (heals/compactions REPLACE the inode, which the replica
+  detects by ``st_ino`` and handles by a clean rescan).
+
+- **mmap + incremental tail scan.**  The file is memory-mapped; the v3
+  checksum framing (chain/store.py) is walked once at attach and then
+  only over the newly appended tail on each ``refresh()`` — headers
+  are served as raw 80-byte mmap slices (no object parse:
+  ``protocol.encode_headers_raw``), block bodies as raw record slices,
+  and the per-record work is three SHA-256d digests per transaction at
+  attach time (txid index) plus fork choice over header fields.  A
+  torn tail (the writer's in-flight record) simply fails its CRC and
+  is retried on the next refresh.
+
+- **The same serving caches as the node.**  Proofs go through a
+  ``ProofCache`` (chain/proof.py — whole-block merkle amortization +
+  serialized-payload memoization + 4-byte tip patches) and filters
+  through a ``FilterIndex`` (chain/filters.py), so a replica's steady-
+  state QPS is dict lookups and byte splices, measured in
+  benchmarks/query_plane.py.
+
+- **Governor admission.**  Every session gets a per-peer query budget
+  (node/governor.py ``ResourceGovernor``) charged at the dispatch
+  door, same classes and same economics as the full node — a replica
+  is cheap, not free.
+
+``p1 serve --workers N`` runs N such processes against one store on one
+port via ``SO_REUSEPORT``, so host query throughput scales with cores
+while the consensus node only mines and validates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import mmap
+import os
+import secrets
+import struct
+import time
+from pathlib import Path
+
+from p1_tpu.chain.filters import FilterIndex
+from p1_tpu.chain.proof import ProofCache, build_block_proofs
+from p1_tpu.chain.store import MAGIC, V2_MAGIC, ChainStore
+from p1_tpu.core.block import Block
+from p1_tpu.core.genesis import make_genesis
+from p1_tpu.core.hashutil import sha256d
+from p1_tpu.core.header import HEADER_SIZE
+from p1_tpu.node import protocol
+from p1_tpu.node.governor import CLASS_QUERIES, ResourceGovernor
+from p1_tpu.node.protocol import Hello, MsgType
+
+log = logging.getLogger("p1_tpu.queryplane")
+
+_LEN = struct.Struct(">I")
+_CRC_SIZE = 4
+
+#: Serving caps, mirroring the node's (one query must not pin the loop).
+HEADERS_BATCH = 2000
+FILTER_BATCH = 1000
+SYNC_BATCH = 500
+SYNC_BYTES = 8 << 20
+
+#: A replica holds no per-peer consensus state, so it can afford far
+#: more concurrent sessions than a node's MAX_PEERS — this is the knob
+#: that lets thousands of light clients fan out across a few workers.
+MAX_SESSIONS = 2048
+
+#: How long a session may sit silent before the replica closes it.
+#: No PING probing here — reconnecting to a replica is cheap, and the
+#: simple read deadline keeps dead sockets from pinning session slots.
+IDLE_TIMEOUT_S = 120.0
+
+
+class _Entry:
+    """One indexed record: everything fork choice and serving need,
+    without retaining a single parsed object."""
+
+    __slots__ = ("height", "work", "prev", "off", "length")
+
+    def __init__(self, height: int, work: int, prev: bytes, off: int, length: int):
+        self.height = height
+        self.work = work
+        self.prev = prev
+        self.off = off  # payload offset in the store file (0 = genesis, no record)
+        self.length = length
+
+
+class ReplicaView:
+    """A flock-free, incrementally refreshed read view of a chain store.
+
+    Correctness model: the store is the node's own append-only log of
+    blocks it fully validated before persisting, protected per record by
+    the v3 CRC (chain/store.py) — the replica therefore TRUSTS record
+    contents the same way the node's own ``trusted=True`` resume does,
+    and spends its cycles on indexing, not revalidation.  Clients
+    verify what they receive anyway (headers by PoW replay, proofs by
+    merkle recombination — the protocol is evidence-based end to end).
+    """
+
+    def __init__(self, path: str | os.PathLike, difficulty: int, retarget=None):
+        self.path = Path(path)
+        self.difficulty = difficulty
+        self.retarget = retarget
+        self.genesis = make_genesis(difficulty, retarget)
+        self.proof_cache = ProofCache()
+        self.filter_index = FilterIndex()
+        self._fd: int | None = None
+        self._mm: mmap.mmap | None = None
+        self._ino: int | None = None
+        self._mapped = 0  # bytes currently mapped
+        self._off = 0  # next unscanned byte offset
+        self.records = 0
+        self.rescans = 0  # full rescans (inode change / truncation)
+        self.refreshes = 0
+        self._entries: dict[bytes, _Entry] = {}
+        self._pending: dict[bytes, list[tuple[bytes, bytes, int, int]]] = {}
+        self._tx_index: dict[bytes, bytes | list[bytes]] = {}
+        self._main: list[bytes] = []
+        self._tip: bytes = b""
+        self._reset_index()
+        self.refresh()
+
+    # -- attach / rescan ---------------------------------------------------
+
+    def _reset_index(self) -> None:
+        ghash = self.genesis.block_hash()
+        self._entries = {
+            ghash: _Entry(0, 1 << self.difficulty, b"", 0, 0)
+        }
+        self._pending = {}
+        self._tx_index = {
+            tx.txid(): ghash for tx in self.genesis.txs
+        }
+        self._main = [ghash]
+        self._tip = ghash
+        self._off = 0
+        self.records = 0
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        self._ino = None
+        self._mapped = 0
+
+    def refresh(self) -> int:
+        """Bring the view up to date with the file; returns how many new
+        records were indexed.  NEVER takes any lock — reading races the
+        writer only at the torn tail, which the per-record CRC resolves
+        (an incomplete record fails its checksum and is retried on the
+        next refresh, after the writer's flush completes)."""
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            # Store not created yet (node about to boot): empty view.
+            self.close()
+            self._reset_index()
+            return 0
+        if self._ino is not None and (
+            st.st_ino != self._ino or st.st_size < self._mapped
+        ):
+            # The inode was replaced (heal rebuild, `p1 compact`) or the
+            # file shrank (torn-tail truncation at writer acquire):
+            # every cached offset is void — rescan from scratch.  Caches
+            # keyed by block hash (proofs, filters) stay valid: a hash
+            # names the same bytes in any inode.
+            self.close()
+            self._reset_index()
+            self.rescans += 1
+        if self._fd is None:
+            self._fd = os.open(self.path, os.O_RDONLY)
+            self._ino = os.fstat(self._fd).st_ino
+        size = os.fstat(self._fd).st_size
+        if size < len(MAGIC):
+            return 0
+        if size > self._mapped:
+            if self._mm is not None:
+                self._mm.close()
+            self._mm = mmap.mmap(self._fd, size, prot=mmap.PROT_READ)
+            self._mapped = size
+        mm = self._mm
+        if self._off == 0:
+            head = bytes(mm[: len(MAGIC)])
+            if head == V2_MAGIC:
+                raise ValueError(
+                    f"{self.path}: v2 chain store — upgrade with `p1 fsck`"
+                    " or `p1 compact` before serving replicas"
+                )
+            if head != MAGIC:
+                raise ValueError(f"{self.path}: not a chain store")
+            self._off = len(MAGIC)
+        new = 0
+        old_tip = self._tip
+        while self._off < self._mapped:
+            end = ChainStore._v3_record_at(mm, self._off)
+            if end is None:
+                # Torn tail (writer mid-append) or trailing damage the
+                # writer will heal: stop here, retry next refresh.
+                break
+            p_off = self._off + _LEN.size
+            p_len = end - p_off - _CRC_SIZE
+            self._index_record(p_off, p_len)
+            self._off = end
+            new += 1
+        if new:
+            self.records += new
+            if self._tip != old_tip or len(self._main) - 1 != self._entries[self._tip].height:
+                self._rebuild_main()
+        self.refreshes += 1
+        return new
+
+    def _index_record(self, off: int, length: int) -> None:
+        """Index one checksum-valid record at payload ``off``: header
+        digest, fork choice, txid index — no object construction."""
+        mm = self._mm
+        hdr = bytes(mm[off : off + HEADER_SIZE])
+        if len(hdr) < HEADER_SIZE:
+            return
+        bhash = sha256d(hdr)
+        if bhash in self._entries:
+            return  # duplicate record (e.g. a snapshot's genesis row)
+        prev = hdr[4:36]  # BlockHeader layout: u32 version + 32s prev_hash
+        parent = self._entries.get(prev)
+        if parent is None:
+            # Out-of-line record (shouldn't happen in a node's log, which
+            # appends in connect order — but a foreign/hand-built store
+            # may interleave): park until the parent shows up.
+            self._pending.setdefault(prev, []).append((bhash, hdr, off, length))
+            return
+        self._connect(bhash, hdr, off, length, parent)
+        # Drain anything that was waiting on this block, recursively.
+        queue = [bhash]
+        while queue:
+            for child, chdr, coff, clen in self._pending.pop(queue.pop(), []):
+                self._connect(
+                    child, chdr, coff, clen, self._entries[chdr[4:36]]
+                )
+                queue.append(child)
+
+    def _connect(self, bhash, hdr, off, length, parent) -> None:
+        diff = _header_difficulty(hdr)
+        entry = _Entry(
+            parent.height + 1, parent.work + (1 << diff), hdr[4:36], off, length
+        )
+        self._entries[bhash] = entry
+        tip = self._entries[self._tip]
+        if entry.work > tip.work or (
+            entry.work == tip.work and bhash < self._tip
+        ):
+            self._tip = bhash
+        self._index_txids(bhash, off, length)
+
+    def _index_txids(self, bhash: bytes, off: int, length: int) -> None:
+        """txid -> block hash entries for one record, hashing raw tx
+        slices straight off the map (no Transaction objects)."""
+        mm = self._mm
+        end = off + length
+        pos = off + HEADER_SIZE
+        if pos + 4 > end:
+            return
+        (ntx,) = _LEN.unpack_from(mm, pos)
+        pos += 4
+        for _ in range(ntx):
+            if pos + 4 > end:
+                return  # malformed (CRC-valid but not a block): serve raw only
+            (tlen,) = _LEN.unpack_from(mm, pos)
+            pos += 4
+            if pos + tlen > end:
+                return
+            txid = sha256d(bytes(mm[pos : pos + tlen]))
+            pos += tlen
+            have = self._tx_index.get(txid)
+            if have is None:
+                self._tx_index[txid] = bhash
+            elif isinstance(have, bytes):
+                if have != bhash:
+                    self._tx_index[txid] = [have, bhash]
+            elif bhash not in have:
+                have.append(bhash)
+
+    def _rebuild_main(self) -> None:
+        """Re-derive the height -> hash list for the current tip.  Walks
+        back only until it meets the old main chain (O(new blocks + fork
+        depth)), the incremental trick Chain's reorg paths use."""
+        suffix: list[bytes] = []
+        h = self._tip
+        while True:
+            entry = self._entries[h]
+            if (
+                entry.height < len(self._main)
+                and self._main[entry.height] == h
+            ):
+                break
+            suffix.append(h)
+            if entry.height == 0:
+                break
+            h = entry.prev
+        keep = self._entries[suffix[-1]].height if suffix else len(self._main)
+        del self._main[keep:]
+        self._main.extend(reversed(suffix))
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def tip_height(self) -> int:
+        return len(self._main) - 1
+
+    def _is_main(self, bhash: bytes) -> bool:
+        entry = self._entries.get(bhash)
+        return (
+            entry is not None
+            and entry.height < len(self._main)
+            and self._main[entry.height] == bhash
+        )
+
+    def raw_record(self, bhash: bytes) -> bytes | None:
+        entry = self._entries.get(bhash)
+        if entry is None or entry.off == 0:
+            if entry is not None and entry.height == 0:
+                return self.genesis.serialize()
+            return None
+        return bytes(self._mm[entry.off : entry.off + entry.length])
+
+    def read_block(self, bhash: bytes) -> Block | None:
+        raw = self.raw_record(bhash)
+        if raw is None:
+            return None
+        return Block.deserialize(raw)
+
+    def raw_header(self, height: int) -> bytes | None:
+        if not 0 <= height < len(self._main):
+            return None
+        entry = self._entries[self._main[height]]
+        if entry.off == 0:
+            return self.genesis.header.serialize()
+        return bytes(self._mm[entry.off : entry.off + HEADER_SIZE])
+
+    def _start_after(self, locator: list[bytes]) -> int:
+        for h in locator:
+            entry = self._entries.get(h)
+            if entry is not None and self._is_main(h):
+                return entry.height + 1
+        return 0
+
+    def headers_after(self, locator: list[bytes], limit: int = HEADERS_BATCH):
+        start = self._start_after(locator)
+        end = min(start + limit, len(self._main))
+        return [self.raw_header(h) for h in range(start, end)]
+
+    def blocks_after(
+        self,
+        locator: list[bytes],
+        limit: int = SYNC_BATCH,
+        max_bytes: int = SYNC_BYTES,
+    ):
+        start = self._start_after(locator)
+        end = min(start + limit, len(self._main))
+        out, total = [], 0
+        for h in range(start, end):
+            raw = self.raw_record(self._main[h])
+            total += len(raw) + 4
+            if out and total > max_bytes:
+                break
+            out.append(raw)
+        return out
+
+    def filters_range(self, start: int, count: int):
+        """(block hash, filter) pairs for main heights [start, start+count)."""
+        out = []
+        for h in range(start, min(start + count, len(self._main))):
+            bhash = self._main[h]
+            fbytes = self.filter_index.get_or_build(
+                bhash, lambda bh: self.read_block(bh)
+            )
+            out.append((bhash, fbytes))
+        return out
+
+    def proof_payload(self, txid: bytes) -> bytes:
+        """The wire PROOF reply for ``txid`` at this view's tip — same
+        cache economics as the node's ``_proof_payload``."""
+        have = self._tx_index.get(txid)
+        if have is None:
+            return protocol.encode_proof(None)
+        candidates = [have] if isinstance(have, bytes) else have
+        bhash = next((b for b in candidates if self._is_main(b)), None)
+        if bhash is None:
+            return protocol.encode_proof(None)
+        entry = self.proof_cache.get(bhash, txid)
+        if entry is None:
+            block = self.read_block(bhash)
+            if block is None:
+                return protocol.encode_proof(None)
+            height = self._entries[bhash].height
+            txids = [tx.txid() for tx in block.txs]
+            for tid, proof in build_block_proofs(block, height, txids).items():
+                e = self.proof_cache.add(bhash, tid, proof)
+                if tid == txid:
+                    entry = e
+        if entry.payload is None:
+            self.proof_cache.note_payload(
+                entry, protocol.encode_proof(entry.proof)
+            )
+        return protocol.patch_proof_tip(entry.payload, self.tip_height)
+
+
+def _header_difficulty(hdr: bytes) -> int:
+    """The u32 difficulty field straight out of an 80-byte header record
+    (core/header.py ``>I32s32sIII``: bytes 72..76) — the one header
+    field fork choice needs per record, read without an object parse."""
+    return struct.unpack_from(">I", hdr, 72)[0]
+
+
+class QueryPlaneServer:
+    """One replica worker: an asyncio server speaking the READ subset of
+    the wire protocol over a ``ReplicaView``, behind governor admission.
+
+    Served: HELLO, GETHEADERS, GETFILTERS, GETPROOF, GETBLOCKS,
+    GETSTATUS, PING.  Everything write-shaped (BLOCK/TX pushes) or
+    ledger-shaped (GETACCOUNT, GETFEES, GETMEMPOOL — they need tip
+    state only the consensus node holds) is ignored; a client that
+    needs those talks to the node.
+    """
+
+    def __init__(
+        self,
+        view: ReplicaView,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        refresh_interval_s: float = 0.25,
+        max_sessions: int = MAX_SESSIONS,
+        idle_timeout_s: float = IDLE_TIMEOUT_S,
+        reuse_port: bool = False,
+        governor: ResourceGovernor | None = None,
+    ):
+        self.view = view
+        self.host = host
+        self._want_port = port
+        self.port: int | None = None
+        self.refresh_interval_s = refresh_interval_s
+        self.max_sessions = max_sessions
+        self.idle_timeout_s = idle_timeout_s
+        self.reuse_port = reuse_port
+        self.governor = governor or ResourceGovernor()
+        self.instance_nonce = secrets.randbits(64) | 1
+        self._server: asyncio.Server | None = None
+        self._sessions: set[asyncio.Task] = set()
+        self._refresh_task: asyncio.Task | None = None
+        self._running = False
+        self.started_at = time.monotonic()
+        self.queries_served = collections.Counter()
+        self.admission_dropped = 0
+        self.sessions_refused = 0
+        self.sessions_total = 0
+        #: Rolling per-second query counts for the QPS figure (last 60 s).
+        self._qps_window: collections.deque[tuple[int, int]] = (
+            collections.deque(maxlen=60)
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        self.started_at = time.monotonic()
+        self._server = await asyncio.start_server(
+            self._on_client,
+            self.host,
+            self._want_port,
+            reuse_port=self.reuse_port or None,
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._refresh_task = asyncio.create_task(self._refresh_loop())
+        log.info(
+            "replica serving %s on %s:%d (tip height %d)",
+            self.view.path,
+            self.host,
+            self.port,
+            self.view.tip_height,
+        )
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._refresh_task is not None:
+            self._refresh_task.cancel()
+            await asyncio.gather(self._refresh_task, return_exceptions=True)
+            self._refresh_task = None
+        for task in list(self._sessions):
+            task.cancel()
+        await asyncio.gather(*self._sessions, return_exceptions=True)
+        self._sessions.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.view.close()
+
+    async def _refresh_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.refresh_interval_s)
+            try:
+                self.view.refresh()
+            except (OSError, ValueError) as e:
+                # A transient read fault or a mid-run store replacement
+                # with something unreadable: keep serving the view we
+                # hold and keep retrying — a replica that dies of one
+                # bad stat() defeats its purpose.
+                log.warning("replica refresh failed: %s", e)
+
+    # -- sessions ----------------------------------------------------------
+
+    def _count_query(self, mtype) -> None:
+        self.queries_served[mtype.name] += 1
+        now = int(time.monotonic())
+        if self._qps_window and self._qps_window[-1][0] == now:
+            sec, n = self._qps_window[-1]
+            self._qps_window[-1] = (sec, n + 1)
+        else:
+            self._qps_window.append((now, 1))
+
+    def qps(self) -> float:
+        """Queries/s over the rolling window (excludes the current
+        second only if it is the lone sample)."""
+        if not self._qps_window:
+            return 0.0
+        span = max(1, self._qps_window[-1][0] - self._qps_window[0][0] + 1)
+        return sum(n for _, n in self._qps_window) / span
+
+    def status(self) -> dict:
+        v = self.view
+        return {
+            "role": "replica",
+            "store": str(v.path),
+            "height": v.tip_height,
+            "tip": v._main[-1].hex() if v._main else "",
+            "records": v.records,
+            "refreshes": v.refreshes,
+            "rescans": v.rescans,
+            "sessions": len(self._sessions),
+            "sessions_total": self.sessions_total,
+            "sessions_refused": self.sessions_refused,
+            "queries": {
+                "served": dict(self.queries_served),
+                "total": sum(self.queries_served.values()),
+                "qps": round(self.qps(), 1),
+                "admission_dropped": self.admission_dropped,
+                "proof_cache": v.proof_cache.snapshot(),
+                "filter_cache": v.filter_index.snapshot(),
+            },
+        }
+
+    def _hello(self) -> bytes:
+        return protocol.encode_hello(
+            Hello(
+                self.view.genesis.block_hash(),
+                self.view.tip_height,
+                self.port or 0,
+                self.instance_nonce,
+            )
+        )
+
+    async def _on_client(self, reader, writer) -> None:
+        if len(self._sessions) >= self.max_sessions:
+            self.sessions_refused += 1
+            writer.close()
+            return
+        task = asyncio.current_task()
+        self._sessions.add(task)
+        self.sessions_total += 1
+        budget = self.governor.budget()
+        try:
+            await protocol.write_frame(writer, self._hello())
+            payload = await asyncio.wait_for(
+                protocol.read_frame(reader), timeout=10.0
+            )
+            mtype, hello = protocol.decode(payload)
+            if mtype is not MsgType.HELLO:
+                raise protocol.ProtocolError("expected HELLO")
+            if hello.genesis_hash != self.view.genesis.block_hash():
+                raise protocol.ChainMismatch("genesis mismatch")
+            while self._running:
+                payload = await asyncio.wait_for(
+                    protocol.read_frame(reader), timeout=self.idle_timeout_s
+                )
+                mtype, body = protocol.decode(payload)
+                if mtype in _QUERY_TYPES and not self.governor.admit(
+                    budget, CLASS_QUERIES
+                ):
+                    self.admission_dropped += 1
+                    continue
+                reply = self._answer(mtype, body)
+                if reply is not None:
+                    self._count_query(mtype)
+                    await protocol.write_frame(writer, reply)
+        except (
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+            TimeoutError,
+            ConnectionError,
+            ValueError,
+            OSError,
+        ):
+            pass  # replica sessions end quietly; clients just reconnect
+        finally:
+            self._sessions.discard(task)
+            writer.close()
+
+    def _answer(self, mtype, body) -> bytes | None:
+        v = self.view
+        if mtype is MsgType.GETHEADERS:
+            return protocol.encode_headers_raw(
+                v.headers_after(body, HEADERS_BATCH)
+            )
+        if mtype is MsgType.GETFILTERS:
+            start, count = body
+            entries = v.filters_range(start, min(count, FILTER_BATCH))
+            return protocol.encode_filters(start, entries)
+        if mtype is MsgType.GETPROOF:
+            return v.proof_payload(body)
+        if mtype is MsgType.GETBLOCKS:
+            return protocol.encode_blocks_raw(
+                v.blocks_after(body, SYNC_BATCH, SYNC_BYTES)
+            )
+        if mtype is MsgType.GETSTATUS:
+            return protocol.encode_status(self.status())
+        if mtype is MsgType.PING:
+            return protocol.encode_pong(body)
+        return None  # pushes / ledger queries: not this plane's job
+
+
+_QUERY_TYPES = frozenset(
+    {
+        MsgType.GETHEADERS,
+        MsgType.GETFILTERS,
+        MsgType.GETPROOF,
+        MsgType.GETBLOCKS,
+        MsgType.GETSTATUS,
+    }
+)
+
+
+async def serve_replica(
+    store_path,
+    difficulty: int,
+    *,
+    retarget=None,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    refresh_interval_s: float = 0.25,
+    reuse_port: bool = False,
+) -> QueryPlaneServer:
+    """Attach a ``ReplicaView`` and start one worker (the `p1 serve`
+    core, also what tests drive directly)."""
+    view = ReplicaView(store_path, difficulty, retarget)
+    server = QueryPlaneServer(
+        view,
+        host=host,
+        port=port,
+        refresh_interval_s=refresh_interval_s,
+        reuse_port=reuse_port,
+    )
+    await server.start()
+    return server

@@ -686,3 +686,100 @@ class TestFsck:
         proc = self._fsck("--help")
         assert proc.returncode == 0
         assert "salvage" in proc.stdout and "--store" in proc.stdout
+
+
+class TestServe:
+    """`p1 serve` (round 9): a read-only replica worker process over a
+    chain store — help smoke plus one subprocess e2e proving the JSON
+    ready line, real query service, and the --deadline exit."""
+
+    def test_help_smoke(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "serve", "--help"],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0
+        assert "--store" in proc.stdout and "--workers" in proc.stdout
+
+    def test_worker_count_validation(self, tmp_path):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "p1_tpu",
+                "serve",
+                "--store",
+                str(tmp_path / "x.dat"),
+                "--port",
+                "0",
+                "--workers",
+                "2",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 2
+        assert "explicit --port" in proc.stderr
+
+    def test_serve_e2e_queries_then_deadline_exit(self, tmp_path):
+        import asyncio
+
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.node.client import get_headers, get_status
+        from p1_tpu.node.testing import make_blocks
+
+        store = tmp_path / "chain.dat"
+        blocks = make_blocks(5, difficulty=12)
+        s = ChainStore(store)
+        try:
+            for block in blocks[1:]:
+                s.append(block)
+        finally:
+            s.close()
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "p1_tpu",
+                "serve",
+                "--store",
+                str(store),
+                "--difficulty",
+                "12",
+                "--port",
+                "0",
+                "--deadline",
+                "30",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            cwd="/root/repo",
+        )
+        try:
+            ready = json.loads(proc.stdout.readline())
+            assert ready["config"] == "serve" and ready["height"] == 5
+
+            async def _query():
+                headers = await get_headers(
+                    "127.0.0.1", ready["port"], 12
+                )
+                status = await get_status(
+                    "127.0.0.1", ready["port"], 12
+                )
+                return headers, status
+
+            headers, status = asyncio.run(_query())
+            assert len(headers) == 6  # genesis + 5
+            assert [h.block_hash() for h in headers] == [
+                b.block_hash() for b in blocks
+            ]
+            assert status["role"] == "replica" and status["height"] == 5
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)

@@ -48,7 +48,9 @@ def test_walk_found_the_tree():
         "p1_tpu.core._ed25519",
         "p1_tpu.core.sigcache",
         "p1_tpu.chain.replay",
+        "p1_tpu.chain.filters",
         "p1_tpu.node.node",
+        "p1_tpu.node.queryplane",
         "p1_tpu.hashx.pallas_backend",
     ):
         assert expected in names
