@@ -244,6 +244,32 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Deterministic network simulator (round 10): node-seconds of
+    # simulated mesh per wall second on a quick 100-node partition-heal
+    # (benchmarks/netsim_scale.py scales linearly enough that the small
+    # run tracks the pinned 200-node figure within the guard band) —
+    # reported against the ONE recorded constant (perf_record.py
+    # RECORDED_SIM_RATE), same convention as above.
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_SIM_RATE,
+        SIM_DEGRADED_FRACTION,
+    )
+
+    try:
+        from benchmarks.netsim_scale import bench_sim
+
+        sim = bench_sim(nodes=100, seed=0)
+        extra["sim_nodes_per_sec"] = sim["sim_nodes_per_sec"]
+        extra["sim_events_per_sec"] = sim["events_per_wall_s"]
+        extra["sim_ok"] = sim["ok"]
+        extra["sim_vs_recorded"] = round(
+            sim["sim_nodes_per_sec"] / RECORDED_SIM_RATE, 2
+        )
+        if sim["sim_nodes_per_sec"] < SIM_DEGRADED_FRACTION * RECORDED_SIM_RATE:
+            extra["sim_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
 
     print(

@@ -1,0 +1,63 @@
+"""Simulator scale benchmark: nodes x virtual-seconds per wall-second.
+
+The question this answers: how much mesh can one host simulate, and how
+fast?  The metric is ``sim_nodes_per_sec`` = nodes x virtual_seconds /
+wall_seconds — node-seconds of simulated network per second of real
+time — measured on the partition-heal scenario (the corpus flagship:
+mesh formation, gossip, a 60/40 cut, divergent mining, mass reorg on
+heal).  The scale table (``--table``) feeds docs/PERF.md; the single
+default run feeds ``bench.py``'s ``sim_nodes_per_sec`` line against the
+pinned ``RECORDED_SIM_RATE`` (p1_tpu/hashx/perf_record.py).
+
+Real sockets on this 1-vCPU host topped out around 7 nodes at 1x real
+time, i.e. ~7 node-seconds/second; the simulator's figure is the
+multiple of that wall this round removed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def bench_sim(nodes: int = 200, seed: int = 0) -> dict:
+    """One partition-heal run; returns the rate figures + the report."""
+    from p1_tpu.node.scenarios import partition_heal
+
+    report = partition_heal(nodes=nodes, seed=seed)
+    rate = nodes * report["virtual_s"] / max(report["wall_s"], 1e-9)
+    return {
+        "nodes": nodes,
+        "ok": report["ok"],
+        "virtual_s": report["virtual_s"],
+        "wall_s": report["wall_s"],
+        "events": report["events"],
+        "events_per_wall_s": round(report["events"] / max(report["wall_s"], 1e-9)),
+        "sim_nodes_per_sec": round(rate, 1),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=200)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--table",
+        action="store_true",
+        help="run the docs/PERF.md scale ladder (50/200/1000) instead "
+        "of one size",
+    )
+    args = parser.parse_args()
+    if args.table:
+        for n in (50, 200, 1000):
+            print(json.dumps(bench_sim(n, args.seed)))
+    else:
+        print(json.dumps(bench_sim(args.nodes, args.seed)))
+
+
+if __name__ == "__main__":
+    main()

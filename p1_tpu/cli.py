@@ -565,6 +565,47 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_retarget(p)
 
+    p = sub.add_parser(
+        "sim",
+        help="deterministic network-simulator scenarios (1000-node "
+        "meshes in virtual time, one JSON report line)",
+    )
+    p.add_argument(
+        "scenario",
+        nargs="?",
+        default="partition-heal",
+        help="scenario name (see --list); default partition-heal",
+    )
+    p.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    p.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="determinism seed: same seed => byte-identical event trace "
+        "(the report's trace_digest)",
+    )
+    p.add_argument(
+        "--nodes",
+        type=int,
+        default=None,
+        help="mesh size (scenarios with a fixed shape ignore it)",
+    )
+    p.add_argument("--difficulty", type=int, default=8)
+    p.add_argument(
+        "--joiners", type=int, default=None, help="flash-crowd joiner count"
+    )
+    p.add_argument(
+        "--cycles", type=int, default=None, help="churn stop/restart waves"
+    )
+    p.add_argument(
+        "--attackers", type=int, default=None, help="eclipse attacker hosts"
+    )
+    p.add_argument(
+        "--region-nodes", type=int, default=None, help="wan nodes per region"
+    )
+
     sub.add_parser("bench", help="headline benchmark (one JSON line)")
     return parser
 
@@ -1448,6 +1489,43 @@ def cmd_serve(args) -> int:
             proc.join(timeout=5)
 
 
+def cmd_sim(args) -> int:
+    """Run one simulator scenario (node/scenarios.py) and print its
+    report as a single JSON line — exit 0 iff the scenario's invariant
+    held.  Pure virtual time: the 1000-node default runs in tier-1
+    minutes of wall clock on one host."""
+    import inspect
+
+    from p1_tpu.node.scenarios import SCENARIOS, run_scenario
+
+    if args.list:
+        for name, fn in sorted(SCENARIOS.items()):
+            doc = (inspect.getdoc(fn) or "").split(".")[0].replace("\n", " ")
+            print(f"{name}: {doc}")
+        return 0
+    if args.scenario not in SCENARIOS:
+        raise SystemExit(
+            f"unknown scenario {args.scenario!r}; "
+            f"have: {', '.join(sorted(SCENARIOS))} (p1 sim --list)"
+        )
+    accepted = inspect.signature(SCENARIOS[args.scenario]).parameters
+    flag_map = {
+        "nodes": args.nodes,
+        "joiners": args.joiners,
+        "cycles": args.cycles,
+        "attackers": args.attackers,
+        "region_nodes": args.region_nodes,
+    }
+    kwargs = {
+        k: v for k, v in flag_map.items() if v is not None and k in accepted
+    }
+    report = run_scenario(
+        args.scenario, seed=args.seed, difficulty=args.difficulty, **kwargs
+    )
+    print(json.dumps(report))
+    return 0 if report.get("ok") else 1
+
+
 def cmd_net(args) -> int:
     from p1_tpu.node.netharness import run_net
 
@@ -1494,6 +1572,7 @@ def main(argv=None) -> int:
         "serve": cmd_serve,
         "pod": cmd_pod,
         "net": cmd_net,
+        "sim": cmd_sim,
         "bench": cmd_bench,
     }[args.cmd]
     return handler(args)

@@ -109,6 +109,14 @@ class NodeConfig:
     #: per window instead.  Worker count NEVER changes validation
     #: outcomes, only where the verify cost is paid.
     verify_workers: int = 0
+    #: Deterministic identity/jitter seed.  None (production) draws the
+    #: HELLO instance nonce and default miner id from the OS and leaves
+    #: supervision backoff jitter on an unseeded RNG; a seed makes all
+    #: of it a pure function of the seed — what lets the network
+    #: simulator (node/netsim.py) replay a thousand-node run
+    #: byte-for-byte.  Never affects consensus: identity and jitter
+    #: only.
+    rng_seed: int | None = None
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

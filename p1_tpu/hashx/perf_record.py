@@ -80,3 +80,22 @@ RECORDED_QUERY_QPS = 980_000.0
 #: Same-session fraction below which the query-plane measurement is
 #: flagged degraded in the bench JSON (host-load tolerance, as above).
 QUERY_DEGRADED_FRACTION = 0.5
+
+#: Deterministic network simulator (round 10): node-seconds of
+#: simulated mesh per wall second — nodes x virtual_s / wall_s on the
+#: 200-node partition-heal scenario (benchmarks/netsim_scale.py;
+#: node/netsim.py).  Measured 2026-08-04 on the 1-vCPU bench host at
+#: low load: ~1,900 (and ~1,050 at the 1000-node acceptance scale —
+#: the rate falls with mesh size as per-event Python cost dominates;
+#: docs/PERF.md "Simulated mesh scale" has the ladder).  Context: real
+#: sockets on this host topped out at ~7 nodes at 1x real time = ~7
+#: node-seconds/second, so the pinned figure is a ~270x scale-up.
+#: ``bench.py`` emits ``sim_vs_recorded`` against this figure — the
+#: denominator-pinning convention of RECORDED_CPU_BASELINE_HPS.
+RECORDED_SIM_RATE = 1_900.0
+
+#: Same-session fraction below which the simulator measurement is
+#: flagged degraded in the bench JSON.  Wider than the host-plane
+#: guards: the figure is pure-Python event-loop throughput, the most
+#: co-tenant-sensitive measurement in the file.
+SIM_DEGRADED_FRACTION = 0.4

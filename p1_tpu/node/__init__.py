@@ -1,5 +1,14 @@
 from p1_tpu.node.client import send_tx
 from p1_tpu.node.node import Node, NodeMetrics
 from p1_tpu.node.protocol import Hello, MsgType
+from p1_tpu.node.transport import SocketTransport, Transport
 
-__all__ = ["Node", "NodeMetrics", "Hello", "MsgType", "send_tx"]
+__all__ = [
+    "Node",
+    "NodeMetrics",
+    "Hello",
+    "MsgType",
+    "send_tx",
+    "SocketTransport",
+    "Transport",
+]

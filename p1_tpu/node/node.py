@@ -24,7 +24,7 @@ import collections
 import dataclasses
 import json
 import logging
-import time
+import random
 from pathlib import Path
 
 from p1_tpu.chain import AddResult, AddStatus, Chain, ChainStore
@@ -48,6 +48,7 @@ from p1_tpu.node.governor import (
 )
 from p1_tpu.node.protocol import Hello, MsgType
 from p1_tpu.node.supervision import RequestSupervisor
+from p1_tpu.node.transport import SOCKET_TRANSPORT, Transport
 
 log = logging.getLogger("p1_tpu.node")
 
@@ -351,19 +352,42 @@ class Node:
         config: NodeConfig,
         miner: Miner | None = None,
         store: ChainStore | None = None,
+        transport: Transport | None = None,
+        rng: random.Random | None = None,
     ):
-        import secrets
-
         self.config = config
+        #: The network/clock seam (node/transport.py).  Default = real
+        #: sockets + system clocks, byte-identical to the historical
+        #: behavior; the simulator (node/netsim.py) injects in-memory
+        #: links under a virtual clock so a thousand of these run
+        #: deterministically in one process.
+        self.transport = transport if transport is not None else SOCKET_TRANSPORT
+        self.clock = self.transport.clock
+        #: Node-local RNG.  None (production) draws identity from the
+        #: OS; a seeded instance (config.rng_seed, or injected directly)
+        #: makes the node's identity AND its supervision jitter a pure
+        #: function of the seed — the reproducibility contract simulated
+        #: runs assert byte-for-byte.
+        if rng is None and config.rng_seed is not None:
+            rng = random.Random(config.rng_seed)
+        self._rng = rng
+        if rng is not None:
+            nonce = rng.getrandbits(64) | 1
+            tag = f"m-{rng.getrandbits(32):08x}"
+        else:
+            import secrets
+
+            nonce = secrets.randbits(64) | 1
+            tag = f"m-{secrets.token_hex(4)}"
         #: Random per-process id carried in HELLO: dialing an address that
         #: answers with OUR nonce means we dialed ourselves (an address
         #: book can legitimately learn our own address from peers) — the
         #: connection is dropped and the address forgotten.
-        self.instance_nonce = secrets.randbits(64) | 1  # never 0 (= client)
+        self.instance_nonce = nonce  # never 0 (= client)
         #: Coinbase identity: distinct per node unless pinned by config, so
         #: concurrent miners assemble *different* candidate blocks and the
         #: fork-choice machinery is actually exercised at network level.
-        self.miner_id = config.miner_id or f"m-{secrets.token_hex(4)}"
+        self.miner_id = config.miner_id or tag
         self.chain = Chain(config.difficulty, retarget=config.retarget_rule())
         #: Verify-once signature cache (core/sigcache.py): ONE instance
         #: shared by this node's mempool admission and its chain's block
@@ -417,6 +441,8 @@ class Node:
             attempts_max=1 << 30,  # borrowed, and retries never exhaust
             backoff_base_s=config.sync_backoff_base_s,
             backoff_max_s=config.sync_backoff_max_s,
+            clock=self.clock.monotonic,
+            rng=self._rng,
         )
         #: Set when a store failure should end the process instead of
         #: degrading (``--store-degraded-exit``); the CLI watches it.
@@ -429,6 +455,7 @@ class Node:
         self.governor = ResourceGovernor(
             watermark_bytes=config.mem_watermark_bytes,
             admission=config.admission_control,
+            clock=self.clock.monotonic,
         )
         if miner is not None:
             self.miner = miner
@@ -453,7 +480,22 @@ class Node:
             attempts_max=config.sync_attempts_max,
             backoff_base_s=config.sync_backoff_base_s,
             backoff_max_s=config.sync_backoff_max_s,
+            clock=self.clock.monotonic,
+            rng=self._rng,
         )
+        #: Set when a batch-synced block (gossip=False — locator sync,
+        #: orphan backfill) moved our tip: the catch-up path never
+        #: re-gossips individual blocks (a 500-block IBD must not push
+        #: 500 frames at every peer), so when the episode QUIESCES the
+        #: node announces its final tip once instead.  Without this, a
+        #: block only propagates as far as nodes that could connect it
+        #: directly: the first peer that needed a backfill becomes a
+        #: gossip dead end, and after a partition heals, mesh regions
+        #: with no direct link across the old cut never converge — found
+        #: by the 1000-node partition-heal simulation (node/netsim.py),
+        #: invisible at the 7-node scale real sockets allowed.  It is
+        #: Bitcoin's post-IBD tip announcement, one flag's worth.
+        self._announce_tip = False
         #: Discovery dials in flight (dedup against the next tick).
         self._dialing: set[tuple[str, int]] = set()
         #: Misbehavior scoring: host -> recent violation times / ban expiry.
@@ -497,7 +539,11 @@ class Node:
         self._mempool_io: asyncio.Task | None = None
         self._server: asyncio.Server | None = None
         self._tasks: list[asyncio.Task] = []
-        self._sessions: set[asyncio.Task] = set()  # live inbound handlers
+        #: Live session/background tasks in CREATION order (a dict used
+        #: as an ordered set: ``stop()`` iterates it, and reproducible
+        #: simulated runs need reproducible teardown order — a plain
+        #: set's id()-based iteration was a trace-divergence source).
+        self._sessions: dict[asyncio.Task, None] = {}
         #: Inbound sessions still inside the HELLO exchange (MAX_HANDSHAKING).
         self._handshaking = 0
         self._abort = None  # threading.Event of the in-flight search
@@ -507,6 +553,9 @@ class Node:
         self.port: int | None = None  # bound listen port (after start)
 
     # -- lifecycle -------------------------------------------------------
+
+    def _untrack_session(self, task) -> None:
+        self._sessions.pop(task, None)
 
     def _addr_book_path(self):
         return (
@@ -729,10 +778,10 @@ class Node:
             # After the chain: admission validates against the ledger.
             self._load_mempool()
         self._running = True
-        self._server = await asyncio.start_server(
+        self._server = await self.transport.listen(
             self._on_inbound, self.config.host, self.config.port
         )
-        self.port = self._server.sockets[0].getsockname()[1]
+        self.port = self._server.port
         log.info("listening on %s:%d", self.config.host, self.port)
         for host, port in self.config.peer_addrs():
             self._tasks.append(asyncio.create_task(self._dial_loop(host, port)))
@@ -906,7 +955,7 @@ class Node:
 
     def _spawn_store_recovery(self) -> None:
         task = asyncio.create_task(self._store_recovery_loop())
-        self._sessions.add(task)
+        self._sessions[task] = None
         task.add_done_callback(self._store_recovery_done)
 
     def _store_recovery_done(self, task: asyncio.Task) -> None:
@@ -915,7 +964,7 @@ class Node:
         once degraded, so nothing else ever respawns the loop.  Surface
         the wreck and restart; the loop's own backoff (first await) keeps
         a persistent crash from spinning."""
-        self._sessions.discard(task)
+        self._sessions.pop(task, None)
         if task.cancelled():
             return
         exc = task.exception()
@@ -1037,13 +1086,13 @@ class Node:
         until = self._banned_until.get(host)
         if until is None:
             return False
-        if time.monotonic() >= until:
+        if self.clock.monotonic() >= until:
             del self._banned_until[host]
             return False
         return True
 
     def _record_violation(self, host: str) -> None:
-        now = time.monotonic()
+        now = self.clock.monotonic()
         window = self._violations.setdefault(host, collections.deque())
         window.append(now)
         while window and now - window[0] > BAN_WINDOW_S:
@@ -1092,17 +1141,17 @@ class Node:
             return
         task = asyncio.current_task()
         assert task is not None
-        self._sessions.add(task)
+        self._sessions[task] = None
         try:
             await self._peer_session(reader, writer, "in", inbound=True)
         finally:
-            self._sessions.discard(task)
+            self._sessions.pop(task, None)
 
     async def _dial_loop(self, host: str, port: int) -> None:
         """Keep one outbound connection to a configured peer alive."""
         while self._running:
             try:
-                reader, writer = await asyncio.open_connection(host, port)
+                reader, writer = await self.transport.connect(host, port)
             except OSError:
                 await asyncio.sleep(RECONNECT_DELAY_S)
                 continue
@@ -1118,7 +1167,7 @@ class Node:
         try:
             try:
                 reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(host, port), timeout=5.0
+                    self.transport.connect(host, port), timeout=5.0
                 )
             except (OSError, asyncio.TimeoutError):
                 # Unreachable: demote/forget (a live peer's ADDR gossip
@@ -1181,10 +1230,10 @@ class Node:
                     continue  # don't court a host we're refusing
                 self._dialing.add(addr)
                 task = asyncio.create_task(self._dial_once(*addr))
-                self._sessions.add(task)
-                task.add_done_callback(self._sessions.discard)
+                self._sessions[task] = None
+                task.add_done_callback(self._untrack_session)
                 started += 1
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if (
                 started == 0
                 and self._peers
@@ -1251,7 +1300,7 @@ class Node:
     ) -> None:
         """Issue a supervised mempool (page) request to ``peer``."""
         peer.mempool_requested = True
-        peer.mempool_inflight_since = time.monotonic()
+        peer.mempool_inflight_since = self.clock.monotonic()
         await self._send_guarded(peer, protocol.encode_getmempool(cursor))
 
     def _pick_sync_peer(self, exclude: _Peer | None = None) -> _Peer | None:
@@ -1283,7 +1332,7 @@ class Node:
         interval = max(0.05, self.config.sync_stall_timeout_s / 4)
         while self._running:
             await asyncio.sleep(interval)
-            now = time.monotonic()
+            now = self.clock.monotonic()
             try:
                 await self._check_block_sync()
                 await self._check_pending_cblocks(now)
@@ -1330,8 +1379,8 @@ class Node:
             return
         delay = sup.record_stall()
         task = asyncio.create_task(self._failover_blocks(staller, delay))
-        self._sessions.add(task)
-        task.add_done_callback(self._sessions.discard)
+        self._sessions[task] = None
+        task.add_done_callback(self._untrack_session)
 
     async def _failover_blocks(self, staller: _Peer, delay: float) -> None:
         """After the jittered backoff, re-issue the locator to the best
@@ -1423,14 +1472,14 @@ class Node:
         if tried:
             self._known_addrs.pop(addr, None)
             self._tried_addrs.pop(addr, None)
-            self._tried_addrs[addr] = time.monotonic()
+            self._tried_addrs[addr] = self.clock.monotonic()
             while len(self._tried_addrs) > MAX_TRIED_ADDRS:
                 self._tried_addrs.popitem(last=False)
             return
         if addr in self._tried_addrs:
             return  # already known-good; gossip cannot demote it
         self._known_addrs.pop(addr, None)
-        self._known_addrs[addr] = time.monotonic()
+        self._known_addrs[addr] = self.clock.monotonic()
         while len(self._known_addrs) > MAX_KNOWN_ADDRS:
             self._known_addrs.popitem(last=False)
 
@@ -1459,7 +1508,7 @@ class Node:
         because two same-host solicited replies would otherwise race for
         a single refill; safe because only our own outbound dials can
         trigger a grant, never an inbound peer."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         bucket = self._addr_budgets.get(host)
         if bucket is None:
             bucket = self._addr_budgets[host] = [ADDR_TOKENS_MAX, now]
@@ -1526,12 +1575,17 @@ class Node:
         # progress itself can resume at the same stream position (a plain
         # read_frame cancelled between length prefix and body would desync
         # the stream and mis-score the peer).
-        frames = protocol.FrameReader(reader)
+        frames = protocol.FrameReader(reader, clock=self.clock.monotonic)
         if inbound:
             self._handshaking += 1
         try:
             if len(self._peers) >= MAX_PEERS:
                 raise _Refused(f"peer limit {MAX_PEERS} reached")
+            # Height at the moment our HELLO leaves: if the chain moves
+            # during the handshake round trip, the advertisement below
+            # is stale and must be corrected (see the tip push after
+            # registration).
+            hello_sent_height = self.chain.height
             await peer.send(self._hello())
             # Deadline on the whole HELLO read: a socket that connects and
             # goes quiet must not hold resources past this window.  A
@@ -1596,6 +1650,21 @@ class Node:
                 if peer.host:
                     self._addr_budget(peer.host, grant=True)
                 await peer.send(protocol.encode_getaddr())
+            if peer.is_node and self.chain.height > hello_sent_height:
+                # The chain moved while the handshake was in flight, so
+                # the height we advertised is stale and the peer may
+                # have (correctly, on its information) decided not to
+                # sync from us.  Push the current tip once: the peer
+                # connects it or orphan-backfills through ordinary
+                # locator sync.  Without this, a block that lands
+                # during the handshake RTT is never advertised on the
+                # new link at all — on a WAN-latency simulated mesh,
+                # every cross-region link formed during one block's
+                # propagation window went dark this way and a region
+                # mined a competing fork (node/netsim.py found it; a
+                # ~100 ms race real-socket tests never hit).
+                payload, _saved = self._block_gossip_payload(self.chain.tip)
+                await self._send_guarded(peer, payload)
             if hello.tip_height > self.chain.height:
                 # Blocks first, mempool after: the BLOCKS handler requests
                 # the pool once our chain reaches the advertised height,
@@ -1776,6 +1845,20 @@ class Node:
             if accepted_any and body:
                 await self._request_blocks(peer)
             else:
+                if self._announce_tip:
+                    # Catch-up quiesced on a new tip: announce it once
+                    # (see _announce_tip).  Receivers that followed the
+                    # same sync dedup it for the cost of one frame;
+                    # receivers beyond a healed cut learn the chain
+                    # exists and pull the rest via orphan backfill.
+                    self._announce_tip = False
+                    payload, saved = self._block_gossip_payload(
+                        self.chain.tip
+                    )
+                    n = await self._gossip(payload, skip=peer)
+                    if saved and n:
+                        self.metrics.cblocks_sent += n
+                        self.metrics.cblock_bytes_saved += saved * n
                 if (
                     self._sync.target is peer
                     and self.chain.height >= peer.hello_height
@@ -2059,9 +2142,10 @@ class Node:
         block exceeds the compact form's counts).  Returns (payload,
         bytes saved per delivered peer) — the CALLER accounts metrics
         once it knows how many peers actually received it."""
-        full = protocol.encode_block(block)
+        now = self.clock.wall()
+        full = protocol.encode_block(block, sent_ts=now)
         if self.config.compact_gossip and 1 < len(block.txs) <= 0xFFFF:
-            compact = protocol.encode_cblock(block)
+            compact = protocol.encode_cblock(block, sent_ts=now)
             return compact, len(full) - len(compact)
         return full, 0
 
@@ -2130,7 +2214,7 @@ class Node:
             self.governor.cblock_slot_drops += 1
             return
         self._pending_cblocks[(bhash, peer)] = _PendingCompact(
-            header, txs, want, cb.sent_ts, asked_at=time.monotonic()
+            header, txs, want, cb.sent_ts, asked_at=self.clock.monotonic()
         )
         while len(self._pending_cblocks) > MAX_PENDING_CBLOCKS:
             self._pending_cblocks.popitem(last=False)
@@ -2206,7 +2290,7 @@ class Node:
                 # only for blocks that actually connected: duplicates and
                 # orphans would skew the figure toward re-delivery noise.
                 self.metrics.propagation_delays_s.append(
-                    max(0.0, time.time() - sent_ts)
+                    max(0.0, self.clock.wall() - sent_ts)
                 )
             self.metrics.blocks_accepted += 1
             # incl. cascaded orphans; a failing disk degrades, never
@@ -2218,6 +2302,10 @@ class Node:
                 # anything LRU-evicted later rebuilds from the store).
                 self.chain.filter_index.add_block(b)
             if res.tip_changed:
+                if not gossip:
+                    # Batch-synced tip movement: queue the one-shot
+                    # announce for when the episode quiesces.
+                    self._announce_tip = True
                 if res.removed:
                     self.metrics.reorgs += 1
                 self.mempool.apply_block_delta(res.removed, res.added)
@@ -2304,7 +2392,7 @@ class Node:
         tip = self.chain.tip
         if self.chain.retarget is None:
             return tip
-        bound = int(time.time()) + ANCHOR_SLACK_S
+        bound = int(self.clock.wall()) + ANCHOR_SLACK_S
         if tip.header.timestamp <= bound:
             return tip
         return self.chain.best_block_within(bound)
@@ -2324,7 +2412,7 @@ class Node:
             # guaranteed connectable against the TIP's ledger, so carry
             # the coinbase alone until the honest branch takes over.
             txs = (coinbase,)
-        ts = max(parent.header.timestamp + 1, int(time.time()))
+        ts = max(parent.header.timestamp + 1, int(self.clock.wall()))
         if self.chain.retarget is not None:
             # The shared clamp: largest consensus-valid stamp (strict
             # increase; forward cap from height 2 — a runaway local
@@ -2370,7 +2458,7 @@ class Node:
                 continue
             candidate = self._assemble()
             self._abort = threading.Event()
-            t0 = time.perf_counter()
+            t0 = self.clock.monotonic()
             sealed = await loop.run_in_executor(
                 None, self.miner.search_nonce, candidate.header, self._abort
             )
@@ -2381,7 +2469,7 @@ class Node:
                 continue  # aborted: tip moved under us, reassemble
             block = Block(sealed, candidate.txs)
             self.metrics.blocks_mined += 1
-            self.metrics.last_block_time_s = time.perf_counter() - t0
+            self.metrics.last_block_time_s = self.clock.monotonic() - t0
             log.info(
                 "mined height=%d nonce=%d txs=%d t=%.3fs hps=%.0f",
                 self.chain.height + 1,
@@ -2420,7 +2508,7 @@ class Node:
             "banned_hosts": sum(
                 1
                 for until in self._banned_until.values()
-                if until > time.monotonic()
+                if until > self.clock.monotonic()
             ),
             "mempool": len(self.mempool),
             "hashes_per_sec": round(self.metrics.hashes_per_sec),

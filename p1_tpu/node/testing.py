@@ -43,6 +43,7 @@ from p1_tpu.core.header import BlockHeader
 from p1_tpu.core.tx import Transaction
 from p1_tpu.node import protocol
 from p1_tpu.node.protocol import Hello, MsgType
+from p1_tpu.node.transport import SOCKET_TRANSPORT
 
 __all__ = ["FaultPlan", "FloodPlan", "GreedyPeer", "HostilePeer", "make_blocks"]
 
@@ -195,6 +196,8 @@ class GreedyPeer:
         blocks: list[Block],
         plan: FloodPlan = FloodPlan(),
         source: str | None = None,
+        transport=None,
+        rng=None,
     ):
         assert blocks, "need at least a genesis block"
         self.blocks = list(blocks)
@@ -203,7 +206,13 @@ class GreedyPeer:
         #: so the victim's per-host scoring lands on the attacker, not on
         #: every other localhost peer — same trick as the byzantine suite.
         self.source = source
-        self.nonce = secrets.randbits(64) | 1
+        #: The transport seam (node/transport.py): real sockets by
+        #: default; a netsim facade runs the same flood over in-memory
+        #: links (``rng`` then pins the nonce for reproducible traces).
+        self.transport = transport if transport is not None else SOCKET_TRANSPORT
+        self.nonce = (
+            rng.getrandbits(64) if rng is not None else secrets.randbits(64)
+        ) | 1
         self.sent = 0
         self.disconnects = 0
         self.refused = 0
@@ -256,7 +265,7 @@ class GreedyPeer:
         )
         while not self._stopping:
             try:
-                reader, writer = await asyncio.open_connection(
+                reader, writer = await self.transport.connect(
                     host,
                     port,
                     local_addr=(self.source, 0) if self.source else None,
@@ -339,28 +348,39 @@ class HostilePeer:
         blocks: list[Block],
         plan: FaultPlan = FaultPlan(),
         mempool_txs: tuple = (),
+        transport=None,
+        host: str = "127.0.0.1",
+        rng=None,
     ):
         assert blocks, "need at least a genesis block"
         self.blocks = list(blocks)
         self.plan = plan
         self.mempool_txs = tuple(mempool_txs)
         self._pos = {b.block_hash(): i for i, b in enumerate(self.blocks)}
-        self.nonce = secrets.randbits(64) | 1
+        #: Transport seam: real sockets by default; a netsim facade runs
+        #: the identical FaultPlan over simulated links (``host`` is then
+        #: the simulated listen address, ``rng`` pins the nonce so two
+        #: same-seed runs trace identically).
+        self.transport = transport if transport is not None else SOCKET_TRANSPORT
+        self.host = host
+        self.nonce = (
+            rng.getrandbits(64) if rng is not None else secrets.randbits(64)
+        ) | 1
         self.port: int | None = None
         self.requests: collections.Counter = collections.Counter()
         self.sessions = 0
-        self._server: asyncio.Server | None = None
-        self._tasks: set[asyncio.Task] = set()
-        self._live: set[_Session] = set()
+        self._server = None
+        # Ordered (dicts, not sets): teardown order is part of the
+        # deterministic-trace contract under the simulator.
+        self._tasks: dict[asyncio.Task, None] = {}
+        self._live: dict[_Session, None] = {}
         self._fault_hits = 0
 
     # -- lifecycle -------------------------------------------------------
 
     async def start(self) -> int:
-        self._server = await asyncio.start_server(
-            self._on_conn, "127.0.0.1", 0
-        )
-        self.port = self._server.sockets[0].getsockname()[1]
+        self._server = await self.transport.listen(self._on_conn, self.host, 0)
+        self.port = self._server.port
         return self.port
 
     async def stop(self) -> None:
@@ -378,10 +398,10 @@ class HostilePeer:
     async def dial(self, host: str, port: int) -> None:
         """Connect OUT to a victim (the inbound-attacker profile) and run
         the same scripted session over that socket."""
-        reader, writer = await asyncio.open_connection(host, port)
+        reader, writer = await self.transport.connect(host, port)
         task = asyncio.create_task(self._session(reader, writer))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        self._tasks[task] = None
+        task.add_done_callback(lambda t: self._tasks.pop(t, None))
 
     async def _on_conn(self, reader, writer) -> None:
         await self._session(reader, writer)
@@ -403,7 +423,7 @@ class HostilePeer:
     async def _session(self, reader, writer) -> None:
         self.sessions += 1
         sess = _Session(reader, writer)
-        self._live.add(sess)
+        self._live[sess] = None
         try:
             await self._send(sess, self._hello())
             while True:
@@ -420,7 +440,7 @@ class HostilePeer:
         ):
             pass  # victim hung up (or stop() closed us) — session over
         finally:
-            self._live.discard(sess)
+            self._live.pop(sess, None)
             writer.close()
 
     async def _handle(self, sess: _Session, mtype: MsgType, body) -> None:

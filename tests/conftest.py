@@ -51,6 +51,12 @@ def pytest_configure(config):
         "markers",
         "slow: long-running soaks excluded from the tier-1 `-m 'not slow'` run",
     )
+    config.addinivalue_line(
+        "markers",
+        "sim: deterministic network-simulator scenarios (node/netsim.py) "
+        "— virtual-time runs selectable with `-m sim`; tier-1 carries "
+        "the quick set, the 1000-node acceptance runs are also `slow`",
+    )
     from p1_tpu.core import keys
 
     keys.set_verify_workers(config.getoption("--verify-workers"))

@@ -1,6 +1,7 @@
 """CLI: each benchmark config shape runs from one command (SURVEY §7.7)."""
 
 import json
+import os
 import subprocess
 import sys
 
@@ -686,6 +687,66 @@ class TestFsck:
         proc = self._fsck("--help")
         assert proc.returncode == 0
         assert "salvage" in proc.stdout and "--store" in proc.stdout
+
+
+class TestSim:
+    """`p1 sim` (round 10): the deterministic network-simulator
+    scenarios — list/help smoke plus one subprocess e2e proving the
+    JSON report line, the ok exit-code contract, and that the report's
+    trace digest is reproducible by seed across PROCESSES (which the
+    in-process determinism tests cannot see: it additionally requires
+    nothing hash-seed-dependent in the event path)."""
+
+    def test_list_smoke(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "sim", "--list"],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0
+        for name in ("partition-heal", "flash-crowd", "eclipse", "wan"):
+            assert name in proc.stdout
+
+    def test_unknown_scenario_is_a_clean_cli_error(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "sim", "bogus"],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode != 0
+        assert "unknown scenario" in proc.stderr
+
+    def test_sim_e2e_report_and_cross_process_determinism(self):
+        def one_run():
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "p1_tpu",
+                    "sim",
+                    "partition-heal",
+                    "--nodes",
+                    "16",
+                    "--seed",
+                    "9",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=110,
+                cwd="/root/repo",
+                env={**os.environ, "PYTHONHASHSEED": "0"},
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        a, b = one_run(), one_run()
+        assert a["ok"] and a["converged"] and a["ledger_conserved"]
+        assert a["nodes"] == 16
+        assert a["trace_digest"] == b["trace_digest"]
 
 
 class TestServe:

@@ -51,6 +51,9 @@ def test_walk_found_the_tree():
         "p1_tpu.chain.filters",
         "p1_tpu.node.node",
         "p1_tpu.node.queryplane",
+        "p1_tpu.node.transport",
+        "p1_tpu.node.netsim",
+        "p1_tpu.node.scenarios",
         "p1_tpu.hashx.pallas_backend",
     ):
         assert expected in names

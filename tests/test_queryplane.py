@@ -424,6 +424,137 @@ class TestProofCache:
         assert proof.tip_height == 3
 
 
+class TestFilterRebuildUnderReorg:
+    """The round-9 FilterIndex's rebuild-from-store path under reorg —
+    previously only the happy path (build at connect, serve from LRU)
+    was exercised.  Here filters are LRU-evicted AND the bodies they
+    would rebuild from are evicted to the store, then a reorg moves the
+    main chain: every filter served afterwards must be rebuilt through
+    ``Chain._block_at``'s store refetch and be byte-identical to a
+    fresh construction from the block — for the new main chain and for
+    the orphaned branch alike."""
+
+    def _extend(self, chain, store, parent, height, txs, ts):
+        miner = Miner(backend=get_backend("cpu"), chunk=4096)
+        draft = BlockHeader(
+            version=1,
+            prev_hash=parent,
+            merkle_root=merkle_root([t.txid() for t in txs]),
+            timestamp=ts,
+            difficulty=chain.difficulty,
+            nonce=0,
+        )
+        sealed = miner.search_nonce(draft)
+        block = Block(sealed, tuple(txs))
+        res = chain.add_block(block)
+        assert res.status.value == "accepted", res.reason
+        store.append(block)
+        return block
+
+    def test_evicted_filters_rebuild_from_the_store_across_a_reorg(
+        self, tmp_path
+    ):
+        store = ChainStore(tmp_path / "c.dat")
+        store.acquire()
+        try:
+            chain = Chain(1)
+            chain.body_source = store
+            g = chain.genesis
+            # Branch A: two blocks, the second carrying a transfer the
+            # filter must commit to.
+            a1 = self._extend(
+                chain, store, g.block_hash(), 1,
+                [Transaction.coinbase(account("alice"), 1)],
+                g.header.timestamp + 60,
+            )
+            tx = stx("alice", "bob", 3, 1, 0, difficulty=1)
+            a2 = self._extend(
+                chain, store, a1.block_hash(), 2,
+                [Transaction.coinbase(account("alice"), 2), tx],
+                g.header.timestamp + 120,
+            )
+            # Fresh ground truth BEFORE any eviction/reorg.
+            truth = {
+                b.block_hash(): fmod.block_filter(b) for b in (a1, a2)
+            }
+            # Branch B: three carol blocks from genesis — reorgs A out.
+            parent, ts = g.block_hash(), g.header.timestamp + 61
+            b_blocks = []
+            for h in range(1, 4):
+                b = self._extend(
+                    chain, store, parent, h,
+                    [Transaction.coinbase(account("carol"), h)], ts,
+                )
+                truth[b.block_hash()] = fmod.block_filter(b)
+                parent, ts = b.block_hash(), ts + 60
+                b_blocks.append(b)
+            assert chain.height == 3  # the reorg landed
+
+            # Now the hostile part: drop every cached filter AND evict
+            # bodies so a rebuild must round-trip through the store.
+            chain.filter_index = fmod.FilterIndex(max_bytes=16 << 20)
+            assert len(chain.filter_index) == 0
+            chain.evict_bodies(1)
+            assert chain.bodies_evicted > 0
+
+            # New-main-chain filters rebuild byte-identically...
+            for h in range(1, 4):
+                bhash = chain.main_hash_at(h)
+                assert chain.block_filter(bhash) == truth[bhash]
+            # ...and so do the ORPHANED branch's (still indexed, still
+            # store-resident — a late light client may ask for them).
+            assert chain.block_filter(a2.block_hash()) == truth[
+                a2.block_hash()
+            ]
+            assert chain.filter_index.built >= 4  # rebuilt, not cached
+            assert chain.body_refetches > 0  # the store path really ran
+
+            # Semantics survived the rebuild: the orphaned block's
+            # filter still matches the reorged-out transfer (zero false
+            # negatives are per-block, branch or not) while the
+            # same-height main-chain block — which never carried it —
+            # need not (and its sender set is carol's, not alice's).
+            a2f = chain.block_filter(a2.block_hash())
+            assert fmod.matches_any(
+                a2f, a2.block_hash(), [tx.txid()]
+            )
+            main2 = chain.main_hash_at(2)
+            assert fmod.matches_any(
+                chain.block_filter(main2), main2,
+                [account("carol").encode()],
+            )
+            # Unknown hash: not an exception, a None (the serving
+            # path's not-found contract).
+            assert chain.block_filter(b"\x00" * 32) is None
+        finally:
+            store.close()
+
+    def test_rebuilt_filters_serve_identical_bytes_to_connect_time(
+        self, tmp_path
+    ):
+        """A store resumed with a bounded body cache must serve the
+        exact filter bytes the original node built at connect time —
+        the replica/serving plane's cold-history path."""
+        chain = build_chain(8, difficulty=1, rng=random.Random(7))
+        blocks = list(chain.main_chain())
+        truth = {
+            b.block_hash(): chain.block_filter(b.block_hash())
+            for b in blocks[1:]
+        }
+        store = ChainStore(tmp_path / "r.dat")
+        store.acquire()
+        try:
+            for b in blocks[1:]:
+                store.append(b)
+            resumed = store.load_chain(1, body_cache=2)
+            resumed.body_source = store
+            assert resumed.resident_body_bytes < chain.resident_body_bytes
+            for bhash, expected in truth.items():
+                assert resumed.block_filter(bhash) == expected
+        finally:
+            store.close()
+
+
 # -- node-level wire service ----------------------------------------------
 
 

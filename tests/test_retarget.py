@@ -496,20 +496,29 @@ class TestForwardDatingBound:
                 target_spacing=RULE.spacing,
             )
         )
-        import p1_tpu.node.node as node_mod
+        class _Clock:
+            """The node reads wall time ONLY through its clock seam
+            (node/transport.py) — a runaway local clock is one field."""
 
+            wall_now = 0.0
+
+            def wall(self):
+                return self.wall_now
+
+            monotonic = wall
+
+        clock = _Clock()
+        monkeypatch.setattr(node, "clock", clock)
         # Height 1 (tip = genesis): the assembler must NOT clamp — it is
         # the bootstrap anchor that brings the chain clock to wall time.
         far = node.chain.tip.header.timestamp + 10 * RULE.max_increment
-        monkeypatch.setattr(node_mod.time, "time", lambda: far)
+        clock.wall_now = far
         anchor = node._assemble()
         assert anchor.header.timestamp == far
         # From height 2 on, a runaway local clock is clamped to the cap.
         _extend(node.chain, 1, dt=1)
         tip_ts = node.chain.tip.header.timestamp
-        monkeypatch.setattr(
-            node_mod.time, "time", lambda: tip_ts + 10 * RULE.max_increment
-        )
+        clock.wall_now = tip_ts + 10 * RULE.max_increment
         block = node._assemble()
         assert block.header.timestamp == tip_ts + RULE.max_increment
 

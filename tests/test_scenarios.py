@@ -1,0 +1,114 @@
+"""The scenario corpus (p1_tpu/node/scenarios.py) as a test suite.
+
+Tier-1 (``sim`` marker) runs every scenario family at a mesh size real
+sockets could never reach on this host — the flagship is a 200-node
+partition-heal inside the ordinary timeout budget.  The ``slow`` set
+carries the acceptance-scale runs: the 1000-node 600/400 partition-heal
+(ISSUE 7's headline criterion) and the 500-joiner flash crowd.
+
+Every scenario's ``ok`` already folds in its own invariants
+(convergence, exact ledger conservation, containment metrics); the
+tests re-assert the load-bearing ones explicitly so a failure names
+what broke instead of just "ok was False".
+"""
+
+import pytest
+
+from p1_tpu.node.scenarios import (
+    churn_storm,
+    eclipse,
+    flash_crowd,
+    partition_heal,
+    run_scenario,
+    wan,
+)
+
+pytestmark = pytest.mark.sim
+
+
+class TestPartitionHeal:
+    def test_200_node_mesh_splits_heals_and_converges(self):
+        """The tier-1 flagship: a 200-node mesh (≈28x the real-socket
+        ceiling) splits 120/80, both sides mine their own chains, the
+        cut heals, and every node converges on the majority tip with
+        the ledger-sum invariant intact — in bounded VIRTUAL time."""
+        r = partition_heal(nodes=200, seed=0)
+        assert r["ok"], r
+        assert r["tips_diverged"], "partition never actually diverged"
+        assert r["converged"] and r["ledger_conserved"]
+        assert r["heights"]["min"] == r["final_height"]
+        # Every minority node lived on the minority chain and was
+        # reorged back — mass fork-choice, not a lucky no-op.
+        assert r["minority_nodes_reorged"] >= 0.9 * r["split"][1]
+        assert r["heal_virtual_s"] <= 120.0
+
+    @pytest.mark.slow
+    def test_1000_node_acceptance_run(self):
+        """ISSUE 7 acceptance: 1000 nodes, 600/400 split, heal,
+        one tip + conserved ledgers in bounded virtual time, tier-1
+        minutes of wall time (measured ~25 s here; the wall guard
+        below is the regression tripwire, with wide CI margin)."""
+        r = partition_heal(nodes=1000, seed=0)
+        assert r["ok"], r
+        assert r["split"] == [600, 400]
+        assert r["minority_nodes_reorged"] == 400
+        assert r["heal_virtual_s"] <= 120.0
+        assert r["wall_s"] < 300.0
+
+
+class TestFlashCrowd:
+    def test_80_joiners_storm_one_seed(self):
+        # 80 > MAX_PEERS(64): the cap regime, not a comfortable mesh.
+        r = flash_crowd(joiners=80, chain_height=12, seed=0)
+        assert r["ok"], r
+        # The herd exceeded the seed's open slots and synced anyway —
+        # through each other, which is the scenario's point.
+        assert r["seed_capped"]
+        assert r["heights"]["min"] == 12
+
+    @pytest.mark.slow
+    def test_500_joiners_acceptance_scale(self):
+        r = flash_crowd(joiners=500, chain_height=20, seed=0)
+        assert r["ok"], r
+        assert r["seed_peer_count"] <= 64  # MAX_PEERS held under the herd
+        assert r["heights"]["min"] == 20
+
+
+class TestChurnStorm:
+    def test_waves_of_restarts_still_converge(self):
+        r = churn_storm(nodes=30, cycles=4, seed=0)
+        assert r["ok"], r
+        assert r["restarts"] > 0
+        assert r["heights"]["min"] == r["final_height"]
+
+
+class TestEclipse:
+    def test_addr_flood_cannot_eclipse_the_victim(self):
+        r = eclipse(honest=16, attackers=6, spam_rounds=20, seed=0)
+        assert r["ok"], r
+        # The round-4 defenses, named: gossip never reached the tried
+        # bucket, the per-host budget clipped the flood to a trickle,
+        # the book stayed bounded, and the victim kept following the
+        # honest chain.
+        assert r["tried_bucket_attacker_entries"] == 0
+        assert r["new_bucket_attacker_entries"] < r["spam_addrs_sent"] / 10
+        assert r["address_book_bounded"]
+        assert r["victim_honest_links"] >= 1
+        assert r["victim_followed_honest_tip"]
+
+
+class TestWan:
+    def test_asymmetric_geography_converges_and_is_visible(self):
+        r = wan(region_nodes=6, blocks=6, seed=0)
+        assert r["ok"], r
+        # The latency model is load-bearing: measured propagation shows
+        # at least one inter-region one-way latency.
+        assert r["propagation_max_p95_ms"] >= r["min_inter_region_latency_ms"]
+
+
+class TestRegistry:
+    def test_run_scenario_dispatches_and_rejects_unknown(self):
+        r = run_scenario("wan", region_nodes=3, blocks=2, seed=1)
+        assert r["scenario"] == "wan"
+        with pytest.raises(ValueError, match="unknown scenario"):
+            run_scenario("nope")
