@@ -270,6 +270,34 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Chaos plane (round 11): combined-fault schedules per wall second
+    # (benchmarks/chaos_rate.py) against the ONE recorded constant
+    # (perf_record.py RECORDED_CHAOS_RATE), same convention as above.
+    # A quick 5-schedule probe: it tracks the pinned 10-schedule figure
+    # within the guard band at half the bench cost.
+    from p1_tpu.hashx.perf_record import (
+        CHAOS_DEGRADED_FRACTION,
+        RECORDED_CHAOS_RATE,
+    )
+
+    try:
+        from benchmarks.chaos_rate import bench_chaos
+
+        ch = bench_chaos(schedules=5)
+        extra["chaos_schedules_per_sec"] = ch["chaos_schedules_per_sec"]
+        extra["chaos_virtual_per_wall"] = ch["virtual_per_wall"]
+        extra["chaos_ok"] = ch["ok"]
+        extra["chaos_vs_recorded"] = round(
+            ch["chaos_schedules_per_sec"] / RECORDED_CHAOS_RATE, 2
+        )
+        if (
+            ch["chaos_schedules_per_sec"]
+            < CHAOS_DEGRADED_FRACTION * RECORDED_CHAOS_RATE
+        ):
+            extra["chaos_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
 
     print(

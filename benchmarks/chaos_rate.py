@@ -1,0 +1,74 @@
+"""Chaos sweep throughput: schedules/second, wall-vs-virtual ratio.
+
+The question this answers: how fast can the chaos plane SEARCH the
+combined-fault space?  Every schedule is a full mesh life cycle —
+formation, warmup mining, the fault events (crashes with torn appends,
+disk errors, partitions, adversaries), the heal epilogue, settle, and
+the invariant suite — so the schedules/s figure is the search budget
+`p1 chaos` and the sweeps in tests/test_chaos.py spend from.
+
+The companion ratio (virtual seconds simulated per wall second) says
+what the discrete-event substrate buys here: a schedule spans minutes
+of virtual time (supervision deadlines, store-recovery backoff, settle
+windows all at PRODUCTION values) and costs tens of milliseconds of
+wall clock.
+
+The default run feeds ``bench.py``'s ``chaos_rate`` line against the
+pinned ``RECORDED_CHAOS_RATE`` (p1_tpu/hashx/perf_record.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def bench_chaos(
+    schedules: int = 10, nodes: int = 5, events: int = 10, seed: int = 0
+) -> dict:
+    """Run ``schedules`` consecutive seeds; all must hold their
+    invariants (a violation voids the measurement — a failing sweep is
+    a bug report, not a benchmark)."""
+    from p1_tpu.node.chaos import run_chaos
+
+    wall = virtual = 0.0
+    ok = True
+    t0 = time.perf_counter()
+    for s in range(seed, seed + schedules):
+        report = run_chaos(s, nodes=nodes, n_events=events)
+        ok &= report["ok"]
+        virtual += report["virtual_s"]
+    wall = time.perf_counter() - t0
+    return {
+        "schedules": schedules,
+        "nodes": nodes,
+        "events": events,
+        "ok": ok,
+        "wall_s": round(wall, 3),
+        "virtual_s": round(virtual, 1),
+        "chaos_schedules_per_sec": round(schedules / max(wall, 1e-9), 2),
+        "virtual_per_wall": round(virtual / max(wall, 1e-9), 1),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--schedules", type=int, default=10)
+    parser.add_argument("--nodes", type=int, default=5)
+    parser.add_argument("--events", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    print(
+        json.dumps(
+            bench_chaos(args.schedules, args.nodes, args.events, args.seed)
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -581,6 +581,7 @@ class ChainStore:
         trusted: bool = False,
         body_cache: int = 0,
         sig_cache=None,
+        orphans_ok: bool = False,
     ) -> Chain:
         """Rebuild a validated chain from the log (skipping the genesis
         record, which the Chain constructor provides).  Pass ``blocks``
@@ -609,6 +610,18 @@ class ChainStore:
         snapshot of the wrong chain).  The guard lives here, once, so no
         call site can forget it; a partially-connecting store (corrupt
         tail) still loads what it can.
+
+        ``orphans_ok`` relaxes that guard for callers that can BACKFILL:
+        when this acquire's heal quarantined the head of the log, the
+        surviving records legitimately hang off a missing ancestor —
+        they park in the chain's orphan pool and reconnect the moment a
+        peer re-serves the gap.  The chaos sweeps found the hard guard
+        bricking exactly that recovery (a node refusing to boot off its
+        own healed store over one rotted head record, with the whole
+        suffix intact and the mesh holding every missing block); a NODE
+        passes ``orphans_ok`` when its store healed, while tooling
+        (``p1 compact``) keeps the refusal — compacting an unanchored
+        store would discard records a sync could still save.
 
         Resume operates on the packed-bytes plane end to end: the batch
         parse (``load_blocks``) seeds every block's encoding caches from
@@ -658,7 +671,7 @@ class ChainStore:
                 chain.evict_bodies(body_cache)
         if body_cache > 0:
             chain.evict_bodies(body_cache)
-        if saw_record and not chain.height:
+        if saw_record and not chain.height and not orphans_ok:
             raise ValueError(
                 f"{self.path}: records do not connect to this chain's "
                 "genesis — wrong --difficulty or "

@@ -606,6 +606,55 @@ def build_parser() -> argparse.ArgumentParser:
         "--region-nodes", type=int, default=None, help="wan nodes per region"
     )
 
+    p = sub.add_parser(
+        "chaos",
+        help="combined-fault search over the simulated mesh: seeded "
+        "crash/disk/partition/adversary schedules, invariant checks, "
+        "self-shrinking repros",
+    )
+    p.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="first schedule seed (determinism: same seed => "
+        "byte-identical event trace)",
+    )
+    p.add_argument(
+        "--schedules",
+        type=int,
+        default=1,
+        help="how many consecutive seeds to sweep (default 1)",
+    )
+    p.add_argument("--nodes", type=int, default=6, help="mesh size per run")
+    p.add_argument(
+        "--events", type=int, default=12, help="fault events per schedule"
+    )
+    p.add_argument("--difficulty", type=int, default=8)
+    p.add_argument(
+        "--repro",
+        metavar="FILE",
+        help="replay a repro artifact instead of sweeping (exit 1 iff "
+        "the recorded violation reproduces)",
+    )
+    p.add_argument(
+        "--out",
+        metavar="FILE",
+        default="chaos_repro.json",
+        help="where a violation's shrunk repro artifact is written "
+        "(default chaos_repro.json)",
+    )
+    p.add_argument(
+        "--no-shrink",
+        action="store_true",
+        help="on violation, write the full schedule without minimizing",
+    )
+    p.add_argument(
+        "--inject-bug",
+        choices=["relapse-disk", "deaf-recover"],
+        help="TEST ONLY: seed a known recovery bug so the shrink/repro "
+        "pipeline can be exercised against a guaranteed violation",
+    )
+
     sub.add_parser("bench", help="headline benchmark (one JSON line)")
     return parser
 
@@ -1526,6 +1575,111 @@ def cmd_sim(args) -> int:
     return 0 if report.get("ok") else 1
 
 
+def cmd_chaos(args) -> int:
+    """Chaos sweep / repro replay (node/chaos.py).  Exit-code contract:
+    0 = every schedule's invariants held, 1 = a violation was found and
+    its (shrunk) repro artifact written — or, under --repro, the
+    artifact's violation reproduced — 2 = usage error (argparse's own
+    exit, plus unreadable/foreign repro files)."""
+    from p1_tpu.node import chaos
+
+    if args.repro:
+        try:
+            report, artifact = chaos.run_repro(args.repro)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        hit = sorted({v["invariant"] for v in report["violations"]})
+        print(
+            json.dumps(
+                {
+                    "repro": args.repro,
+                    "expected": artifact["expected_violations"],
+                    "observed": hit,
+                    "trace_digest": report["trace_digest"],
+                    "digest_match": report["trace_digest"]
+                    == artifact["expected_trace_digest"],
+                    "reproduced": bool(hit),
+                }
+            )
+        )
+        return 1 if hit else 0
+    digests = []
+    for seed in range(args.seed, args.seed + args.schedules):
+        events = chaos.generate_schedule(seed, args.nodes, args.events)
+        report = chaos.run_chaos(
+            seed,
+            nodes=args.nodes,
+            events=events,
+            difficulty=args.difficulty,
+            inject_bug=args.inject_bug,
+        )
+        digests.append(report["trace_digest"])
+        if report["ok"]:
+            continue
+        target = report["violations"][0]["invariant"]
+        shrunk, runs = events, 0
+        if not args.no_shrink:
+
+            def reproduces(subset):
+                rep = chaos.run_chaos(
+                    seed,
+                    nodes=args.nodes,
+                    events=subset,
+                    difficulty=args.difficulty,
+                    inject_bug=args.inject_bug,
+                )
+                return any(
+                    v["invariant"] == target for v in rep["violations"]
+                )
+
+            shrunk, runs = chaos.shrink_schedule(events, reproduces)
+        final = chaos.run_chaos(
+            seed,
+            nodes=args.nodes,
+            events=shrunk,
+            difficulty=args.difficulty,
+            inject_bug=args.inject_bug,
+        )
+        chaos.write_repro(
+            args.out,
+            final,
+            shrunk,
+            seed=seed,
+            nodes=args.nodes,
+            difficulty=args.difficulty,
+            inject_bug=args.inject_bug,
+        )
+        print(
+            json.dumps(
+                {
+                    "ok": False,
+                    "seed": seed,
+                    "violations": final["violations"],
+                    "schedule_events": len(events),
+                    "shrunk_events": len(shrunk),
+                    "shrink_runs": runs,
+                    "repro": args.out,
+                    "trace_digest": final["trace_digest"],
+                }
+            )
+        )
+        return 1
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "schedules": args.schedules,
+                "seed_first": args.seed,
+                "nodes": args.nodes,
+                "events_per_schedule": args.events,
+                "trace_digests": digests,
+            }
+        )
+    )
+    return 0
+
+
 def cmd_net(args) -> int:
     from p1_tpu.node.netharness import run_net
 
@@ -1573,6 +1727,7 @@ def main(argv=None) -> int:
         "pod": cmd_pod,
         "net": cmd_net,
         "sim": cmd_sim,
+        "chaos": cmd_chaos,
         "bench": cmd_bench,
     }[args.cmd]
     return handler(args)

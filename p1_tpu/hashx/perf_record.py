@@ -99,3 +99,20 @@ RECORDED_SIM_RATE = 1_900.0
 #: guards: the figure is pure-Python event-loop throughput, the most
 #: co-tenant-sensitive measurement in the file.
 SIM_DEGRADED_FRACTION = 0.4
+
+#: Chaos plane (round 11): combined-fault schedules per wall second on
+#: the default 5-node/10-event configuration (benchmarks/chaos_rate.py;
+#: node/chaos.py) — each schedule a full mesh life cycle: formation,
+#: warmup, the fault events (crashes with torn appends, disk errors,
+#: partitions, adversaries), heal epilogue, settle, and the invariant
+#: suite.  Measured 2026-08-04 on the 1-vCPU bench host at load 0.07:
+#: ~9.9 schedules/s at a ~320x virtual-per-wall ratio (a schedule
+#: spans ~33 virtual seconds of production-deadline supervision and
+#: recovery backoff).  ``bench.py`` emits ``chaos_vs_recorded``
+#: against this figure — the denominator-pinning convention of
+#: RECORDED_CPU_BASELINE_HPS.
+RECORDED_CHAOS_RATE = 9.9
+
+#: Same-session degraded threshold; as co-tenant-sensitive as the sim
+#: figure (same pure-Python event-loop substrate).
+CHAOS_DEGRADED_FRACTION = 0.4

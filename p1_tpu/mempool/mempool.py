@@ -126,7 +126,7 @@ def load_mempool(pool: "Mempool", path) -> tuple[int, int]:
     (count,) = _struct.unpack_from(">I", raw, len(MEMPOOL_MAGIC))
     off = len(MEMPOOL_MAGIC) + 4
     restored = dropped = 0
-    now = time.monotonic()
+    now = pool._clock()
     for _ in range(count):
         if len(raw) < off + 12:
             break  # truncated tail: keep what we have
@@ -158,8 +158,17 @@ class Mempool:
         chain_tag=None,
         nonce_of=None,
         sig_cache=None,
+        clock=time.monotonic,
     ):
         self.max_txs = max_txs
+        #: Monotonic time source for admission stamps / TTL expiry.  A
+        #: bare reference, never called at import: the node injects its
+        #: transport clock (node/transport.py) so pool ages ride VIRTUAL
+        #: time under the simulator — chaos schedules that crash and
+        #: recover nodes must see deterministic checkpoint ages, and the
+        #: wall-clock lint (tests/test_simlint.py) holds mempool/ to the
+        #: same seam discipline as node/ and chain/.
+        self._clock = clock
         #: Verify-once signature cache (core/sigcache.py) admission
         #: populates: a transfer verified here is NOT re-verified when
         #: the block carrying it connects (or when mining re-assembles
@@ -289,7 +298,7 @@ class Mempool:
         if incumbent is not None:
             self._drop(self._txs[incumbent])
         self._txs[txid] = tx
-        self._admitted_at[txid] = time.monotonic()
+        self._admitted_at[txid] = self._clock()
         self.bytes_pending += len(tx.serialize())
         self._by_slot[slot] = txid
         self._pending_debit[tx.sender] = (
@@ -343,7 +352,7 @@ class Mempool:
         realistic confirmation horizon should stop occupying capacity and
         sync bandwidth.  ``now`` is injectable for deterministic tests.
         """
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         stale = [
             txid
             for txid, t in self._admitted_at.items()
@@ -480,7 +489,7 @@ class Mempool:
         before the restart does not get a fresh hour after it."""
         if not self.add(tx):
             return False
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         self._admitted_at[tx.txid()] = now - max(0.0, age_s)
         return True
 
@@ -488,7 +497,7 @@ class Mempool:
         """(transaction, age_seconds) for every pending transaction —
         what persistence saves.  Ages, not absolute stamps: admission
         times are monotonic-clock values, meaningless across processes."""
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         return [
             (tx, max(0.0, now - self._admitted_at[txid]))
             for txid, tx in self._txs.items()

@@ -1,0 +1,838 @@
+"""The chaos plane: combined-fault search over the simulated mesh.
+
+Every robustness layer so far was proven against ONE fault family at a
+time — supervision against stalling peers (round 6), the FaultStore
+against a bad disk (round 7), the governor against floods (round 8),
+the simulator against partitions (round 10).  Jepsen-style experience
+says the bugs that split chains live in the *compositions*: a crash
+during a reorg while the disk is ENOSPC-degraded and the mesh is
+partitioned.  This module points the deterministic simulator
+(node/netsim.py) at exactly that space:
+
+- ``generate_schedule`` — a seeded generator composing every existing
+  injector into one randomized, virtual-time-stamped event list:
+  abrupt crash/recover (``SimNet.crash_node`` — torn store appends,
+  stale mempool checkpoints, no shutdown hooks), StoreFaultPlan disk
+  errors and bit-flips, partitions, link latency/loss spikes,
+  HostilePeer/GreedyPeer adversaries, transaction traffic, and
+  scenario-driven mining on both sides of every cut.  Schedules are
+  fully deterministic per seed and JSON-round-trippable.
+- ``run_chaos`` — the orchestrator: applies a schedule to a live mesh
+  of full persistent nodes, clears every fault in a deterministic
+  epilogue, settles, and checks the global invariant suite at quiesce:
+  ledger conservation on every node, convergence to one tip within
+  bounded virtual time after the last fault clears, no node stuck
+  serve-only once its disk healed, every crashed node's store
+  fsck-clean (verdict 0/1, never 2) at recovery AND at shutdown, no
+  resurrected already-mined transaction in any pool, and proof/filter
+  caches consistent with the post-reorg chain.
+- ``shrink_schedule`` — delta debugging (ddmin): on a violation, the
+  schedule is minimized to the smallest event list that still
+  reproduces it, and ``write_repro``/``run_repro`` round-trip a
+  replayable artifact (seed + schedule + expected digest) through
+  ``p1 chaos --repro``.
+
+Determinism contract: the whole run — crash/recover cycles included —
+hashes into the simulator's event-trace digest; two runs of one seed
+are byte-identical in-process and across processes under
+PYTHONHASHSEED (tests/test_chaos.py, tests/test_cli.py).
+
+What the crash model does NOT capture (honesty, docs/ROUND11.md): the
+torn-append artifact is the FaultStore's single-record tear — kernel
+page-cache reordering that loses an EARLIER acknowledged write while a
+later one survives is outside it (the store fsyncs per append, so that
+scenario requires a lying disk, which round 7's writer-refusal covers
+separately); fsync-reordering across the mempool checkpoint and the
+store is likewise not modeled — the checkpoint is atomic-or-absent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import random
+import time
+from pathlib import Path
+
+from p1_tpu.node.netsim import NODE_PORT, LinkProfile, SimNet
+
+__all__ = [
+    "CHAOS_BUGS",
+    "fsck_verdict",
+    "generate_schedule",
+    "run_chaos",
+    "run_repro",
+    "shrink_schedule",
+    "write_repro",
+]
+
+#: Repro artifact format tag (bump on layout change).
+REPRO_FORMAT = "p1-chaos-repro-1"
+
+#: Test-only injectable bugs, each a known-broken recovery behavior the
+#: shrinker acceptance proof seeds deliberately (never reachable from
+#: production config — only the ``--inject-bug`` flag threads them):
+#:
+#: - ``relapse-disk``: recovery silently re-arms the recovered node's
+#:   disk fault — the "recovery declared the disk healthy without
+#:   proving it" bug class; the node degrades serve-only on its first
+#:   post-recover append and stays there, violating the serve-only
+#:   invariant.
+#: - ``deaf-recover``: the recovered node comes back with an empty peer
+#:   list — the "recovered node rejoins nothing" bug class; when nobody
+#:   happens to dial it, the mesh converges without it.
+CHAOS_BUGS = ("relapse-disk", "deaf-recover")
+
+
+# -- schedule generation ---------------------------------------------------
+
+
+def generate_schedule(
+    seed: int,
+    n_nodes: int,
+    n_events: int = 12,
+    horizon_vs: float = 30.0,
+    txs: bool = True,
+) -> list[dict]:
+    """One randomized, well-formed fault schedule: ``n_events`` events
+    at seeded virtual-time offsets in (0, ``horizon_vs``].  Well-formed
+    means runnable, not balanced — crashes may outlive the schedule
+    (the orchestrator's epilogue recovers everything), and any SUBSET
+    of a generated schedule is also runnable (events on dead/absent
+    targets degrade to no-ops), which is what lets the ddmin shrinker
+    cut arbitrary chunks.
+
+    Event ops and their composition sources:
+
+    - ``mine`` — scenario-driven block production (both sides of a cut
+      mine, so heals reorg);
+    - ``tx`` — a signed wallet spend submitted to a live node (funds
+      ride node 0's pinned coinbase identity);
+    - ``crash`` / ``recover`` — abrupt death (optionally with a torn
+      in-flight append) and resume-path reboot;
+    - ``corrupt`` — flip one byte of a CRASHED node's store file
+      (bit-rot while down; recovery must quarantine, never trust);
+    - ``disk_fail`` / ``disk_heal`` — arm/clear a persistent
+      StoreFaultPlan write error on a LIVE node (degrade→serve-only→
+      supervised recovery, inside the adversarial mesh);
+    - ``partition`` / ``heal`` — contiguous split (the backbone
+      topology keeps both sides internally connected);
+    - ``slow_link`` / ``restore_link`` — latency/jitter/loss spike on
+      every link of one host;
+    - ``hostile`` — a HostilePeer (stale or swallowed sync replies)
+      dials a victim; ``flood`` — a GreedyPeer protocol-valid flood.
+    """
+    rng = random.Random((seed << 3) ^ 0xC4A05)
+    times = sorted(
+        round(rng.uniform(0.5, horizon_vs), 3) for _ in range(n_events)
+    )
+    crashed: set[int] = set()
+    disks_down: set[int] = set()
+    slowed: set[int] = set()
+    partitioned = False
+    hostiles = 0
+    events: list[dict] = []
+    for at in times:
+        ops = [("mine", 5.0)]
+        if txs:
+            ops.append(("tx", 2.0))
+        if len(crashed) < max(1, n_nodes - 2):
+            ops.append(("crash", 2.5))
+        if crashed:
+            ops.append(("recover", 2.0))
+            ops.append(("corrupt", 1.0))
+        if not partitioned and n_nodes >= 4:
+            ops.append(("partition", 1.5))
+        if partitioned:
+            ops.append(("heal", 2.0))
+        if len(disks_down) < n_nodes - 1:
+            ops.append(("disk_fail", 1.5))
+        if disks_down:
+            ops.append(("disk_heal", 1.5))
+        if len(slowed) < n_nodes - 1:
+            ops.append(("slow_link", 1.0))
+        if slowed:
+            ops.append(("restore_link", 1.0))
+        if hostiles < 2:
+            ops.append(("hostile", 0.75))
+            ops.append(("flood", 0.5))
+        op = rng.choices([o for o, _ in ops], [w for _, w in ops])[0]
+        ev: dict = {"at": at, "op": op}
+        if op == "mine":
+            ev["node"] = rng.randrange(n_nodes)
+        elif op == "tx":
+            ev["amount"] = rng.randrange(1, 5)
+            ev["fee"] = rng.randrange(0, 3)
+        elif op == "crash":
+            victims = [i for i in range(n_nodes) if i not in crashed]
+            ev["node"] = rng.choice(victims)
+            # 0 = clean kill; >0 seeds the torn-append offset.
+            ev["torn"] = rng.choice((0, 0, rng.randrange(1, 1 << 16)))
+            crashed.add(ev["node"])
+            disks_down.discard(ev["node"])  # a dead process holds no plan
+        elif op == "recover":
+            ev["node"] = rng.choice(sorted(crashed))
+            crashed.discard(ev["node"])
+        elif op == "corrupt":
+            ev["node"] = rng.choice(sorted(crashed))
+            ev["offset"] = rng.randrange(1 << 20)
+        elif op == "partition":
+            ev["frac"] = rng.choice((0.3, 0.5, 0.7))
+            partitioned = True
+        elif op == "heal":
+            partitioned = False
+        elif op == "disk_fail":
+            import errno
+
+            up = [i for i in range(n_nodes) if i not in disks_down]
+            ev["node"] = rng.choice(up)
+            ev["errno"] = rng.choice((errno.ENOSPC, errno.EIO))
+            disks_down.add(ev["node"])
+        elif op == "disk_heal":
+            ev["node"] = rng.choice(sorted(disks_down))
+            disks_down.discard(ev["node"])
+        elif op == "slow_link":
+            cands = [i for i in range(n_nodes) if i not in slowed]
+            ev["node"] = rng.choice(cands)
+            ev["latency_ms"] = rng.choice((50, 150, 400))
+            ev["loss"] = rng.choice((0.0, 0.2, 0.5))
+            slowed.add(ev["node"])
+        elif op == "restore_link":
+            ev["node"] = rng.choice(sorted(slowed))
+            slowed.discard(ev["node"])
+        elif op == "hostile":
+            ev["node"] = rng.randrange(n_nodes)
+            ev["fault"] = rng.choice(("stale", "swallow"))
+            ev["height"] = rng.randrange(3, 9)
+            hostiles += 1
+        elif op == "flood":
+            ev["node"] = rng.randrange(n_nodes)
+            ev["kind"] = rng.choice(("queries", "blocks"))
+            hostiles += 1
+        events.append(ev)
+    return events
+
+
+# -- store verdicts --------------------------------------------------------
+
+
+def fsck_verdict(path) -> int:
+    """The `p1 fsck` exit-code contract as a pure function of the store
+    file's bytes: 0 = clean framing, 1 = damage a salvage recovers
+    (torn tail / quarantinable spans — at least one good record or an
+    empty-but-valid log survives), 2 = unrecoverable (missing, not a
+    chain store, or nothing salvageable).  The chaos invariant: a
+    crashed node's store must NEVER reach 2 — whatever the schedule
+    did, recovery has something valid to stand on."""
+    from p1_tpu.chain.store import ChainStore
+
+    path = Path(path)
+    if not path.exists():
+        return 2
+    data = path.read_bytes()
+    try:
+        scan = ChainStore.scan(data)
+    except ValueError:
+        return 2
+    if scan.clean:
+        return 0
+    # Damaged but salvageable as long as the framing walk itself stood
+    # up (it did — scan returned).  A store reduced to bad spans only
+    # still salvages to a valid empty log, which resyncs from peers.
+    return 1
+
+
+# -- the orchestrator ------------------------------------------------------
+
+
+def run_chaos(
+    seed: int,
+    nodes: int = 6,
+    n_events: int = 12,
+    events: list[dict] | None = None,
+    difficulty: int = 8,
+    store_dir=None,
+    horizon_vs: float = 30.0,
+    settle_vs: float = 240.0,
+    wall_limit_s: float | None = 180.0,
+    inject_bug: str | None = None,
+    txs: bool = True,
+    keep_trace: bool = False,
+) -> dict:
+    """Run one chaos schedule end to end and return the report.
+
+    ``events`` replays an explicit schedule (the repro path); None
+    generates one from the seed.  ``store_dir`` holds every node's
+    on-disk state for the run; None uses a private temp directory.
+    ``inject_bug`` (test-only, see ``CHAOS_BUGS``) seeds a known
+    recovery bug so the shrinker pipeline can be proven against a
+    violation that is guaranteed to exist.
+
+    Report: ``ok`` iff every invariant held; ``violations`` lists
+    ``{"invariant", "detail"}`` rows; ``trace_digest`` is the
+    simulator's running event hash — the replay-identity witness.
+    """
+    assert inject_bug is None or inject_bug in CHAOS_BUGS, inject_bug
+    if events is None:
+        events = generate_schedule(
+            seed, nodes, n_events, horizon_vs=horizon_vs, txs=txs
+        )
+    if store_dir is None:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="p1chaos") as tmp:
+            return run_chaos(
+                seed,
+                nodes=nodes,
+                events=events,
+                difficulty=difficulty,
+                store_dir=tmp,
+                settle_vs=settle_vs,
+                wall_limit_s=wall_limit_s,
+                inject_bug=inject_bug,
+                txs=txs,
+                keep_trace=keep_trace,
+            )
+    t0 = time.monotonic()
+    net = SimNet(
+        seed=seed,
+        difficulty=difficulty,
+        store_dir=store_dir,
+        keep_trace=keep_trace,
+    )
+    runner = _ChaosRunner(
+        net, nodes, difficulty, inject_bug, settle_vs, wall_limit_s
+    )
+    report = net.run(runner.main(events))
+    report["seed"] = seed
+    report["nodes"] = nodes
+    report["wall_s"] = round(time.monotonic() - t0, 3)
+    report["ok"] = not report["violations"]
+    return report
+
+
+class _ChaosRunner:
+    """One schedule's execution state (hosts, wallets, live actors)."""
+
+    def __init__(self, net, n_nodes, difficulty, inject_bug, settle_vs, wall_limit_s):
+        from p1_tpu.core.keys import Keypair
+
+        self.net = net
+        self.n = n_nodes
+        self.difficulty = difficulty
+        self.inject_bug = inject_bug
+        self.settle_vs = settle_vs
+        self.wall_limit_s = wall_limit_s
+        self.hosts = [net.host_name(i) for i in range(n_nodes)]
+        # Deterministic wallet: node 0 mines to this account, so its
+        # spends are funded the moment the warmup blocks land.
+        self.wallet = Keypair.from_seed_text(f"p1-chaos-{net.seed}")
+        self.payee = Keypair.from_seed_text(f"p1-chaos-{net.seed}-payee")
+        self.actors: list = []  # hostile/greedy peers, stopped at epilogue
+        self.slowed: set[str] = set()
+        self.partitioned = False
+        self.recover_verdicts: list[int] = []
+        self.counts = {"applied": 0, "crashes": 0, "recoveries": 0, "txs": 0}
+
+    # -- helpers ----------------------------------------------------------
+
+    def _alive(self, idx: int, mining: bool = False) -> str | None:
+        """Resolve a schedule's node index to a LIVE host, walking
+        forward deterministically when the named one is down (subsets
+        of a schedule must stay runnable).  ``mining`` additionally
+        skips degraded serve-only nodes: they reject even their own
+        sealed blocks (by design), so a mine event on one is a no-op
+        the schedule did not intend."""
+        for k in range(self.n):
+            host = self.hosts[(idx + k) % self.n]
+            node = self.net.nodes.get(host)
+            if node is None:
+                continue
+            if mining and node._store_degraded:
+                continue
+            return host
+        return None
+
+    def _record(self, *fields) -> None:
+        # Chaos actions are trace events: the digest must pin the
+        # schedule as executed, not just its network side effects.
+        self.net.net._record("chaos", self.net.clock.now, *fields)
+
+    # -- event application -------------------------------------------------
+
+    async def _apply(self, ev: dict) -> None:
+        net = self.net
+        op = ev["op"]
+        if op == "mine":
+            host = self._alive(ev["node"], mining=True)
+            if host is not None:
+                self._record("mine", host)
+                await net.mine_on(net.nodes[host])
+        elif op == "tx":
+            from p1_tpu.core.genesis import genesis_hash
+            from p1_tpu.core.tx import Transaction
+
+            host = self._alive(0)
+            if host is None:
+                return
+            node = net.nodes[host]
+            acct = self.wallet.account
+            seq = node.mempool.pending_next_seq(acct, node.chain.nonce(acct))
+            tx = Transaction.transfer(
+                self.wallet,
+                self.payee.account,
+                ev["amount"],
+                ev["fee"],
+                seq,
+                chain=genesis_hash(self.difficulty),
+            )
+            self._record("tx", host, seq)
+            await node.submit_tx(tx)
+            self.counts["txs"] += 1
+        elif op == "crash":
+            host = self.hosts[ev["node"]]
+            if host in net.nodes:
+                await net.crash_node(host, torn=ev.get("torn", 0))
+                self.counts["crashes"] += 1
+        elif op == "recover":
+            host = self.hosts[ev["node"]]
+            if host in net.crashed:
+                await self._recover(host)
+        elif op == "corrupt":
+            host = self.hosts[ev["node"]]
+            if host not in net.crashed:
+                return  # only a DOWN node's disk rots unobserved
+            path = Path(net.configs[host].store_path)
+            data = bytearray(path.read_bytes())
+            if len(data) <= 9:
+                return  # magic only: nothing to rot
+            # Never the magic: a destroyed format tag is fsck verdict 2
+            # by definition, and this event models bit-rot in records.
+            off = 8 + ev["offset"] % (len(data) - 8)
+            data[off] ^= 0x20
+            path.write_bytes(bytes(data))
+            self._record("corrupt", host, off)
+        elif op == "partition":
+            k = max(1, min(self.n - 1, int(self.n * ev["frac"])))
+            self.partitioned = True
+            net.net.partition(self.hosts[:k], self.hosts[k:])
+        elif op == "heal":
+            if self.partitioned:
+                self.partitioned = False
+                net.net.heal()
+        elif op == "disk_fail":
+            from p1_tpu.chain.testing import StoreFaultPlan
+
+            host = self.hosts[ev["node"]]
+            store = net.stores.get(host)
+            if host in net.nodes and store is not None:
+                self._record("disk_fail", host, ev["errno"])
+                store.plan = StoreFaultPlan(
+                    fail_writes_from=store.writes + 1,
+                    write_errno=ev["errno"],
+                )
+        elif op == "disk_heal":
+            host = self.hosts[ev["node"]]
+            store = net.stores.get(host)
+            if store is not None:
+                self._record("disk_heal", host)
+                store.clear_faults()
+        elif op == "slow_link":
+            host = self.hosts[ev["node"]]
+            self.slowed.add(host)
+            self._record("slow_link", host, ev["latency_ms"], ev["loss"])
+            profile = LinkProfile(
+                latency_s=ev["latency_ms"] / 1e3,
+                jitter_s=ev["latency_ms"] / 4e3,
+                loss=ev["loss"],
+            )
+            for other in self.hosts:
+                if other != host:
+                    net.net.set_profile(host, other, profile)
+        elif op == "restore_link":
+            self._restore_link(self.hosts[ev["node"]])
+        elif op == "hostile":
+            from p1_tpu.node.protocol import MsgType
+            from p1_tpu.node.testing import FaultPlan, HostilePeer, make_blocks
+
+            victim = self._alive(ev["node"])
+            if victim is None:
+                return
+            src = f"66.6.0.{len(self.actors)}"
+            plan = (
+                FaultPlan(stale_replies=True)
+                if ev["fault"] == "stale"
+                else FaultPlan(swallow=frozenset({MsgType.GETBLOCKS}))
+            )
+            hp = HostilePeer(
+                make_blocks(ev["height"], self.difficulty),
+                plan=plan,
+                transport=net.net.host(src),
+                host=src,
+                rng=random.Random(net.seed * 101 + len(self.actors)),
+            )
+            await hp.start()
+            self._record("hostile", victim, ev["fault"])
+            await hp.dial(victim, NODE_PORT)
+            self.actors.append(hp)
+        elif op == "flood":
+            from p1_tpu.node.testing import FloodPlan, GreedyPeer, make_blocks
+
+            victim = self._alive(ev["node"])
+            if victim is None:
+                return
+            src = f"66.6.1.{len(self.actors)}"
+            plan = (
+                FloodPlan(queries=True, burst=4, pause_s=0.25)
+                if ev["kind"] == "queries"
+                else FloodPlan(blocks=True, burst=4, pause_s=0.25)
+            )
+            gp = GreedyPeer(
+                make_blocks(4, self.difficulty),
+                plan=plan,
+                transport=net.net.host(src),
+                rng=random.Random(net.seed * 103 + len(self.actors)),
+            )
+            self._record("flood", victim, ev["kind"])
+            await gp.start(victim, NODE_PORT)
+            self.actors.append(gp)
+        self.counts["applied"] += 1
+
+    def _restore_link(self, host: str) -> None:
+        if host not in self.slowed:
+            return
+        self.slowed.discard(host)
+        self._record("restore_link", host)
+        for other in self.hosts:
+            if other != host:
+                self.net.net.set_profile(
+                    host, other, self.net.net.default_profile
+                )
+
+    async def _recover(self, host: str) -> None:
+        net = self.net
+        verdict = fsck_verdict(net.configs[host].store_path)
+        self.recover_verdicts.append(verdict)
+        if self.inject_bug == "deaf-recover":
+            # Test-only seeded bug: the reboot loses its peer list.
+            net.configs[host] = dataclasses.replace(
+                net.configs[host], peers=()
+            )
+        await net.recover_node(host)
+        if self.inject_bug == "relapse-disk":
+            from p1_tpu.chain.testing import StoreFaultPlan
+
+            # Test-only seeded bug: recovery declared the disk healthy
+            # without proving it — the first post-recover append fails
+            # and the node is stuck serve-only.
+            net.stores[host].plan = StoreFaultPlan(fail_writes_from=1)
+        self.counts["recoveries"] += 1
+
+    # -- the run -----------------------------------------------------------
+
+    async def main(self, events: list[dict]) -> dict:
+        net = self.net
+        violations: list[dict] = []
+        # Preamble: backbone + one seeded extra edge, node 0's coinbase
+        # pinned to the funded wallet, two warmup blocks everywhere.
+        topo = random.Random(net.seed ^ 0x70B0C4)
+        for i, host in enumerate(self.hosts):
+            peers = []
+            if i > 0:
+                peers.append(self.hosts[i - 1])
+                if i > 2:
+                    peers.append(self.hosts[topo.randrange(i - 1)])
+            kwargs = {"miner_id": self.wallet.account} if i == 0 else {}
+            await net.add_node(name=host, peers=peers, **kwargs)
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=self.wall_limit_s
+        ), "chaos mesh never formed"
+        miner0 = net.nodes[self.hosts[0]]
+        for _ in range(2):
+            await net.mine_on(miner0, spacing_s=1.0)
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == 2,
+            60,
+            step=0.25,
+            wall_limit_s=self.wall_limit_s,
+        ), "chaos mesh never converged pre-schedule"
+
+        # The schedule, in virtual time.
+        t_start = net.clock.now
+        for ev in sorted(events, key=lambda e: e["at"]):
+            target = t_start + ev["at"]
+            if target > net.clock.now:
+                await asyncio.sleep(target - net.clock.now)
+            await self._apply(ev)
+
+        # Epilogue: clear EVERY fault, deterministically, then settle.
+        for actor in self.actors:
+            await actor.stop()
+        self.actors.clear()
+        for host in sorted(self.slowed):
+            self._restore_link(host)
+        if self.partitioned:
+            self.partitioned = False
+            net.net.heal()
+        for host, store in sorted(net.stores.items()):
+            if host in net.nodes:
+                store.clear_faults()
+        for host in sorted(net.crashed):
+            await self._recover(host)
+        faults_cleared_at = net.clock.now
+        # Give the disk-recovery supervisor its backoff window: a node
+        # that degraded serve-only while the fault was armed clears the
+        # state one jittered retry AFTER the heal, not the same instant.
+        # "Permanently stuck" means still degraded past this bound.
+        await net.run_until(
+            lambda: not any(
+                n._store_degraded for n in net.nodes.values()
+            ),
+            self.settle_vs / 4,
+            step=0.25,
+            wall_limit_s=self.wall_limit_s,
+        )
+        # Two-phase settle: let post-heal sync land, then mine one
+        # fresh block (the announcement that must reach EVERY node —
+        # including any the schedule just rebooted, and the tie-break
+        # for same-height competing tips partition mining left) and
+        # require global convergence on it.
+        await net.run_until(
+            net.converged,
+            self.settle_vs / 2,
+            step=0.25,
+            wall_limit_s=self.wall_limit_s,
+        )
+        settle_host = self._alive(0, mining=True)
+        if settle_host is not None:
+            await net.mine_on(net.nodes[settle_host])
+        converged = await net.run_until(
+            lambda: net.converged()
+            and len(set(net.heights())) == 1,
+            self.settle_vs / 2,
+            step=0.25,
+            wall_limit_s=self.wall_limit_s,
+        )
+        settle_vs = net.clock.now - faults_cleared_at
+
+        # -- the invariant suite, at quiesce -------------------------------
+        if not converged:
+            tips = {h: n.chain.tip_hash.hex()[:12] for h, n in net.nodes.items()}
+            violations.append(
+                {
+                    "invariant": "converge",
+                    "detail": f"tips still split {settle_vs:.1f}vs after "
+                    f"the last fault cleared: {tips}",
+                }
+            )
+        if not net.ledger_conserved():
+            violations.append(
+                {
+                    "invariant": "ledger",
+                    "detail": "ledger sum != BLOCK_REWARD * height somewhere",
+                }
+            )
+        for host, node in net.nodes.items():
+            if node._store_degraded:
+                violations.append(
+                    {
+                        "invariant": "serve-only",
+                        "detail": f"{host} still degraded serve-only after "
+                        "its disk healed",
+                    }
+                )
+        for verdict in self.recover_verdicts:
+            if verdict > 1:
+                violations.append(
+                    {
+                        "invariant": "fsck",
+                        "detail": "a crashed store was unrecoverable "
+                        "(verdict 2) at reboot",
+                    }
+                )
+        violations.extend(self._check_pools())
+        violations.extend(self._check_caches())
+
+        heights = net.heights()
+        report = {
+            "events": len(events),
+            "schedule_tail": [e["op"] for e in events][-6:],
+            **self.counts,
+            "recover_verdicts": self.recover_verdicts,
+            "virtual_s": round(net.clock.now, 3),
+            "net_events": net.net.events,
+            "settle_virtual_s": round(settle_vs, 3),
+            "heights": {"min": min(heights), "max": max(heights)},
+            "reorgs_total": sum(
+                n.metrics.reorgs for n in net.nodes.values()
+            ),
+            "violations": violations,
+        }
+        await net.stop_all()
+        # Shutdown verdicts AFTER the stores closed cleanly: whatever
+        # the schedule inflicted, what reaches disk must stay loadable.
+        for host in self.hosts:
+            path = net.configs[host].store_path
+            if path and fsck_verdict(path) > 1:
+                report["violations"].append(
+                    {
+                        "invariant": "fsck",
+                        "detail": f"{host}'s store unrecoverable at shutdown",
+                    }
+                )
+        report["trace_digest"] = net.trace_digest()
+        return report
+
+    def _check_pools(self) -> list[dict]:
+        """No crash-restart (or reorg) may resurrect a transaction the
+        node's own main chain already mined — the mempool
+        crash-consistency invariant."""
+        out = []
+        for host, node in self.net.nodes.items():
+            for txid in node.mempool._txs:
+                if txid in node.chain._tx_index:
+                    out.append(
+                        {
+                            "invariant": "resurrect",
+                            "detail": f"{host} pool holds mined tx "
+                            f"{txid.hex()[:16]}",
+                        }
+                    )
+        return out
+
+    def _check_caches(self) -> list[dict]:
+        """Proof/filter caches must agree with the post-reorg chain:
+        every resident filter byte-matches a fresh build from the block
+        body, and the tip block's transaction proofs verify as a
+        stateless client would."""
+        from p1_tpu.chain.filters import block_filter
+        from p1_tpu.chain.proof import SPVError, verify_tx_proof
+
+        out = []
+        for host, node in self.net.nodes.items():
+            chain = node.chain
+            tip = chain.tip
+            for height in {1, chain.height // 2, chain.height}:
+                bhash = chain.main_hash_at(height)
+                if bhash is None:
+                    continue
+                cached = chain.filter_index.get(bhash)
+                if cached is not None and cached != block_filter(
+                    chain._block_at(bhash)
+                ):
+                    out.append(
+                        {
+                            "invariant": "caches",
+                            "detail": f"{host} filter for height {height} "
+                            "diverges from its block",
+                        }
+                    )
+            for tx in tip.txs[:2]:
+                proof = chain.tx_proof(tx.txid())
+                try:
+                    if proof is None:
+                        raise SPVError("no proof for a tip transaction")
+                    verify_tx_proof(
+                        proof,
+                        self.difficulty,
+                        chain.genesis.block_hash(),
+                        txid=tx.txid(),
+                    )
+                    if proof.height != chain.height:
+                        raise SPVError("tip proof at wrong height")
+                except SPVError as e:
+                    out.append(
+                        {
+                            "invariant": "caches",
+                            "detail": f"{host} tip proof failed: {e}",
+                        }
+                    )
+        return out
+
+
+# -- delta-debugging shrinker ---------------------------------------------
+
+
+def shrink_schedule(
+    events: list[dict], reproduces, max_runs: int = 120
+) -> tuple[list[dict], int]:
+    """Minimize ``events`` to a small list that still ``reproduces``
+    (ddmin: try dropping chunks at doubling granularity, restart
+    coarse after every success).  ``reproduces(subset) -> bool`` runs
+    one full chaos replay per call, so ``max_runs`` bounds total cost;
+    the result is 1-minimal when the budget allows (no single event can
+    be removed), merely smaller when it doesn't."""
+    assert reproduces(events), "the full schedule must reproduce first"
+    runs = 1
+    n = 2
+    while len(events) >= 2 and runs < max_runs:
+        chunk = max(1, len(events) // n)
+        reduced = False
+        for start in range(0, len(events), chunk):
+            candidate = events[:start] + events[start + chunk :]
+            if not candidate:
+                continue
+            runs += 1
+            if reproduces(candidate):
+                events = candidate
+                n = max(n - 1, 2)
+                reduced = True
+                break
+            if runs >= max_runs:
+                break
+        if not reduced:
+            if n >= len(events):
+                break
+            n = min(len(events), 2 * n)
+    return events, runs
+
+
+# -- repro artifacts -------------------------------------------------------
+
+
+def write_repro(
+    path,
+    report: dict,
+    events: list[dict],
+    *,
+    seed: int,
+    nodes: int,
+    difficulty: int,
+    inject_bug: str | None = None,
+) -> None:
+    """One replayable violation: everything ``run_repro`` needs to
+    reproduce it from nothing — seed, topology size, the (shrunk)
+    schedule, the expected violations and trace digest."""
+    artifact = {
+        "format": REPRO_FORMAT,
+        "seed": seed,
+        "nodes": nodes,
+        "difficulty": difficulty,
+        "inject_bug": inject_bug,
+        "events": events,
+        "expected_violations": sorted(
+            {v["invariant"] for v in report["violations"]}
+        ),
+        "expected_trace_digest": report["trace_digest"],
+    }
+    Path(path).write_text(json.dumps(artifact, indent=1))
+
+
+def run_repro(path) -> tuple[dict, dict]:
+    """Replay a repro artifact; returns ``(report, artifact)``.
+    Raises ValueError for anything that is not a chaos repro."""
+    try:
+        artifact = json.loads(Path(path).read_text())
+    except (OSError, ValueError) as e:
+        raise ValueError(f"unreadable repro artifact {path}: {e}") from None
+    if not isinstance(artifact, dict) or artifact.get("format") != REPRO_FORMAT:
+        raise ValueError(f"{path} is not a {REPRO_FORMAT} artifact")
+    report = run_chaos(
+        artifact["seed"],
+        nodes=artifact["nodes"],
+        events=artifact["events"],
+        difficulty=artifact["difficulty"],
+        inject_bug=artifact.get("inject_bug"),
+    )
+    return report, artifact

@@ -26,7 +26,12 @@ layered on the transport seam (node/transport.py):
   instances (the REAL node — chain, mempool, governor, supervision,
   address book; nothing mocked), drives deterministic block production,
   and runs scenarios to assertable convergence in bounded *virtual*
-  time.
+  time.  With ``store_dir`` set, every node persists to a per-host
+  fault-injectable store, and the chaos plane's crash primitives
+  apply: ``crash_node`` (abrupt death — severed links, no shutdown
+  hooks, a torn in-flight append, a stale mempool checkpoint) and
+  ``recover_node`` (reboot through the normal resume path) —
+  node/chaos.py composes them with every other injector.
 
 Determinism contract: one seed fixes everything observable.  Node
 identity and supervision jitter derive from ``NodeConfig.rng_seed``;
@@ -438,6 +443,23 @@ class SimTransport:
         # observer added after the cut).
         return ga is not None and gb is not None and ga != gb
 
+    def kill_host(self, host: str) -> None:
+        """A host died abruptly (the chaos plane's crash primitive):
+        every connection touching it is severed — in-flight bytes die on
+        the wire, exactly like a partition cut — and its listeners
+        vanish, so reconnect dials are refused until the host comes back
+        and listens again.  Recorded in the trace: a crash is an
+        observable network event."""
+        self._record("kill_host", self.clock.now, host)
+        for key in [k for k in self._listeners if k[0] == host]:
+            del self._listeners[key]
+        for conn in [
+            c
+            for c in self._conns
+            if c.a_addr[0] == host or c.b_addr[0] == host
+        ]:
+            conn.sever()
+
     def partition(self, *groups) -> None:
         """Split the network: hosts in different groups can neither dial
         each other nor keep existing connections (those are severed —
@@ -534,7 +556,10 @@ class SimNet:
         difficulty: int = 8,
         default_profile: LinkProfile | None = None,
         keep_trace: bool = False,
+        store_dir=None,
     ):
+        from pathlib import Path
+
         from p1_tpu.hashx import get_backend
         from p1_tpu.miner import Miner
 
@@ -550,6 +575,16 @@ class SimNet:
         self.rng = random.Random(seed)
         self.nodes: dict[str, object] = {}
         self.configs: dict[str, object] = {}
+        #: ``store_dir`` gives every node a real on-disk ChainStore
+        #: (one ``<host>.dat`` per node, always a fault-injectable
+        #: ``FaultStore``) — the substrate crash/recovery scenarios
+        #: need: a crashed node's surviving state IS its files.
+        self.store_dir = Path(store_dir) if store_dir is not None else None
+        #: host -> live FaultStore (chaos events re-arm plans on these).
+        self.stores: dict[str, object] = {}
+        #: Hosts currently dead from ``crash_node`` (host -> the dead
+        #: Node object, kept for post-mortem assertions in tests).
+        self.crashed: dict[str, object] = {}
         self._miner = Miner(backend=get_backend("cpu"), chunk=1 << 16)
 
     # -- lifecycle ---------------------------------------------------------
@@ -558,11 +593,30 @@ class SimNet:
     def host_name(i: int) -> str:
         return f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
 
-    async def add_node(self, name: str | None = None, peers=(), **cfg):
+    def _make_store(self, host: str, plan=None):
+        """A fresh FaultStore over the host's on-disk log (None when the
+        host is configured storeless).  Always a FaultStore, even with a
+        healthy plan: chaos events arm disk faults on live stores, and a
+        recover must hand the new process the same injectable seam."""
+        config = self.configs[host]
+        if not config.store_path:
+            self.stores.pop(host, None)
+            return None
+        from p1_tpu.chain.testing import FaultStore
+
+        store = FaultStore(config.store_path, plan=plan)
+        self.stores[host] = store
+        return store
+
+    async def add_node(
+        self, name: str | None = None, peers=(), store_plan=None, **cfg
+    ):
         """Spawn and start one full node.  ``peers`` are host names (or
         explicit "host:port" strings); defaults keep the sim lean —
         mining off (scenario-driven), no mempool TTL loop, seeded
-        identity."""
+        identity.  With ``store_dir`` set (or an explicit ``store_path``
+        in ``cfg``), the node persists to a real on-disk FaultStore;
+        ``store_plan`` scripts its initial disk pathology."""
         from p1_tpu.config import NodeConfig
         from p1_tpu.node.node import Node
 
@@ -571,14 +625,21 @@ class SimNet:
         cfg.setdefault("mine", False)
         cfg.setdefault("mempool_ttl_s", 0.0)
         cfg.setdefault("rng_seed", self.rng.getrandbits(48))
+        if self.store_dir is not None:
+            cfg.setdefault("store_path", str(self.store_dir / f"{host}.dat"))
         peer_strs = tuple(
             p if ":" in p else f"{p}:{NODE_PORT}" for p in peers
         )
         config = NodeConfig(
             host=host, port=NODE_PORT, peers=peer_strs, **cfg
         )
-        node = Node(config, miner=self._miner, transport=self.net.host(host))
         self.configs[host] = config
+        node = Node(
+            config,
+            miner=self._miner,
+            transport=self.net.host(host),
+            store=self._make_store(host, plan=store_plan),
+        )
         self.nodes[host] = node
         await node.start()
         return node
@@ -589,13 +650,113 @@ class SimNet:
 
     async def restart_node(self, host: str):
         """Churn: bring a previously stopped host back with the SAME
-        config (and so the same seed-derived identity)."""
+        config (and so the same seed-derived identity).  GRACEFUL
+        restart: the predecessor's ``stop()`` ran every shutdown hook
+        (mempool checkpoint, address book, store close) — contrast
+        ``crash_node``/``recover_node``, which skip them all."""
         from p1_tpu.node.node import Node
 
         node = Node(
             self.configs[host],
             miner=self._miner,
             transport=self.net.host(host),
+            store=self._make_store(host),
+        )
+        self.nodes[host] = node
+        await node.start()
+        return node
+
+    async def crash_node(self, host: str, torn: int = 0):
+        """Kill a node ABRUPTLY — the process-death model, no graceful
+        shutdown anywhere on the path:
+
+        - the wire dies first (``kill_host``): every connection is
+          severed with bytes in flight, reconnect dials refuse until
+          the host listens again;
+        - every task is cancelled with no close hooks — no mempool
+          save, no address-book save, no final store sync: whatever the
+          last periodic checkpoint wrote is what the disk holds (stale
+          by up to one housekeeping interval, exactly like a real
+          crash);
+        - ``torn > 0`` tears an in-flight store append at the kill
+          point through the FaultStore torn-write seam: the node's
+          current assembly candidate dies ``torn``-bytes into its
+          record — the on-disk artifact a power cut mid-append leaves,
+          which ``recover_node``'s normal resume must truncate;
+        - file handles close (the writer flock releases — a dead
+          process holds no locks), buffers are NOT flushed gracefully
+          (the store flushes per append by design, so acknowledged
+          records are already on disk — the durability contract under
+          test).
+
+        The dead Node object is kept in ``self.crashed[host]`` for
+        post-mortem assertions."""
+        node = self.nodes.pop(host)
+        self.net._record("crash", self.clock.now, host, torn)
+        self.net.kill_host(host)
+        node._running = False
+        node._abort_inflight_search()
+        tasks = [*node._tasks, *node._sessions]
+        for task in tasks:
+            task.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        node._tasks.clear()
+        node._sessions.clear()
+        if node._mempool_io is not None:
+            # The checkpoint WRITE runs in a real thread the event loop
+            # cannot cancel; wait it out so the post-crash disk state is
+            # a deterministic function of virtual time (either the
+            # checkpoint fully landed — tmp+rename is atomic — or it
+            # was never started), not a race against the wall clock.
+            await asyncio.gather(node._mempool_io, return_exceptions=True)
+        if node.store is not None:
+            if torn > 0:
+                self._tear_append(node, torn)
+            node.store.close()
+        self.crashed[host] = node
+        return node
+
+    def _tear_append(self, node, torn: int) -> None:
+        """Die ``torn`` bytes into appending the node's current assembly
+        candidate — the in-flight record a mid-append crash tears.  Runs
+        through the FaultStore torn-write plan (chain/testing.py), so
+        the partial bytes genuinely reach the file the way the harness's
+        storage suites model it."""
+        from p1_tpu.chain.testing import StoreFaultPlan
+
+        store = node.store
+        candidate = node._assemble()
+        # A full record is 4 (length) + payload + 4 (CRC) bytes; clamp
+        # the tear strictly inside it so the artifact is always an
+        # INCOMPLETE record (at minimum the CRC trailer is missing).
+        record_len = len(candidate.serialize()) + 8
+        torn_bytes = 1 + (torn - 1) % (record_len - 1)
+        store.plan = StoreFaultPlan(
+            fail_write_at=store.writes + 1, torn_bytes=torn_bytes
+        )
+        try:
+            store.append(candidate)
+        except OSError:
+            pass  # the point: the append died mid-write
+        finally:
+            store.plan = StoreFaultPlan()
+
+    async def recover_node(self, host: str):
+        """Reboot a crashed host from the same on-disk state through the
+        NORMAL resume path — ``Node.start()``'s store acquire (torn-tail
+        truncation, corruption quarantine/heal), validated chain replay,
+        and full-admission mempool reload.  Nothing about the boot knows
+        it follows a crash; that is the contract under test."""
+        assert host in self.crashed, f"{host} did not crash"
+        del self.crashed[host]
+        from p1_tpu.node.node import Node
+
+        self.net._record("recover", self.clock.now, host)
+        node = Node(
+            self.configs[host],
+            miner=self._miner,
+            transport=self.net.host(host),
+            store=self._make_store(host),
         )
         self.nodes[host] = node
         await node.start()

@@ -413,6 +413,9 @@ class Node:
             nonce_of=lambda acct: self.chain.nonce(acct),
             chain_tag=self.chain.genesis.block_hash(),
             sig_cache=self.sig_cache,
+            # The transport clock, so admission stamps / TTL ages ride
+            # virtual time under the simulator like every node deadline.
+            clock=self.clock.monotonic,
         )
         self.metrics = NodeMetrics()
         #: ``store`` is injectable (tests pass a fault-injecting
@@ -761,6 +764,12 @@ class Node:
                     trusted=not self.config.revalidate_store,
                     body_cache=body_cache,
                     sig_cache=self.sig_cache,
+                    # A heal that quarantined records may have cut the
+                    # log loose from genesis; the survivors park as
+                    # orphans and the ordinary locator sync backfills
+                    # the gap — refusing to boot here bricked crash
+                    # recovery (found by the chaos sweep, node/chaos.py).
+                    orphans_ok=self.store.healed["quarantined_records"] > 0,
                 )
             except ValueError as e:
                 self.store.close()
@@ -1851,11 +1860,22 @@ class Node:
                     # same sync dedup it for the cost of one frame;
                     # receivers beyond a healed cut learn the chain
                     # exists and pull the rest via orphan backfill.
+                    # The announce must NOT skip the quiescing peer:
+                    # with interleaved catch-up episodes the tip can
+                    # come from a different peer entirely, and the one
+                    # whose empty reply quiesced us may be BEHIND it —
+                    # a crash-recovered node that synced 2->4 from a
+                    # stale peer and 4->7 from a fresh one consumed the
+                    # one-shot flag on the stale peer's quiesce and
+                    # skipped exactly the node that needed the push,
+                    # leaving it forked forever (found by the chaos
+                    # sweep, node/chaos.py seed 30; the redundant frame
+                    # to an already-caught-up server is one dedup).
                     self._announce_tip = False
                     payload, saved = self._block_gossip_payload(
                         self.chain.tip
                     )
-                    n = await self._gossip(payload, skip=peer)
+                    n = await self._gossip(payload)
                     if saved and n:
                         self.metrics.cblocks_sent += n
                         self.metrics.cblock_bytes_saved += saved * n
@@ -2285,10 +2305,14 @@ class Node:
                 # never exhaust it, however fast the mesh mines.  Batch
                 # sync replies (gossip=False) were never charged.
                 origin.budget.refund(CLASS_BLOCKS)
-            if sent_ts is not None:
+            if sent_ts:
                 # Push-gossip propagation delay (send -> accept), recorded
                 # only for blocks that actually connected: duplicates and
                 # orphans would skew the figure toward re-delivery noise.
+                # Falsy covers both "no stamp" spellings — None (never
+                # passed a stamp) and the codec's 0.0 "no stamp" encode
+                # (protocol.encode_block) — so an unstamped tooling push
+                # can't record a nonsense epoch-sized delay.
                 self.metrics.propagation_delays_s.append(
                     max(0.0, self.clock.wall() - sent_ts)
                 )

@@ -259,7 +259,13 @@ def encode_block(block: Block, sent_ts: float | None = None) -> bytes:
     # ``serialize`` is memoized on the block (core/block.py): relaying a
     # block that arrived by gossip re-frames the SAME wire bytes — the
     # zero-repack pipeline's relay leg.
-    ts = time.time() if sent_ts is None else sent_ts
+    #
+    # ``sent_ts`` is the sender's wall clock for the receiver's
+    # propagation telemetry; None encodes 0.0 = "no stamp" (receivers
+    # skip the sample).  The codec deliberately reads NO clock of its
+    # own: stamps come from the caller's (possibly virtual) transport
+    # clock, which is what keeps simulated traces byte-identical.
+    ts = 0.0 if sent_ts is None else sent_ts
     return bytes([MsgType.BLOCK]) + struct.pack(">d", ts) + block.serialize()
 
 
@@ -309,8 +315,9 @@ def encode_cblock(block: Block, sent_ts: float | None = None) -> bytes:
     """Compact form of ``block``: prefill the coinbase (receivers cannot
     have it — it is minted by this block), elide everything else to its
     txid.  ~32 bytes per transaction on the wire instead of the full
-    serialization."""
-    ts = time.time() if sent_ts is None else sent_ts
+    serialization.  ``sent_ts`` as in ``encode_block``: the caller's
+    stamp or 0.0 = none, never a codec-side clock read."""
+    ts = 0.0 if sent_ts is None else sent_ts
     if len(block.txs) > 0xFFFF:
         # The compact form's counts are u16; consensus blocks are u32.
         # Callers fall back to the full BLOCK encoding (node.py does).

@@ -235,12 +235,21 @@ class GreedyPeer:
     def _frames(self) -> list[bytes]:
         plan = self.plan
         out: list[bytes] = []
+        # Stamp pushes from OUR transport clock (virtual under the
+        # simulator): the stamp is inside the frame bytes, so a host
+        # clock read here would make every simulated flood's trace
+        # nondeterministic.
+        now = self.transport.clock.wall()
         if plan.blocks:
-            out += [protocol.encode_block(b) for b in self.blocks[1:]]
+            out += [
+                protocol.encode_block(b, sent_ts=now) for b in self.blocks[1:]
+            ]
         if plan.orphans:
             # Withhold the connecting block: everything from [2:] parks
             # in the victim's orphan pool (valid PoW, unknown parent).
-            out += [protocol.encode_block(b) for b in self.blocks[2:]]
+            out += [
+                protocol.encode_block(b, sent_ts=now) for b in self.blocks[2:]
+            ]
         out += list(plan.tx_frames)
         if plan.queries:
             genesis_locator = [self.blocks[0].block_hash()]

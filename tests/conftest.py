@@ -57,6 +57,12 @@ def pytest_configure(config):
         "— virtual-time runs selectable with `-m sim`; tier-1 carries "
         "the quick set, the 1000-node acceptance runs are also `slow`",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: combined-fault schedules over the simulated mesh "
+        "(node/chaos.py) — tier-1 carries the bounded ~30-schedule "
+        "sweep, the ≥200-schedule sweep is also `slow`",
+    )
     from p1_tpu.core import keys
 
     keys.set_verify_workers(config.getoption("--verify-workers"))

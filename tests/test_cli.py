@@ -749,6 +749,83 @@ class TestSim:
         assert a["trace_digest"] == b["trace_digest"]
 
 
+class TestChaos:
+    """`p1 chaos` (round 11): combined-fault schedules over the
+    simulated mesh.  Exit-code contract: 0 = all invariants held,
+    1 = violation with a (shrunk) repro written — or a --repro replay
+    that reproduces — 2 = usage / unreadable artifact.  Plus the
+    cross-process determinism half of the acceptance criterion."""
+
+    @staticmethod
+    def _chaos(*argv, timeout=240):
+        return subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "chaos", *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd="/root/repo",
+            env={**os.environ, "PYTHONHASHSEED": "0"},
+        )
+
+    def test_help_smoke(self):
+        proc = self._chaos("--help")
+        assert proc.returncode == 0
+        for flag in ("--schedules", "--repro", "--seed", "--events"):
+            assert flag in proc.stdout
+
+    def test_clean_sweep_exit_0_and_cross_process_determinism(self):
+        # A seed whose schedule includes a crash/recover cycle, so the
+        # digest equality below covers the reboot path too.
+        from p1_tpu.node.chaos import generate_schedule
+
+        seed = next(
+            s
+            for s in range(20)
+            if any(
+                e["op"] == "crash" for e in generate_schedule(s, 5, 10)
+            )
+        )
+
+        def one_run():
+            proc = self._chaos(
+                "--seed", str(seed), "--schedules", "1", "--nodes", "5",
+                "--events", "10",
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        a, b = one_run(), one_run()
+        assert a["ok"] and a["trace_digests"] == b["trace_digests"]
+
+    def test_violation_exit_1_writes_repro_that_replays_exit_1(
+        self, tmp_path
+    ):
+        out = tmp_path / "repro.json"
+        proc = self._chaos(
+            "--seed", "0", "--schedules", "3", "--nodes", "5",
+            "--events", "10", "--inject-bug", "relapse-disk",
+            "--out", str(out),
+        )
+        assert proc.returncode == 1, (proc.stdout, proc.stderr[-2000:])
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["violations"] and out.exists()
+        # Shrinker acceptance: the minimized schedule is tiny.
+        assert summary["shrunk_events"] <= 5
+        replay = self._chaos("--repro", str(out))
+        assert replay.returncode == 1, replay.stderr[-2000:]
+        rep = json.loads(replay.stdout.strip().splitlines()[-1])
+        assert rep["reproduced"] and rep["digest_match"]
+
+    def test_unreadable_repro_exit_2(self, tmp_path):
+        junk = tmp_path / "junk.json"
+        junk.write_text("not a repro")
+        assert self._chaos("--repro", str(junk)).returncode == 2
+        assert (
+            self._chaos("--repro", str(tmp_path / "absent.json")).returncode
+            == 2
+        )
+
+
 class TestServe:
     """`p1 serve` (round 9): a read-only replica worker process over a
     chain store — help smoke plus one subprocess e2e proving the JSON

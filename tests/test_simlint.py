@@ -39,10 +39,12 @@ ALLOWED: dict[str, set[str]] = {
     # (The seam itself — node/transport.py — and the injectable-clock
     # DEFAULT arguments elsewhere hold bare ``time.monotonic``
     # references without calling them; the tokenizer scan below only
-    # flags calls, so they need no grants.)
-    # encode_block's default send stamp (the node passes clock.wall();
-    # standalone tooling encoders keep the host default).
-    "node/protocol.py": {"time.time("},
+    # flags calls, so they need no grants.  node/protocol.py held a
+    # ``time.time(`` grant for encode_block's default send stamp until
+    # round 11: the codec now encodes 0.0 = "no stamp" and every caller
+    # stamps from its own transport clock — the stamp is INSIDE the
+    # frame bytes, so a codec-side host-clock read made simulated flood
+    # traces nondeterministic.)
     # Async product code running under the (possibly virtual) loop.
     "node/node.py": {"asyncio.sleep("},
     "node/client.py": {"asyncio.sleep("},
@@ -51,6 +53,9 @@ ALLOWED: dict[str, set[str]] = {
     # scenario reports' wall_s — deliberate host-clock reads.
     "node/netsim.py": {"time.monotonic(", "asyncio.sleep("},
     "node/scenarios.py": {"time.monotonic(", "asyncio.sleep("},
+    # The chaos plane: same split as scenarios.py — sleeps are virtual,
+    # time.monotonic is the SimWallTimeout budget + report wall_s.
+    "node/chaos.py": {"time.monotonic(", "asyncio.sleep("},
     # Harness/tooling that drives REAL processes and sockets on the
     # host clock by design (subprocess meshes, soak drivers, operator
     # runners) — not part of the simulated node.
@@ -80,7 +85,11 @@ def _scan(path: Path) -> set[str]:
 
 
 def _product_files():
-    for sub in ("node", "chain"):
+    # mempool/ joined the covered set in round 11: pool admission
+    # stamps and TTL ages ride the node's injected clock now, so chaos
+    # schedules that crash/recover nodes see deterministic checkpoint
+    # ages — and stay that way.
+    for sub in ("node", "chain", "mempool"):
         for path in sorted((PKG / sub).glob("*.py")):
             yield f"{sub}/{path.name}", path
 

@@ -473,11 +473,18 @@ class TestCrashSoak:
 
 
 class TestNodeDegradation:
+    @pytest.mark.slow
     def test_enospc_degrades_serves_and_recovers(self, tmp_path):
         """End-to-end acceptance: a node whose disk fills mid-sync (a)
         enters degraded serve-only mode without dropping the peer
         connection, (b) still answers headers queries, and (c) resumes
-        persisting + catches back up once space returns."""
+        persisting + catches back up once space returns.
+
+        Slow smoke since round 11: the tier-1 copy of this e2e runs on
+        SimNet at PRODUCTION backoff deadlines in milliseconds of wall
+        time (tests/test_chaos.py TestStoreRecoverySim) — this socket
+        variant keeps the real-kernel path covered, same migration
+        pattern as the round-10 stall-failover port."""
 
         async def scenario():
             chain_blocks = make_blocks(10, difficulty=DIFF)
