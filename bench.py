@@ -298,6 +298,33 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Untrusted snapshot sync (round 12): seconds from a cold snapshot
+    # file to serving queries (benchmarks/snapshot_boot.py), with the
+    # batched-revalidation baseline from the SAME run — reported
+    # against the ONE recorded constant (perf_record.py
+    # RECORDED_SNAPSHOT_BOOT_S; LOWER is better, so vs_recorded > 1
+    # means slower than the record).
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_SNAPSHOT_BOOT_S,
+        SNAPSHOT_DEGRADED_FACTOR,
+    )
+
+    try:
+        from benchmarks.snapshot_boot import bench_quick as snap_quick
+
+        sb = snap_quick(blocks=800, repeats=3)
+        extra["snapshot_boot_s"] = sb["snapshot_boot_s"]
+        extra["snapshot_revalidate_s"] = sb["revalidate_boot_s"]
+        extra["snapshot_vs_recorded"] = round(
+            sb["snapshot_boot_s"] / RECORDED_SNAPSHOT_BOOT_S, 2
+        )
+        if sb["snapshot_boot_s"] > SNAPSHOT_DEGRADED_FACTOR * (
+            RECORDED_SNAPSHOT_BOOT_S
+        ):
+            extra["snapshot_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
 
     print(

@@ -19,6 +19,13 @@ from p1_tpu.chain.replay import (
     replay_native,
     replay_packed,
 )
+from p1_tpu.chain.snapshot import (
+    LedgerSnapshot,
+    SnapshotError,
+    load_snapshot,
+    state_root,
+    write_snapshot,
+)
 from p1_tpu.chain.store import ChainStore, save_chain
 from p1_tpu.chain.validate import ValidationError, check_block
 
@@ -28,7 +35,12 @@ __all__ = [
     "Chain",
     "ChainStore",
     "FilterIndex",
+    "LedgerSnapshot",
     "ProofCache",
+    "SnapshotError",
+    "load_snapshot",
+    "state_root",
+    "write_snapshot",
     "block_filter",
     "build_block_proofs",
     "matches_any",

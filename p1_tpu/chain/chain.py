@@ -58,6 +58,11 @@ from p1_tpu.core.retarget import RetargetRule
 from p1_tpu.chain.filters import FilterIndex
 from p1_tpu.chain.ledger import Ledger, LedgerError
 from p1_tpu.chain.proof import ProofCache, TxProof, build_block_proofs
+from p1_tpu.chain.snapshot import (
+    DEFAULT_CHECKPOINT_INTERVAL,
+    LedgerSnapshot,
+    state_root,
+)
 from p1_tpu.chain.validate import ValidationError, check_block
 
 
@@ -149,6 +154,34 @@ class Chain:
             ghash: _Entry(self.genesis, self.genesis.header, 0, 1 << difficulty)
         }
         self._tip_hash = ghash
+        #: Height of the chain's BASE block — genesis (0) normally, the
+        #: snapshot anchor block's height for an assumed chain built by
+        #: ``from_snapshot``.  Every height-indexed structure
+        #: (``_main_hashes``) is offset by it; nothing below the base is
+        #: indexed, so reorgs can never cross it.
+        self.base_height = 0
+        #: True for a chain whose base state came from an (untrusted)
+        #: snapshot rather than replayed history — the serving node's
+        #: ASSUMED validation state mirrors this until the flip.
+        self.assumed = False
+        #: State-root commitment cadence (chain/snapshot.py): a root of
+        #: the ledger state is recorded in ``state_checkpoints`` at every
+        #: multiple of this height interval as blocks apply — the
+        #: retarget window when one is active (the consensus-natural
+        #: cadence), DEFAULT_CHECKPOINT_INTERVAL on fixed-difficulty
+        #: chains.  ``checkpoint_extra`` adds ad-hoc watch heights (the
+        #: background revalidation pins the snapshot height there so the
+        #: divergence check reads an exact-height root regardless of
+        #: interval agreement between nodes).
+        self.checkpoint_interval = (
+            retarget.window if retarget is not None else DEFAULT_CHECKPOINT_INTERVAL
+        )
+        self.checkpoint_extra: set[int] = set()
+        #: height -> ledger state root at that height, maintained in
+        #: lockstep with the ledger (recorded on apply, popped on undo —
+        #: a reorg re-records the new branch's roots).  O(height /
+        #: interval) * 32 B; the snapshot plane's commitment surface.
+        self.state_checkpoints: dict[int, bytes] = {}
         #: Verify-once signature cache consulted by every ``check_block``
         #: this index runs (core/sigcache.py).  The process default by
         #: default; a Node wires its own instance in so admission-time
@@ -210,6 +243,52 @@ class Chain:
         #: bytes-bounded LRUs the node charges to its memory gauge.
         self.proof_cache = ProofCache()
         self.filter_index = FilterIndex()
+
+    @classmethod
+    def from_snapshot(
+        cls,
+        difficulty: int,
+        snap: LedgerSnapshot,
+        retarget: RetargetRule | None = None,
+    ) -> "Chain":
+        """An ASSUMED chain anchored on a verified snapshot: the index
+        holds exactly the anchor block, the ledger holds the snapshot
+        state, and everything below the base simply does not exist here
+        — new blocks extend the anchor, queries serve immediately, and
+        the real history is somebody else's (the background
+        revalidation's) problem until the flip.
+
+        Trust: ``snap`` passed chain/snapshot.py's integrity gates
+        (digests, root, anchor hash) — but the STATE is still only the
+        serving peer's claim; the caller owns tracking that (ASSUMED
+        vs VALIDATED, node/node.py).
+
+        Cumulative work below the base is unknowable without the
+        history, so the anchor's work is assumed at ``height + 1``
+        blocks of its own difficulty.  This only weighs fork choice
+        against branches attached below the base — which an assumed
+        chain cannot index anyway (their parents are unknown, they park
+        as orphans) — so the approximation is unobservable until the
+        flip replaces this chain wholesale.
+        """
+        chain = cls(difficulty, retarget=retarget)
+        block = snap.manifest.block
+        bhash = block.block_hash()
+        if snap.height < 1:
+            raise ValueError("snapshot base must be above genesis")
+        work = (snap.height + 1) * (1 << block.header.difficulty)
+        chain._index = {bhash: _Entry(block, block.header, snap.height, work)}
+        chain._tip_hash = bhash
+        chain.base_height = snap.height
+        chain.assumed = True
+        chain._main_hashes = [bhash]
+        chain._ledger = Ledger.restore(snap.balances, snap.nonces)
+        chain._tx_index = {tx.txid(): bhash for tx in block.txs}
+        chain._children = {}
+        # The snapshot's own claim IS the base checkpoint: background
+        # revalidation compares its replayed root against this height.
+        chain.state_checkpoints = {snap.height: snap.state_root}
+        return chain
 
     # -- queries ---------------------------------------------------------
 
@@ -347,8 +426,8 @@ class Chain:
         blocks = 0
         for h in reversed(self._main_hashes[-window:] if window else []):
             entry = self._index[h]
-            if entry.height == 0:
-                break  # genesis anchors, it does not sample
+            if entry.height <= self.base_height:
+                break  # the base block anchors, it does not sample
             blocks += 1
             fees.extend(
                 tx.fee for tx in self._block_at(h).txs if not tx.is_coinbase
@@ -424,11 +503,81 @@ class Chain:
         return self.filter_index.get_or_build(block_hash, self._block_at)
 
     def main_hash_at(self, height: int) -> bytes | None:
-        """The main-chain block hash at ``height`` (None above the tip)
-        — the filter-serving path's height → hash step."""
-        if 0 <= height < len(self._main_hashes):
-            return self._main_hashes[height]
+        """The main-chain block hash at ``height`` (None above the tip,
+        and None below an assumed chain's base — heights this index
+        simply does not hold) — the filter-serving path's height → hash
+        step."""
+        i = height - self.base_height
+        if 0 <= i < len(self._main_hashes):
+            return self._main_hashes[i]
         return None
+
+    # -- snapshot-state plane (chain/snapshot.py) -------------------------
+
+    def state_root(self) -> bytes:
+        """Merkle root of the ledger state at the current tip — the
+        canonical commitment chain/snapshot.py defines."""
+        return state_root(self._ledger._balances, self._ledger._nonces)
+
+    def _is_checkpoint(self, height: int) -> bool:
+        if height <= self.base_height:
+            return False
+        return (
+            height % self.checkpoint_interval == 0
+            or height in self.checkpoint_extra
+        )
+
+    def _ledger_apply(self, block: Block) -> None:
+        """Apply one block to the tip ledger, recording the state root
+        when the block lands on a checkpoint height — the ONE place
+        application happens, so the commitment can never miss a move."""
+        self._ledger.apply_block(block)
+        height = self._index[block.block_hash()].height
+        if self._is_checkpoint(height):
+            self.state_checkpoints[height] = state_root(
+                self._ledger._balances, self._ledger._nonces
+            )
+
+    def _ledger_undo(self, block: Block) -> None:
+        """Reverse one block, dropping any root recorded at its height
+        (a reorg onto another branch re-records through
+        ``_ledger_apply``)."""
+        self._ledger.undo_block(block)
+        self.state_checkpoints.pop(
+            self._index[block.block_hash()].height, None
+        )
+
+    def snapshot_state(
+        self,
+    ) -> tuple[int, Block, dict[str, int], dict[str, int], bytes] | None:
+        """Materialize the ledger state at the LATEST checkpoint height
+        — (height, anchor block, balances, nonces, state root) — by
+        rolling a ledger copy back from the tip (O(interval) undos; the
+        live ledger is untouched).  None when no checkpoint above the
+        base exists yet (too-short chains serve no snapshot).  This is
+        what GETSNAPSHOT serving and ``p1 snapshot create`` package."""
+        interval = self.checkpoint_interval
+        height = (self.height // interval) * interval
+        if height <= self.base_height:
+            return None
+        ledger = self._ledger.copy()
+        for h in range(self.height, height, -1):
+            ledger.undo_block(
+                self._block_at(self._main_hashes[h - self.base_height])
+            )
+        balances = ledger.snapshot()
+        nonces = ledger.nonces_snapshot()
+        root = state_root(balances, nonces)
+        recorded = self.state_checkpoints.get(height)
+        if recorded is not None and recorded != root:
+            # The incremental commitment and the rollback disagree —
+            # an internal invariant break, never peer input.
+            raise RuntimeError(
+                f"state root at checkpoint {height} diverged from the "
+                "recorded commitment"
+            )
+        block = self._block_at(self._main_hashes[height - self.base_height])
+        return height, block, balances, nonces, root
 
     def main_chain(self) -> Iterator[Block]:
         """Genesis-first iteration of the current best chain."""
@@ -446,16 +595,16 @@ class Chain:
         O(limit) per call: served straight from the height index instead of
         materializing the whole main chain (which made a full peer sync
         O(height²/batch))."""
-        start_height = 0
+        start_height = self.base_height
         for h in locator:
             entry = self._index.get(h)
             if entry and self._on_main_chain(h):
                 start_height = entry.height + 1
                 break
-        end = min(start_height + limit, len(self._main_hashes))
+        start = start_height - self.base_height
+        end = min(start + limit, len(self._main_hashes))
         return [
-            self._block_at(self._main_hashes[i])
-            for i in range(start_height, end)
+            self._block_at(self._main_hashes[i]) for i in range(start, end)
         ]
 
     # -- mutation --------------------------------------------------------
@@ -563,7 +712,7 @@ class Chain:
             candidate = self._block_at(self._tip_hash)
             if candidate.header.prev_hash == old_tip:
                 try:
-                    self._ledger.apply_block(candidate)
+                    self._ledger_apply(candidate)
                     return (), (candidate,)
                 except LedgerError as e:
                     self._mark_invalid_subtree(self._tip_hash, str(e))
@@ -571,12 +720,12 @@ class Chain:
         while self._tip_hash != old_tip:
             removed, added = self._reorg_paths(old_tip, self._tip_hash)
             for b in removed:
-                self._ledger.undo_block(b)
+                self._ledger_undo(b)
             applied: list[Block] = []
             failed: LedgerError | None = None
             for b in added:
                 try:
-                    self._ledger.apply_block(b)
+                    self._ledger_apply(b)
                 except LedgerError as e:
                     self._mark_invalid_subtree(b.block_hash(), str(e))
                     failed = e
@@ -587,9 +736,9 @@ class Chain:
             # Roll the ledger back to old_tip and re-run fork choice over
             # the remaining valid blocks.
             for b in reversed(applied):
-                self._ledger.undo_block(b)
+                self._ledger_undo(b)
             for b in reversed(removed):
-                self._ledger.apply_block(b)
+                self._ledger_apply(b)
             self._tip_hash = self._best_valid_tip()
         return (), ()
 
@@ -810,10 +959,8 @@ class Chain:
 
     def _on_main_chain(self, block_hash: bytes) -> bool:
         entry = self._index[block_hash]
-        return (
-            entry.height < len(self._main_hashes)
-            and self._main_hashes[entry.height] == block_hash
-        )
+        i = entry.height - self.base_height
+        return 0 <= i < len(self._main_hashes) and self._main_hashes[i] == block_hash
 
     def _reorg_paths(
         self, old_tip: bytes, new_tip: bytes

@@ -81,6 +81,35 @@ class Ledger:
         """Copy of all non-zero balances (JSON-ready, for status/CLI)."""
         return {a: v for a, v in self._balances.items() if v}
 
+    def nonces_snapshot(self) -> dict[str, int]:
+        """Copy of all non-zero account nonces — the other half of the
+        consensus state a snapshot (chain/snapshot.py) must carry: a
+        snapshot that restored balances but forgot nonces would re-open
+        every confirmed authorization for replay."""
+        return {a: n for a, n in self._nonces.items() if n}
+
+    def copy(self) -> "Ledger":
+        """Independent copy of the full state — what checkpoint-state
+        materialization rolls back (``Chain.snapshot_state``) without
+        touching the live tip ledger."""
+        dup = Ledger()
+        dup._balances = dict(self._balances)
+        dup._nonces = dict(self._nonces)
+        return dup
+
+    @classmethod
+    def restore(
+        cls, balances: dict[str, int], nonces: dict[str, int]
+    ) -> "Ledger":
+        """A ledger seeded from externally supplied state (a verified
+        snapshot).  Zero entries are dropped on the way in so the
+        invariant ``_shift`` maintains (no zero-valued keys) holds from
+        the first block applied."""
+        ledger = cls()
+        ledger._balances = {a: v for a, v in balances.items() if v}
+        ledger._nonces = {a: n for a, n in nonces.items() if n}
+        return ledger
+
     def apply_block(self, block: Block) -> None:
         """Credit/debit ``block``'s transactions; all-or-nothing.
 

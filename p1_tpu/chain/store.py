@@ -517,14 +517,43 @@ class ChainStore:
         carries the chain difficulty), or None for an empty store —
         the streaming-resume path's pre-check, which must not
         materialize the block list just to read one header field."""
+        header = self.first_header()
+        return None if header is None else header.difficulty
+
+    def first_header(self) -> BlockHeader | None:
+        """The first stored record's header (None for an empty store).
+        The snapshot-resume path's linkage probe: a store whose first
+        record is (or extends) genesis resumes normally, one whose
+        records hang off a snapshot anchor needs the sidecar
+        (node/node.py ``_try_snapshot_resume``)."""
         if not self.path.exists():
             return None
         data = self._read_checked()
         for off, _ in self._record_spans(data):
-            return BlockHeader.deserialize(
-                data[off : off + HEADER_SIZE]
-            ).difficulty
+            return BlockHeader.deserialize(data[off : off + HEADER_SIZE])
         return None
+
+    def reindex_spans(self) -> int:
+        """Rebuild the body-span index from the CURRENT file contents —
+        required after an in-place rewrite replaced the inode under the
+        held writer lock (the snapshot plane's flip transition): the old
+        spans point into a dead inode, and serving a refetch from them
+        would be an offset lottery.  Block hashes come straight from the
+        80-byte header slices (block id = header SHA-256d), so the
+        rebuild costs no full-record parses."""
+        from p1_tpu.core.hashutil import sha256d
+
+        self._body_spans.clear()
+        if self._read_fd is not None:
+            os.close(self._read_fd)  # points at the replaced inode
+            self._read_fd = None
+        if not self.path.exists():
+            return 0
+        data = self._read_checked()
+        for off, n in self._record_spans(data):
+            bhash = sha256d(data[off : off + HEADER_SIZE])
+            self._body_spans[bhash] = (off << _SPAN_SHIFT) | n
+        return len(self._body_spans)
 
     # -- body refetch (memory-bounded operation) ---------------------------
 

@@ -487,6 +487,33 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     p = sub.add_parser(
+        "snapshot",
+        help="ledger-state snapshots (chain/snapshot.py): create one "
+        "from a chain store's latest checkpoint, verify a snapshot "
+        "file's integrity (manifest, chunk digests, state root), or "
+        "print its manifest; exit 0 clean / 1 salvageable issue / 2 "
+        "unrecoverable",
+    )
+    p.add_argument(
+        "action",
+        choices=["create", "verify", "info"],
+        help="create: --store -> --file; verify/info: --file",
+    )
+    p.add_argument("--store", default=None, help="chain store (create)")
+    p.add_argument(
+        "--file", default=None, help="snapshot file path (all actions)"
+    )
+    p.add_argument(
+        "--interval",
+        type=int,
+        default=0,
+        help="checkpoint interval override for create (0 = the chain "
+        "default: the retarget window, else 64); the snapshot lands on "
+        "the latest multiple at or below the store's tip",
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
         "serve",
         help="read-only replica worker(s): serve headers/filters/proof "
         "queries from a chain store over mmap, WITHOUT the writer lock "
@@ -1456,6 +1483,18 @@ def cmd_fsck(args) -> int:
     return run_fsck(args.store, args.out)
 
 
+def cmd_snapshot(args) -> int:
+    from p1_tpu.chain.tooling import run_snapshot
+
+    return run_snapshot(
+        args.action,
+        args.store,
+        args.file,
+        interval=args.interval,
+        retarget=_retarget_rule(args),
+    )
+
+
 def cmd_serve(args) -> int:
     """Read-only replica worker(s) over a chain store (`p1 serve`).
 
@@ -1723,6 +1762,7 @@ def main(argv=None) -> int:
         "balances": cmd_balances,
         "compact": cmd_compact,
         "fsck": cmd_fsck,
+        "snapshot": cmd_snapshot,
         "serve": cmd_serve,
         "pod": cmd_pod,
         "net": cmd_net,

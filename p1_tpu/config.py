@@ -117,6 +117,28 @@ class NodeConfig:
     #: byte-for-byte.  Never affects consensus: identity and jitter
     #: only.
     rng_seed: int | None = None
+    #: Untrusted snapshot sync (chain/snapshot.py, the assumeUTXO
+    #: analog).  When True, a FRESH node (height 0) whose peer
+    #: advertises a tip at least ``snapshot_min_lead`` blocks ahead
+    #: fetches a ledger-state snapshot instead of replaying history:
+    #: it verifies the manifest/chunk digests/state root, starts
+    #: serving queries immediately in the ASSUMED validation state, and
+    #: revalidates the real history in the background — flipping to
+    #: fully-validated on a matching state root, or quarantining the
+    #: snapshot, demoting the serving peer, and falling back to genesis
+    #: IBD on any divergence.  Off by default: assumed state is a trust
+    #: posture an operator must opt into.
+    snapshot_sync: bool = False
+    #: Minimum advertised-height lead before a snapshot is preferred
+    #: over ordinary IBD (a snapshot round trip is pointless for a
+    #: nearly caught-up peer).
+    snapshot_min_lead: int = 4
+    #: State-root checkpoint spacing override (0 = the chain default:
+    #: the retarget window when one is active, else
+    #: chain/snapshot.py DEFAULT_CHECKPOINT_INTERVAL).  Must agree
+    #: across nodes for served snapshot heights to line up with what
+    #: joiners can revalidate; it is a policy knob, never consensus.
+    snapshot_interval: int = 0
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

@@ -116,3 +116,21 @@ RECORDED_CHAOS_RATE = 9.9
 #: Same-session degraded threshold; as co-tenant-sensitive as the sim
 #: figure (same pure-Python event-loop substrate).
 CHAOS_DEGRADED_FRACTION = 0.4
+
+#: Untrusted snapshot sync (round 12): seconds from a cold snapshot
+#: file to SERVING queries — load + CRC/digest/state-root verification
+#: + ``Chain.from_snapshot`` + the first balance/header/proof answers —
+#: on the bench probe shape (benchmarks/snapshot_boot.py
+#: ``bench_quick``: 2,000 blocks, ~1k accounts; the figure is
+#: O(accounts), chain length barely moves it: the full 100k-block run
+#: measured the SAME 0.004 s against a 17.4 s batched revalidation in
+#: the same session — docs/PERF.md "Snapshot boot").  Measured
+#: 2026-08-04 on the 1-vCPU bench host at 1-minute loadavg 0.44.
+#: LOWER is better — ``bench.py`` emits ``snapshot_vs_recorded`` =
+#: measured / recorded, flagged degraded above the factor below.
+RECORDED_SNAPSHOT_BOOT_S = 0.004
+
+#: Factor over the recorded boot time above which the measurement is
+#: flagged degraded (generous: the figure is milliseconds, so absolute
+#: jitter is a large relative band).
+SNAPSHOT_DEGRADED_FACTOR = 5.0

@@ -70,6 +70,15 @@ __all__ = [
 #: Repro artifact format tag (bump on layout change).
 REPRO_FORMAT = "p1-chaos-repro-1"
 
+#: Snapshot-sync joiners a schedule may spawn (node indices n_nodes..):
+#: enough to compose join + crash + liar interactions, small enough to
+#: keep tier-1 sweep runtimes flat.
+MAX_JOINERS = 2
+#: Checkpoint spacing every chaos node runs with: small enough that the
+#: warmup + a couple of mine events cross a checkpoint, so joiners get
+#: real snapshots to boot from inside short schedules.
+SNAPSHOT_INTERVAL = 4
+
 #: Test-only injectable bugs, each a known-broken recovery behavior the
 #: shrinker acceptance proof seeds deliberately (never reachable from
 #: production config — only the ``--inject-bug`` flag threads them):
@@ -121,9 +130,21 @@ def generate_schedule(
     - ``slow_link`` / ``restore_link`` — latency/jitter/loss spike on
       every link of one host;
     - ``hostile`` — a HostilePeer (stale or swallowed sync replies)
-      dials a victim; ``flood`` — a GreedyPeer protocol-valid flood.
+      dials a victim; ``flood`` — a GreedyPeer protocol-valid flood;
+    - ``snap_join`` — a fresh snapshot-syncing node (``snapshot_sync``)
+      joins the mesh mid-schedule: it boots ASSUMED from whatever
+      snapshot a peer serves (or falls back to IBD) and must flip to
+      fully-validated by quiesce.  Joiners live at indices >=
+      ``n_nodes`` and are crash/recover/corrupt-eligible like everyone
+      else — which is exactly how crash-during-snapshot-download and
+      crash-during-background-revalidation compose into schedules;
+    - ``snap_liar`` — a hostile SNAPSHOT SERVER (lying balances, a
+      corrupted root, a truncated chunk stream, or a full stall) plus a
+      joiner that dials it first and an honest node second: the joiner
+      must detect/contain the lie and still converge.
     """
     rng = random.Random((seed << 3) ^ 0xC4A05)
+    joiners: set[int] = set()
     times = sorted(
         round(rng.uniform(0.5, horizon_vs), 3) for _ in range(n_events)
     )
@@ -157,6 +178,9 @@ def generate_schedule(
         if hostiles < 2:
             ops.append(("hostile", 0.75))
             ops.append(("flood", 0.5))
+        if len(joiners) < MAX_JOINERS:
+            ops.append(("snap_join", 1.0))
+            ops.append(("snap_liar", 0.75))
         op = rng.choices([o for o, _ in ops], [w for _, w in ops])[0]
         ev: dict = {"at": at, "op": op}
         if op == "mine":
@@ -164,8 +188,22 @@ def generate_schedule(
         elif op == "tx":
             ev["amount"] = rng.randrange(1, 5)
             ev["fee"] = rng.randrange(0, 3)
+        elif op == "snap_join":
+            slot = n_nodes + len(joiners)
+            ev["node"] = slot
+            ev["peers"] = sorted(rng.sample(range(n_nodes), min(2, n_nodes)))
+            joiners.add(slot)
+        elif op == "snap_liar":
+            slot = n_nodes + len(joiners)
+            ev["node"] = slot
+            ev["peers"] = [rng.randrange(n_nodes)]
+            ev["fault"] = rng.choice(("balance", "root", "truncate", "stall"))
+            ev["height"] = rng.choice((8, 12))
+            joiners.add(slot)
+            hostiles += 1
         elif op == "crash":
-            victims = [i for i in range(n_nodes) if i not in crashed]
+            universe = [*range(n_nodes), *sorted(joiners)]
+            victims = [i for i in universe if i not in crashed]
             ev["node"] = rng.choice(victims)
             # 0 = clean kill; >0 seeds the torn-append offset.
             ev["torn"] = rng.choice((0, 0, rng.randrange(1, 1 << 16)))
@@ -324,7 +362,17 @@ class _ChaosRunner:
         self.inject_bug = inject_bug
         self.settle_vs = settle_vs
         self.wall_limit_s = wall_limit_s
-        self.hosts = [net.host_name(i) for i in range(n_nodes)]
+        # Base mesh hosts, then the (lazily spawned) snapshot-joiner
+        # slots — one flat list so every schedule index resolves the
+        # same way whether it names a founder or a joiner.
+        self.hosts = [net.host_name(i) for i in range(n_nodes)] + [
+            f"10.99.0.{k}" for k in range(MAX_JOINERS)
+        ]
+        self.joiner_hosts = self.hosts[n_nodes:]
+        #: (host, height, tip hash, wallet balance) reported by joiners
+        #: WHILE in the ASSUMED state — checked against the validated
+        #: history at quiesce (the never-contradicted invariant).
+        self.samples: list[tuple] = []
         # Deterministic wallet: node 0 mines to this account, so its
         # spends are funded the moment the warmup blocks land.
         self.wallet = Keypair.from_seed_text(f"p1-chaos-{net.seed}")
@@ -476,6 +524,10 @@ class _ChaosRunner:
             self._record("hostile", victim, ev["fault"])
             await hp.dial(victim, NODE_PORT)
             self.actors.append(hp)
+        elif op == "snap_join":
+            await self._snap_join(ev)
+        elif op == "snap_liar":
+            await self._snap_join(ev, fault=ev["fault"])
         elif op == "flood":
             from p1_tpu.node.testing import FloodPlan, GreedyPeer, make_blocks
 
@@ -498,6 +550,52 @@ class _ChaosRunner:
             await gp.start(victim, NODE_PORT)
             self.actors.append(gp)
         self.counts["applied"] += 1
+
+    async def _snap_join(self, ev: dict, fault: str | None = None) -> None:
+        """Spawn one snapshot-syncing joiner (op ``snap_join``), or one
+        joiner whose FIRST peer is a hostile snapshot server running the
+        scheduled pathology (op ``snap_liar``).  Idempotent per slot so
+        schedule subsets stay runnable."""
+        host = self.hosts[ev["node"]]
+        net = self.net
+        if host in net.nodes or host in net.crashed:
+            return
+        peers = []
+        if fault is not None:
+            from p1_tpu.node.protocol import MsgType
+            from p1_tpu.node.testing import FaultPlan, HostilePeer, make_blocks
+
+            if fault in ("balance", "root"):
+                plan = FaultPlan(snapshot_lie=fault)
+            elif fault == "truncate":
+                plan = FaultPlan(snapshot_chunks=1)
+            else:  # "stall": a server that never answers GETSNAPSHOT
+                plan = FaultPlan(swallow=frozenset({MsgType.GETSNAPSHOT}))
+            src = f"66.9.0.{len(self.actors)}"
+            liar = HostilePeer(
+                make_blocks(
+                    ev["height"], self.difficulty, miner_id=f"snapliar-{src}"
+                ),
+                plan=plan,
+                transport=net.net.host(src),
+                host=src,
+                rng=random.Random(net.seed * 107 + len(self.actors)),
+            )
+            await liar.start()
+            self.actors.append(liar)
+            peers.append(f"{src}:{liar.port}")
+        for p in ev.get("peers", ()):
+            alive = self._alive(p)
+            if alive is not None and alive not in peers:
+                peers.append(alive)
+        self._record("snap_join", host, fault or "honest")
+        await net.add_node(
+            name=host,
+            peers=peers,
+            snapshot_sync=True,
+            snapshot_min_lead=2,
+            snapshot_interval=SNAPSHOT_INTERVAL,
+        )
 
     def _restore_link(self, host: str) -> None:
         if host not in self.slowed:
@@ -537,14 +635,19 @@ class _ChaosRunner:
         # Preamble: backbone + one seeded extra edge, node 0's coinbase
         # pinned to the funded wallet, two warmup blocks everywhere.
         topo = random.Random(net.seed ^ 0x70B0C4)
-        for i, host in enumerate(self.hosts):
+        for i, host in enumerate(self.hosts[: self.n]):
             peers = []
             if i > 0:
                 peers.append(self.hosts[i - 1])
                 if i > 2:
                     peers.append(self.hosts[topo.randrange(i - 1)])
             kwargs = {"miner_id": self.wallet.account} if i == 0 else {}
-            await net.add_node(name=host, peers=peers, **kwargs)
+            await net.add_node(
+                name=host,
+                peers=peers,
+                snapshot_interval=SNAPSHOT_INTERVAL,
+                **kwargs,
+            )
         assert await net.run_until(
             net.links_up, 60, step=0.25, wall_limit_s=self.wall_limit_s
         ), "chaos mesh never formed"
@@ -565,6 +668,7 @@ class _ChaosRunner:
             if target > net.clock.now:
                 await asyncio.sleep(target - net.clock.now)
             await self._apply(ev)
+            self._sample_assumed()
 
         # Epilogue: clear EVERY fault, deterministically, then settle.
         for actor in self.actors:
@@ -609,7 +713,13 @@ class _ChaosRunner:
             await net.mine_on(net.nodes[settle_host])
         converged = await net.run_until(
             lambda: net.converged()
-            and len(set(net.heights())) == 1,
+            and len(set(net.heights())) == 1
+            # Snapshot joiners owe a finished verdict: ASSUMED must have
+            # resolved — flip or quarantine+fallback — by quiesce.
+            and all(
+                n.validation_state == "validated"
+                for n in net.nodes.values()
+            ),
             self.settle_vs / 2,
             step=0.25,
             wall_limit_s=self.wall_limit_s,
@@ -651,8 +761,18 @@ class _ChaosRunner:
                         "(verdict 2) at reboot",
                     }
                 )
+        for host, node in net.nodes.items():
+            if node.validation_state != "validated":
+                violations.append(
+                    {
+                        "invariant": "assumed",
+                        "detail": f"{host} still in the ASSUMED state at "
+                        "quiesce (revalidation never resolved)",
+                    }
+                )
         violations.extend(self._check_pools())
         violations.extend(self._check_caches())
+        violations.extend(self._check_assumed_samples())
 
         heights = net.heights()
         report = {
@@ -673,7 +793,10 @@ class _ChaosRunner:
         # Shutdown verdicts AFTER the stores closed cleanly: whatever
         # the schedule inflicted, what reaches disk must stay loadable.
         for host in self.hosts:
-            path = net.configs[host].store_path
+            config = net.configs.get(host)
+            if config is None:
+                continue  # a joiner slot this schedule never spawned
+            path = config.store_path
             if path and fsck_verdict(path) > 1:
                 report["violations"].append(
                     {
@@ -683,6 +806,59 @@ class _ChaosRunner:
                 )
         report["trace_digest"] = net.trace_digest()
         return report
+
+    def _sample_assumed(self) -> None:
+        """Record every ASSUMED joiner's answer to "what is the wallet's
+        balance at your tip?" — the claims the flip must never have let
+        a fully-validated node contradict."""
+        for host in self.joiner_hosts:
+            node = self.net.nodes.get(host)
+            if node is None or node.validation_state != "assumed":
+                continue
+            self.samples.append(
+                (
+                    host,
+                    node.chain.height,
+                    node.chain.tip_hash,
+                    node.chain.balance(self.wallet.account),
+                )
+            )
+
+    def _check_assumed_samples(self) -> list[dict]:
+        """The snapshot invariant: for every joiner that FLIPPED (its
+        snapshot was confirmed honest), every balance it reported while
+        ASSUMED must match what the validated history says at the same
+        block.  Joiners that diverged made no claim that survived — the
+        quarantine retracted their state wholesale."""
+        from p1_tpu.chain.ledger import balances as audit_balances
+
+        out = []
+        account = self.wallet.account
+        for host, height, tip_hash, reported in self.samples:
+            node = self.net.nodes.get(host)
+            if node is None or node.metrics.snapshot_flips == 0:
+                continue
+            for ref_host, ref in self.net.nodes.items():
+                if ref_host == host or ref.chain.base_height != 0:
+                    continue
+                if ref.chain.main_hash_at(height) != tip_hash:
+                    continue  # sampled tip reorged away: no surviving claim
+                blocks = [
+                    ref.chain._block_at(ref.chain.main_hash_at(h))
+                    for h in range(height + 1)
+                ]
+                truth = audit_balances(blocks).get(account, 0)
+                if truth != reported:
+                    out.append(
+                        {
+                            "invariant": "assumed-balance",
+                            "detail": f"{host} reported {reported} for the "
+                            f"wallet at height {height} while ASSUMED; the "
+                            f"validated chain says {truth}",
+                        }
+                    )
+                break
+        return out
 
     def _check_pools(self) -> list[dict]:
         """No crash-restart (or reorg) may resurrect a transaction the

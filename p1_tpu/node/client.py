@@ -404,6 +404,71 @@ async def get_filters(
     return await asyncio.wait_for(_run(), timeout)
 
 
+async def get_snapshot(
+    host: str,
+    port: int,
+    difficulty: int,
+    timeout: float = 60.0,
+    retarget=None,
+    out_path=None,
+):
+    """Fetch the node's current state snapshot (chain/snapshot.py):
+    manifest first, then chunk ranges, each chunk verified against its
+    manifest digest AS IT ARRIVES and the state root checked at the end
+    — the same incremental integrity contract the node's own snapshot
+    boot applies.  Returns a fully verified ``LedgerSnapshot`` (or None
+    when the peer serves no snapshot); ``out_path`` additionally writes
+    the CRC-framed snapshot file.  The STATE is still only the serving
+    peer's claim — only replaying the history proves it (the trust
+    model `p1 snapshot` prints)."""
+    from p1_tpu.chain import snapshot as chain_snapshot
+
+    async def _run():
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+
+            async def _reply():
+                while True:
+                    mtype, body = await _read_msg(reader, writer)
+                    if mtype is MsgType.SNAPSHOT:
+                        return body
+
+            await protocol.write_frame(writer, protocol.encode_getsnapshot(0, 0))
+            body = await _reply()
+            if body[0] == "none":
+                return None
+            if body[0] != "manifest":
+                raise ValueError("peer answered chunks before the manifest")
+            manifest_payload = body[1]
+            manifest = chain_snapshot.parse_manifest(manifest_payload)
+            chunks: list[bytes] = []
+            while len(chunks) < len(manifest.chunk_digests):
+                await protocol.write_frame(
+                    writer, protocol.encode_getsnapshot(len(chunks), 8)
+                )
+                body = await _reply()
+                if body[0] != "chunks" or body[1] != len(chunks) or not body[2]:
+                    raise ValueError("bad SNAPSHOT chunk range from peer")
+                for payload in body[2]:
+                    i = len(chunks)
+                    if (
+                        i >= len(manifest.chunk_digests)
+                        or chain_snapshot.chunk_digest(payload)
+                        != manifest.chunk_digests[i]
+                    ):
+                        raise ValueError(f"chunk {i} fails its manifest digest")
+                    chunks.append(payload)
+            snap = chain_snapshot.assemble(manifest, chunks)
+            if out_path is not None:
+                chain_snapshot.write_snapshot(out_path, manifest_payload, chunks)
+            return snap
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def filter_scan(
     host: str,
     port: int,

@@ -24,11 +24,14 @@ import collections
 import dataclasses
 import json
 import logging
+import os
 import random
 from pathlib import Path
 
 from p1_tpu.chain import AddResult, AddStatus, Chain, ChainStore
-from p1_tpu.chain.validate import preverify_signatures
+from p1_tpu.chain import snapshot as chain_snapshot
+from p1_tpu.chain.snapshot import SnapshotError
+from p1_tpu.chain.validate import ValidationError, preverify_signatures
 from p1_tpu.config import NodeConfig
 from p1_tpu.core import keys
 from p1_tpu.core.block import Block, merkle_root
@@ -123,6 +126,23 @@ MAX_TRACKED_HOSTS = 4096
 #: clock drift at any plausible block count, decades under any attack
 #: anchor worth mounting.
 ANCHOR_SLACK_S = 30 * 86_400
+#: Snapshot chunks per SNAPSHOT reply (server cap AND client ask size):
+#: 8 chunks x ~110 KB worst case stays far under MAX_FRAME while keeping
+#: a multi-million-account transfer to a few hundred round trips.
+SNAPSHOT_BATCH = 8
+#: Manifest chunk-count cap a fetching node will accept: bounds the
+#: worst-case snapshot RAM a hostile manifest can commit us to
+#: (4096 chunks x 4096 accounts = ~16M accounts) before any chunk bytes
+#: arrive.
+SNAPSHOT_MAX_CHUNKS = 4096
+
+#: Validation states (the snapshot plane's trust posture, surfaced in
+#: ``status()["snapshot"]``).  ASSUMED = serving state that came from a
+#: verified-but-untrusted snapshot while the real history revalidates in
+#: the background; VALIDATED = every block behind the tip was fully
+#: validated by this node.
+VALIDATED = "validated"
+ASSUMED = "assumed"
 
 
 class _Refused(Exception):
@@ -151,6 +171,7 @@ _MSG_CLASS = {
     MsgType.GETBLOCKTXN: CLASS_QUERIES,
     MsgType.GETSTATUS: CLASS_QUERIES,
     MsgType.GETFILTERS: CLASS_QUERIES,
+    MsgType.GETSNAPSHOT: CLASS_QUERIES,
 }
 
 #: Frames dropped while the node is in the SHED overload state.
@@ -168,6 +189,9 @@ _SHED_DROPS = frozenset(
         MsgType.GETACCOUNT,
         MsgType.GETADDR,
         MsgType.ADDR,
+        # Snapshot serving is a pure capacity consumer (a joiner can
+        # retry any peer later); under SHED it goes quiet with the rest.
+        MsgType.GETSNAPSHOT,
     }
 )
 
@@ -232,6 +256,23 @@ class NodeMetrics:
     proofs_served: int = 0
     filters_served: int = 0
     filter_bytes_served: int = 0
+    #: Untrusted snapshot sync (round 12, chain/snapshot.py).
+    #: ``snapshot_fetches`` counts snapshot downloads this node STARTED
+    #: (as a joiner); ``snapshot_chunks_served`` what it served to
+    #: others; ``snapshot_flips`` ASSUMED→VALIDATED transitions after a
+    #: matching background revalidation; ``snapshot_divergences`` lies
+    #: caught (root/hash mismatch — the snapshot is quarantined and the
+    #: server demoted); ``snapshot_fallbacks`` falls back to genesis IBD
+    #: (every divergence is also a fallback); ``snapshot_stalls``
+    #: supervised snapshot/revalidation rounds that timed out;
+    #: ``revalidated_blocks`` history replayed by the background lane.
+    snapshot_fetches: int = 0
+    snapshot_chunks_served: int = 0
+    snapshot_flips: int = 0
+    snapshot_divergences: int = 0
+    snapshot_fallbacks: int = 0
+    snapshot_stalls: int = 0
+    revalidated_blocks: int = 0
     #: Rolling window of block propagation delays (peer's gossip send ->
     #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
     #: round-trips".  Bounded so a long-lived node's memory is too.
@@ -269,6 +310,20 @@ class _PendingCompact:
     #: waiting on the FIFO cap — a peer that never answers must not be
     #: able to delay a pushed block by squatting the pending slot.
     asked_at: float = 0.0
+
+
+@dataclasses.dataclass
+class _SnapshotFetch:
+    """One in-flight snapshot download (manifest, then chunk ranges).
+    Everything verifies incrementally: the manifest's anchor block
+    before any chunk is asked for, each chunk's digest the moment it
+    lands.  Purely in-RAM — a crash mid-transfer loses it and the next
+    boot simply starts over (the normal-resume recovery contract)."""
+
+    peer: "_Peer"
+    asked_at: float
+    manifest: "chain_snapshot.Manifest | None" = None
+    chunks: list = dataclasses.field(default_factory=list)
 
 
 class _Peer:
@@ -389,6 +444,30 @@ class Node:
         #: fork-choice machinery is actually exercised at network level.
         self.miner_id = config.miner_id or tag
         self.chain = Chain(config.difficulty, retarget=config.retarget_rule())
+        if config.snapshot_interval > 0:
+            self.chain.checkpoint_interval = config.snapshot_interval
+        #: Snapshot plane (chain/snapshot.py, round 12).  The node's
+        #: trust posture: VALIDATED until a snapshot boot, ASSUMED from
+        #: snapshot adoption until the background revalidation either
+        #: reproduces the snapshot's state root (flip to VALIDATED) or
+        #: catches it lying (quarantine + fall back to genesis IBD —
+        #: also VALIDATED, of the honest chain built so far).
+        self.validation_state = VALIDATED
+        self._snap_fetch: _SnapshotFetch | None = None
+        #: Manifest of the ADOPTED snapshot (None unless ASSUMED) and
+        #: the host that served it (divergence blames it).
+        self._snap_meta = None
+        self._snap_source: str | None = None
+        #: Background revalidation: a second, genesis-anchored Chain
+        #: replaying the real history through the batched-signature
+        #: lane while the assumed chain serves.  None unless ASSUMED.
+        self._bg_chain: Chain | None = None
+        self._bg_last_staller: _Peer | None = None
+        #: Served-snapshot cache: ((height, block hash), (manifest
+        #: payload, chunk payloads), bytes) for the latest checkpoint —
+        #: rebuilt lazily when the checkpoint moves, charged to the
+        #: memory gauge.
+        self._snapshot_cache = None
         #: Verify-once signature cache (core/sigcache.py): ONE instance
         #: shared by this node's mempool admission and its chain's block
         #: validation, so a transfer verified at relay/admission connects
@@ -486,6 +565,17 @@ class Node:
             clock=self.clock.monotonic,
             rng=self._rng,
         )
+        #: Supervision of the background revalidation fetch (its own
+        #: supervisor: the assumed chain's tip sync and the history
+        #: replay are independent jobs with independent stall blame).
+        self._bg_sup = RequestSupervisor(
+            stall_timeout_s=config.sync_stall_timeout_s or 10.0,
+            attempts_max=config.sync_attempts_max,
+            backoff_base_s=config.sync_backoff_base_s,
+            backoff_max_s=config.sync_backoff_max_s,
+            clock=self.clock.monotonic,
+            rng=self._rng,
+        )
         #: Set when a batch-synced block (gossip=False — locator sync,
         #: orphan backfill) moved our tip: the catch-up path never
         #: re-gossips individual blocks (a 500-block IBD must not push
@@ -573,6 +663,17 @@ class Node:
             if self.config.store_path
             else None
         )
+
+    def _snapshot_path(self):
+        """The snapshot sidecar next to the store: present exactly while
+        the node is (or crashed while) in the ASSUMED state — a resume
+        that finds it boots from the snapshot again and restarts the
+        background revalidation; the flip deletes it."""
+        if self.store is not None:
+            return Path(f"{self.store.path}.snapshot")
+        if self.config.store_path:
+            return Path(f"{self.config.store_path}.snapshot")
+        return None
 
     def _load_mempool(self) -> None:
         """Resume the pending pool (Bitcoin's mempool.dat analog): every
@@ -708,13 +809,90 @@ class Node:
         except OSError as e:
             log.warning("could not persist address book %s: %s", path, e)
 
+    def _try_snapshot_resume(self) -> bool:
+        """Resume a node that crashed (or stopped) in the ASSUMED state:
+        the ``.snapshot`` sidecar holds the verified snapshot, the store
+        holds only snapshot-descendant records.  Returns True when the
+        assumed chain was rebuilt (the caller skips the genesis resume).
+
+        Robustness cases, all exercised by the chaos plane:
+
+        - flip completed but crashed before the sidecar unlink: the
+          store's first record connects from genesis — the sidecar is
+          stale; delete it and take the normal resume;
+        - sidecar unreadable/corrupt (bit-rot while down): quarantine it
+          and fall through to the normal resume with ``orphans_ok`` (the
+          snapshot-descendant records park as orphans and ordinary IBD
+          rebuilds from peers) — never a refused boot;
+        - the normal case: rebuild the assumed chain from the sidecar,
+          replay the store's post-snapshot records onto it, restart the
+          background revalidation."""
+        snap_path = self._snapshot_path()
+        if snap_path is None or not snap_path.exists():
+            return False
+        ghash = self.chain.genesis.block_hash()
+        first = self.store.first_header()
+        if first is not None and (
+            first.block_hash() == ghash or first.prev_hash == ghash
+        ):
+            # The flip's store rewrite landed; only the unlink is owed.
+            log.info("stale snapshot sidecar after a completed flip — removing")
+            snap_path.unlink()
+            return False
+        try:
+            snap = chain_snapshot.load_snapshot(snap_path)
+        except (OSError, SnapshotError) as e:
+            log.error(
+                "snapshot sidecar unreadable (%s) — quarantining; booting "
+                "via ordinary IBD",
+                e,
+            )
+            try:
+                os.replace(
+                    snap_path, snap_path.with_name(snap_path.name + ".quarantine")
+                )
+            except OSError:
+                pass
+            self._orphans_ok_boot = True
+            return False
+        chain = Chain.from_snapshot(
+            self.config.difficulty, snap, retarget=self.config.retarget_rule()
+        )
+        chain.sig_cache = self.sig_cache
+        if self.config.snapshot_interval > 0:
+            chain.checkpoint_interval = self.config.snapshot_interval
+        anchor = snap.block_hash
+        for block in self.store.load_blocks():
+            if block.block_hash() == anchor:
+                continue
+            # The node's own flocked log of blocks it validated while
+            # ASSUMED: the same trusted-resume contract as the genesis
+            # path (contextual rules + ledger still run).
+            chain.add_block(block, trusted=True)
+        self.chain = chain
+        self.validation_state = ASSUMED
+        self._snap_meta = snap.manifest
+        if self.config.body_cache_blocks > 0:
+            chain.body_source = self.store
+        log.warning(
+            "resumed in ASSUMED state from snapshot at height %d "
+            "(tip %d) — background revalidation restarting",
+            snap.height,
+            chain.height,
+        )
+        return True
+
     async def start(self) -> None:
         self._load_addr_book()
+        self._orphans_ok_boot = False
         if self.store is not None:
             # Hold the store's writer lock for the node's whole lifetime
             # (not just from the first append): a second node on the same
             # store, or a compaction while we run, must fail loudly.
             self.store.acquire()
+            if self._try_snapshot_resume():
+                self._load_mempool()
+                return await self._start_services()
             body_cache = self.config.body_cache_blocks
             if body_cache > 0:
                 # Memory-bounded resume: never materialize the whole
@@ -769,11 +947,21 @@ class Node:
                     # orphans and the ordinary locator sync backfills
                     # the gap — refusing to boot here bricked crash
                     # recovery (found by the chaos sweep, node/chaos.py).
-                    orphans_ok=self.store.healed["quarantined_records"] > 0,
+                    # Same relaxation when a quarantined SNAPSHOT sidecar
+                    # left the store holding snapshot-descendant records
+                    # with no genesis linkage (_try_snapshot_resume).
+                    orphans_ok=self.store.healed["quarantined_records"] > 0
+                    or self._orphans_ok_boot,
                 )
             except ValueError as e:
                 self.store.close()
                 raise RuntimeError(str(e)) from e
+            if self.config.snapshot_interval > 0:
+                # The resume built a fresh Chain; re-apply the
+                # checkpoint-cadence override (roots recorded at the
+                # default cadence during the load stay — they are valid
+                # commitments, just differently spaced).
+                self.chain.checkpoint_interval = self.config.snapshot_interval
             if body_cache > 0:
                 # Keep evicting as the chain grows past resume (the
                 # governor loop sweeps; the source survives the resume).
@@ -786,6 +974,12 @@ class Node:
                 )
             # After the chain: admission validates against the ledger.
             self._load_mempool()
+        await self._start_services()
+
+    async def _start_services(self) -> None:
+        """Everything after chain/mempool resume: the listener and the
+        background loops — one tail shared by the genesis and snapshot
+        resume paths."""
         self._running = True
         self._server = await self.transport.listen(
             self._on_inbound, self.config.host, self.config.port
@@ -814,6 +1008,10 @@ class Node:
             # neither feature is configured — admission control and the
             # write-queue caps are inline and need no loop.
             self._tasks.append(asyncio.create_task(self._governor_loop()))
+        if self.validation_state == ASSUMED:
+            # A (re)boot in the ASSUMED state owes the network a finished
+            # revalidation: restart the background lane immediately.
+            self._bg_start()
         if self.config.mine:
             self.start_mining()
 
@@ -1019,6 +1217,501 @@ class Node:
             await self.request_sync()
             return
 
+    # -- untrusted snapshot sync (chain/snapshot.py, round 12) ------------
+
+    def _snapshot_records(self):
+        """(manifest payload, chunk payloads) for the latest checkpoint
+        height, built lazily and cached until the checkpoint moves (or
+        reorgs).  None while ASSUMED — a node must never relay state it
+        has not itself validated — or when the chain is too short to
+        hold a checkpoint."""
+        if self.validation_state != VALIDATED:
+            return None
+        chain = self.chain
+        height = (chain.height // chain.checkpoint_interval) * (
+            chain.checkpoint_interval
+        )
+        if height <= chain.base_height:
+            return None
+        key = (height, chain.main_hash_at(height))
+        if self._snapshot_cache is not None and self._snapshot_cache[0] == key:
+            return self._snapshot_cache[1]
+        state = chain.snapshot_state()
+        if state is None:
+            return None
+        h, block, balances, nonces, _root = state
+        manifest_payload, chunks = chain_snapshot.build_records(
+            h, block, balances, nonces
+        )
+        size = len(manifest_payload) + sum(len(c) for c in chunks)
+        self._snapshot_cache = (key, (manifest_payload, chunks), size)
+        return manifest_payload, chunks
+
+    async def _request_snapshot(self, peer: _Peer) -> None:
+        """Start a snapshot download from ``peer`` (manifest first).
+        Supervised like every other multi-round fetch: stalls demote and
+        fail over (``_check_snapshot_fetch``)."""
+        self._snap_fetch = _SnapshotFetch(
+            peer=peer, asked_at=self.clock.monotonic()
+        )
+        self.metrics.snapshot_fetches += 1
+        log.info("requesting state snapshot from %s", peer.label)
+        await self._send_guarded(peer, protocol.encode_getsnapshot(0, 0))
+
+    def _validate_snapshot_manifest(self, manifest) -> None:
+        """Cheap-to-check gates BEFORE any chunk round trips: the anchor
+        block must carry real work (full stateless validation — the same
+        PoW-before-state discipline as compact-block handling), and the
+        claimed shape must be bounded.  Raises SnapshotError /
+        ValidationError."""
+        if manifest.height < 1:
+            raise SnapshotError("snapshot at genesis height")
+        if len(manifest.chunk_digests) > SNAPSHOT_MAX_CHUNKS:
+            raise SnapshotError(
+                f"{len(manifest.chunk_digests)} chunks exceeds the "
+                f"{SNAPSHOT_MAX_CHUNKS} cap"
+            )
+        # On a retargeting chain the contextual difficulty of a deep
+        # block is unknowable without the history (the very thing a
+        # snapshot skips) — check PoW at the CLAIMED difficulty, like
+        # orphan parking; the background revalidation re-checks it
+        # contextually.  Difficulty 0 would pass vacuously.
+        claimed = (
+            manifest.block.header.difficulty
+            if self.chain.retarget is not None
+            else self.config.difficulty
+        )
+        if claimed < 1:
+            raise SnapshotError("workless snapshot anchor")
+        from p1_tpu.chain.validate import check_block
+
+        check_block(
+            manifest.block,
+            claimed,
+            chain_tag=self.chain.genesis.block_hash(),
+            sig_cache=self.sig_cache,
+        )
+
+    async def _snapshot_fetch_failed(
+        self, peer: _Peer, reason: str, score: bool
+    ) -> None:
+        """Abandon the in-flight snapshot download.  ``score=True`` for
+        integrity violations (bad digests, bad manifest — forgery,
+        scorable); stalls stay unscored (slowness is not a violation).
+        Either way the fetch fails over: another peer's snapshot if one
+        qualifies, else ordinary genesis IBD — the node always has a
+        trust-free path forward."""
+        self._snap_fetch = None
+        log.warning("snapshot fetch from %s failed: %s", peer.label, reason)
+        if peer.writer in self._peers:
+            peer.sync_demerits += 1
+            self.metrics.sync_demotions += 1
+        if score and peer.host:
+            self._record_violation(peer.host)
+        other = self._pick_sync_peer(exclude=peer)
+        if other is not None and self._snapshot_worthwhile(other):
+            await self._request_snapshot(other)
+        elif other is not None:
+            await self._request_blocks(other)
+        elif peer.writer in self._peers:
+            # Last peer standing: IBD from it validates everything, so
+            # no trust is extended by falling back to ordinary sync.
+            await self._request_blocks(peer)
+
+    def _snapshot_worthwhile(self, peer: _Peer) -> bool:
+        """Would a snapshot from ``peer`` beat ordinary IBD right now?"""
+        return (
+            self.config.snapshot_sync
+            and self.config.sync_stall_timeout_s > 0
+            and peer.is_node
+            and self.validation_state == VALIDATED
+            and self._snap_fetch is None
+            and self._bg_chain is None
+            and self.chain.height == 0
+            and peer.hello_height - self.chain.height
+            >= max(1, self.config.snapshot_min_lead)
+        )
+
+    async def _handle_snapshot(self, body, peer: _Peer) -> None:
+        """One SNAPSHOT reply (manifest or chunk range) of an in-flight
+        fetch.  Unsolicited frames are ignored; every byte verifies
+        against the manifest as it arrives."""
+        fetch = self._snap_fetch
+        if fetch is None or fetch.peer is not peer:
+            return
+        now = self.clock.monotonic()
+        if body[0] == "none":
+            # The peer serves no snapshot (too short, or itself ASSUMED):
+            # not a fault — fall back to ordinary sync with it.
+            self._snap_fetch = None
+            await self._request_blocks(peer)
+            return
+        if body[0] == "manifest":
+            if fetch.manifest is not None:
+                return  # duplicate
+            try:
+                manifest = chain_snapshot.parse_manifest(body[1])
+                self._validate_snapshot_manifest(manifest)
+            except (SnapshotError, ValidationError) as e:
+                await self._snapshot_fetch_failed(
+                    peer, f"bad manifest: {e}", score=True
+                )
+                return
+            fetch.manifest = manifest
+            fetch.asked_at = now
+            await self._send_guarded(
+                peer, protocol.encode_getsnapshot(0, SNAPSHOT_BATCH)
+            )
+            return
+        # chunks
+        if fetch.manifest is None:
+            return  # chunks before the manifest: ignore
+        _, start, chunks = body
+        if start != len(fetch.chunks) or not chunks:
+            return  # stale/duplicate range; supervision re-asks on stall
+        digests = fetch.manifest.chunk_digests
+        for payload in chunks:
+            i = len(fetch.chunks)
+            if i >= len(digests) or chain_snapshot.chunk_digest(
+                payload
+            ) != digests[i]:
+                # Lying mid-transfer: caught on THIS chunk, before the
+                # rest of the download is paid for.
+                await self._snapshot_fetch_failed(
+                    peer, f"chunk {i} fails its manifest digest", score=True
+                )
+                return
+            fetch.chunks.append(payload)
+        fetch.asked_at = now
+        if len(fetch.chunks) < len(digests):
+            await self._send_guarded(
+                peer,
+                protocol.encode_getsnapshot(len(fetch.chunks), SNAPSHOT_BATCH),
+            )
+            return
+        try:
+            snap = chain_snapshot.assemble(fetch.manifest, fetch.chunks)
+        except SnapshotError as e:
+            await self._snapshot_fetch_failed(peer, str(e), score=True)
+            return
+        self._snap_fetch = None
+        await self._adopt_snapshot(snap, fetch.chunks, peer)
+
+    async def _adopt_snapshot(self, snap, chunk_payloads, peer: _Peer) -> None:
+        """Enter the ASSUMED state: swap the serving chain for one
+        anchored on the verified snapshot, persist the sidecar, start
+        the background revalidation, and catch up to the serving peer's
+        tip.  The node serves balance/header/proof queries from this
+        instant — that is the whole point — while trusting nothing
+        beyond what it can still detect and undo."""
+        if self.validation_state != VALIDATED or self._bg_chain is not None:
+            return
+        if snap.height <= self.chain.height:
+            # An ordinary sync outran the download while it was in
+            # flight — the validated chain is already past the snapshot,
+            # so there is nothing left worth assuming.
+            return
+        chain = Chain.from_snapshot(
+            self.config.difficulty, snap, retarget=self.config.retarget_rule()
+        )
+        chain.sig_cache = self.sig_cache
+        if self.config.snapshot_interval > 0:
+            chain.checkpoint_interval = self.config.snapshot_interval
+        self.chain = chain
+        self.validation_state = ASSUMED
+        self._snap_meta = snap.manifest
+        self._snap_source = peer.host
+        self._abort_inflight_search()  # mining pauses while ASSUMED
+        log.warning(
+            "booted from snapshot: height=%d root=%s from %s — ASSUMED "
+            "state, serving immediately; background revalidation starting",
+            snap.height,
+            snap.state_root.hex()[:16],
+            peer.label,
+        )
+        snap_path = self._snapshot_path()
+        if snap_path is not None:
+            try:
+                chain_snapshot.write_snapshot(
+                    snap_path,
+                    chain_snapshot.encode_manifest(snap.manifest),
+                    chunk_payloads,
+                )
+            except OSError as e:
+                log.warning("could not persist snapshot sidecar: %s", e)
+        # Reset the store onto the assumed layout (anchor + descendants):
+        # any genesis-connected records an outrun ordinary sync already
+        # persisted would otherwise leave a mixed log the resume cannot
+        # interpret.  The history they held is re-fetched (and properly
+        # revalidated) by the background lane anyway.
+        self._rewrite_store(chain)
+        if self.store is not None and self.config.body_cache_blocks > 0:
+            chain.body_source = self.store
+        self._bg_start()
+        await self._request_blocks(peer)
+
+    def _bg_start(self) -> None:
+        """Arm the background revalidation: a second, genesis-anchored
+        chain that replays the REAL history through the batched
+        validation lane (PR 5) while the assumed chain serves.  The
+        fetch itself is driven by ``_check_bg_sync`` ticks and the
+        BLOCKS routing in ``_dispatch``."""
+        if self._bg_chain is not None or self._snap_meta is None:
+            return
+        chain = Chain(
+            self.config.difficulty, retarget=self.config.retarget_rule()
+        )
+        chain.sig_cache = self.sig_cache
+        if self.config.snapshot_interval > 0:
+            chain.checkpoint_interval = self.config.snapshot_interval
+        # Pin the snapshot height as an explicit checkpoint so the
+        # divergence comparison reads an exact-height root regardless of
+        # how the serving node's interval relates to ours.
+        chain.checkpoint_extra.add(self._snap_meta.height)
+        self._bg_chain = chain
+
+    async def _bg_request(self, peer: _Peer) -> None:
+        if self._bg_chain is None:
+            return
+        self._bg_sup.begin(peer)
+        await self._send_guarded(
+            peer, protocol.encode_getblocks(self._bg_chain.locator())
+        )
+
+    async def _check_bg_sync(self) -> None:
+        """Supervision tick for the background revalidation fetch: kick
+        it when idle, demote + fail over when the serving peer stalls —
+        the same progress-buys-the-slot contract as the main sync."""
+        bg = self._bg_chain
+        if bg is None:
+            return
+        sup = self._bg_sup
+        if not sup.active:
+            if not sup.ready():
+                return  # backoff from the last stall still arming
+            if sup.exhausted():
+                sup.attempts = 0  # new episode after the cooldown
+            peer = self._pick_sync_peer(exclude=self._bg_last_staller)
+            if peer is not None:
+                await self._bg_request(peer)
+            return
+        staller = sup.target
+        gone = staller.writer not in self._peers
+        if not (gone or sup.stalled()):
+            return
+        self.metrics.snapshot_stalls += 1
+        if not gone:
+            staller.sync_demerits += 1
+            self.metrics.sync_demotions += 1
+            log.warning(
+                "background revalidation stalled on %s — failing over",
+                staller.label,
+            )
+        self._bg_last_staller = staller
+        sup.record_stall()  # arms the jittered backoff; next tick re-kicks
+        # The unobtainable-history rule: the snapshot came with an
+        # implicit promise that its history exists.  If the replay has
+        # consumed everything every connected peer advertises and still
+        # sits below the snapshot height, nobody can back the claim —
+        # an unbackable snapshot is treated exactly like a lying one
+        # (quarantine + fall back to the validated chain).  Advertised
+        # heights are handshake-stale, so this only under-triggers: a
+        # peer that has since grown past the snapshot height will push
+        # its blocks and the replay resumes through the normal routes.
+        meta = self._snap_meta
+        if meta is not None and self._bg_chain is not None:
+            peer_best = max(
+                (
+                    p.hello_height
+                    for p in self._peers.values()
+                    if p.is_node
+                ),
+                default=0,
+            )
+            if peer_best < meta.height and bg.height >= peer_best:
+                await self._snapshot_diverged(
+                    "snapshot history unobtainable: no connected peer "
+                    "advertises the snapshot height"
+                )
+
+    async def _check_snapshot_fetch(self, now: float) -> None:
+        """Supervision tick for an in-flight snapshot download."""
+        fetch = self._snap_fetch
+        if fetch is None:
+            return
+        deadline = self.config.sync_stall_timeout_s
+        if (
+            fetch.peer.writer in self._peers
+            and now - fetch.asked_at <= deadline
+        ):
+            return
+        self.metrics.snapshot_stalls += 1
+        await self._snapshot_fetch_failed(
+            fetch.peer, "snapshot transfer stalled", score=False
+        )
+
+    async def _check_bg_done(self) -> None:
+        """The verdict: once the background chain's main chain crosses
+        the snapshot height, compare — same block hash AND same state
+        root means the snapshot told the truth (flip to VALIDATED);
+        anything else means it lied (quarantine + fall back)."""
+        bg, meta = self._bg_chain, self._snap_meta
+        if bg is None or meta is None or bg.height < meta.height:
+            return
+        at = bg.main_hash_at(meta.height)
+        if at is None:
+            return
+        if at == meta.block_hash:
+            root = bg.state_checkpoints.get(meta.height)
+            if root == meta.state_root:
+                await self._snapshot_flip()
+            else:
+                await self._snapshot_diverged(
+                    "replayed state root does not match the snapshot's claim"
+                )
+        else:
+            await self._snapshot_diverged(
+                "snapshot anchor block is not on the fully-validated chain"
+            )
+
+    async def _snapshot_flip(self) -> None:
+        """ASSUMED → VALIDATED: the replayed history reproduced the
+        snapshot's state root, so the background chain (which now holds
+        the full validated history) becomes the serving chain, with the
+        assumed chain's post-snapshot blocks transplanted on top.  The
+        store is rewritten as a full genesis-first log and the sidecar
+        removed — a later restart is an ordinary resume."""
+        bg, assumed = self._bg_chain, self.chain
+        self._bg_chain = None
+        self._bg_sup.idle()
+        for h in range(assumed.base_height + 1, assumed.height + 1):
+            bh = assumed.main_hash_at(h)
+            if bh is None:
+                break
+            bg.add_block(assumed._block_at(bh))
+        self.chain = bg
+        self.validation_state = VALIDATED
+        self.metrics.snapshot_flips += 1
+        self._snap_meta = None
+        self._snap_source = None
+        log.warning(
+            "background revalidation CONFIRMED the snapshot — flipped to "
+            "fully-validated at height %d",
+            bg.height,
+        )
+        self._rewrite_store(bg)
+        snap_path = self._snapshot_path()
+        if snap_path is not None and snap_path.exists():
+            try:
+                os.unlink(snap_path)
+            except OSError:
+                pass  # stale sidecar; the next resume detects and drops it
+        if self.store is not None and self.config.body_cache_blocks > 0:
+            bg.body_source = self.store
+        # Mining resumes on the next loop tick (the ASSUMED gate cleared);
+        # one broadcast sync mops up anything gossip dropped meanwhile,
+        # and one tip announce publishes the now-fully-backed chain —
+        # peers that never saw the snapshot's branch can finally
+        # orphan-backfill the WHOLE history from us.
+        await self.request_sync()
+        await self._announce_tip_now()
+
+    async def _snapshot_diverged(self, reason: str) -> None:
+        """The snapshot LIED (or its chain lost): quarantine the sidecar,
+        demote + score the serving peer, and fall back to genesis IBD on
+        the fully-validated background chain — which keeps serving
+        headers and every other query throughout.  Never a crash, never
+        silent acceptance."""
+        bg = self._bg_chain
+        self._bg_chain = None
+        self._bg_sup.idle()
+        self.metrics.snapshot_divergences += 1
+        self.metrics.snapshot_fallbacks += 1
+        log.error(
+            "snapshot DIVERGED (%s) — quarantining it, demoting the "
+            "serving peer, falling back to genesis IBD",
+            reason,
+        )
+        snap_path = self._snapshot_path()
+        if snap_path is not None and snap_path.exists():
+            try:
+                os.replace(
+                    snap_path,
+                    snap_path.with_name(snap_path.name + ".quarantine"),
+                )
+            except OSError as e:
+                log.warning("could not quarantine snapshot sidecar: %s", e)
+        host = self._snap_source
+        if host:
+            self._record_violation(host)
+            for p in self._peers.values():
+                if p.host == host:
+                    p.sync_demerits += 1
+                    self.metrics.sync_demotions += 1
+        self._snap_meta = None
+        self._snap_source = None
+        self.chain = bg
+        self.validation_state = VALIDATED
+        self._rewrite_store(bg)
+        if self.store is not None and self.config.body_cache_blocks > 0:
+            bg.body_source = self.store
+        await self.request_sync()
+        # The fallback chain may carry MORE work than anything our peers
+        # hold (the snapshot's branch was real blocks even if its state
+        # claim lied): announce it once so the mesh can weigh it — fork
+        # choice, not this node, decides.
+        await self._announce_tip_now()
+
+    async def _announce_tip_now(self) -> None:
+        """Push the current tip to every peer once (the validation-state
+        transitions' counterpart of the post-IBD ``_announce_tip``
+        flag): receivers connect it or orphan-backfill the history —
+        which this node, now holding a full genesis-connected chain,
+        can serve end to end."""
+        if self.chain.height == 0 or not self._peers:
+            return
+        payload, saved = self._block_gossip_payload(self.chain.tip)
+        n = await self._gossip(payload)
+        if saved and n:
+            self.metrics.cblocks_sent += n
+            self.metrics.cblock_bytes_saved += saved * n
+
+    def _rewrite_store(self, chain: Chain) -> None:
+        """Replace the store's contents with ``chain``'s main branch
+        (the flip/fallback transition out of the ASSUMED store layout,
+        where records hang off a snapshot anchor instead of genesis):
+        tmp + atomic replace + directory fsync, then re-acquire and
+        re-index.  A failure leaves the OLD store intact — the running
+        chain is authoritative either way, and the next resume's
+        sidecar logic sorts out whichever layout survived."""
+        if self.store is None:
+            return
+        from p1_tpu.chain.store import fsync_dir, save_chain
+
+        path = self.store.path
+        tmp = path.with_name(f"{path.name}.flip.{os.getpid()}")
+        try:
+            save_chain(chain, tmp)
+            self.store.close()  # release the flock on the old inode
+            os.replace(tmp, path)
+            fsync_dir(path.parent)
+            self.store.acquire()
+            self.store.reindex_spans()
+            self._store_pending.clear()
+        except OSError as e:
+            log.error(
+                "store rewrite after the validation flip failed (%s) — "
+                "keeping the previous layout; a restart will re-derive "
+                "state from the sidecar",
+                e,
+            )
+            try:
+                if tmp.exists():
+                    os.unlink(tmp)
+                self.store.acquire()  # make sure the writer lock is back
+            except OSError:
+                pass
+
     # -- overload resilience (node/governor.py) ---------------------------
 
     def _memory_gauge(self) -> int:
@@ -1043,6 +1736,9 @@ class Node:
             # query storm becomes untracked RAM under the watermark.
             + self.chain.proof_cache.bytes_used
             + self.chain.filter_index.bytes_used
+            # Served-snapshot cache (round 12): one checkpoint's worth
+            # of canonical state bytes, rebuilt per checkpoint.
+            + (self._snapshot_cache[2] if self._snapshot_cache else 0)
         )
 
     async def _governor_loop(self) -> None:
@@ -1299,6 +1995,11 @@ class Node:
         everyone at once, so there is no staller to supervise)."""
         if self._store_degraded:
             return  # serve-only: don't solicit blocks we would refuse
+        if self._snap_fetch is not None:
+            # A snapshot download is in flight: replaying history in
+            # parallel would just race the download to the tip and waste
+            # both (the failure path re-solicits blocks explicitly).
+            return
         self._sync.begin(peer)
         await self._send_guarded(
             peer, protocol.encode_getblocks(self.chain.locator())
@@ -1346,6 +2047,8 @@ class Node:
                 await self._check_block_sync()
                 await self._check_pending_cblocks(now)
                 await self._check_mempool_sync(now)
+                await self._check_snapshot_fetch(now)
+                await self._check_bg_sync()
             except Exception:
                 # The supervisor must never die of one bad tick — it is
                 # the layer that un-wedges everything else.
@@ -1674,7 +2377,14 @@ class Node:
                 # ~100 ms race real-socket tests never hit).
                 payload, _saved = self._block_gossip_payload(self.chain.tip)
                 await self._send_guarded(peer, payload)
-            if hello.tip_height > self.chain.height:
+            if self._snapshot_worthwhile(peer):
+                # Fresh node, far-ahead peer, snapshot sync enabled:
+                # fetch a state snapshot instead of replaying history —
+                # boot-from-snapshot in seconds, with the robustness
+                # contract (verify, ASSUME, revalidate, flip-or-
+                # quarantine) carried by the snapshot plane above.
+                await self._request_snapshot(peer)
+            elif hello.tip_height > self.chain.height:
                 # Blocks first, mempool after: the BLOCKS handler requests
                 # the pool once our chain reaches the advertised height,
                 # so admission's affordability check runs against a
@@ -1839,16 +2549,55 @@ class Node:
                 self.sig_cache,
             )
             accepted_any = False
+            bg_accepted = 0
             try:
                 for block in body:
-                    res = await self._handle_block(
-                        block, origin=peer, gossip=False
-                    )
-                    accepted_any |= res.status is AddStatus.ACCEPTED
+                    # Content routing while a background revalidation is
+                    # running (ASSUMED state): historical blocks — the
+                    # ones only the genesis-anchored background chain
+                    # can connect — feed IT; blocks the serving chain
+                    # knows how to place take the normal path (both, for
+                    # the overlap around the snapshot anchor).  Without
+                    # the split, history would park as orphans in the
+                    # assumed chain and never validate anything.
+                    bg = self._bg_chain
+                    handled = False
+                    if bg is not None and (
+                        block.block_hash() in bg
+                        or block.header.prev_hash in bg
+                    ):
+                        st = bg.add_block(block)
+                        if st.status is AddStatus.ACCEPTED and st.connected:
+                            bg_accepted += len(st.connected)
+                            self.metrics.revalidated_blocks += len(
+                                st.connected
+                            )
+                            self._bg_sup.progress()
+                        handled = True
+                    if bg is None or (
+                        block.block_hash() in self.chain
+                        or block.header.prev_hash in self.chain
+                    ):
+                        res = await self._handle_block(
+                            block, origin=peer, gossip=False
+                        )
+                        accepted_any |= res.status is AddStatus.ACCEPTED
+                        handled = True
+                    if not handled:
+                        # Neither chain knows the parent: a gap in the
+                        # history fetch — park in the background chain's
+                        # bounded orphan pool, never the serving one's.
+                        bg.add_block(block)
             finally:
                 if batch_fsync:
                     self.store.fsync = True
                     self._store_sync()
+            if bg_accepted:
+                # The replay advanced: verdict check (flip/diverge), and
+                # if still running, keep pulling history from this peer.
+                await self._check_bg_done()
+                if self._bg_chain is not None and body:
+                    await self._bg_request(peer)
             # Progress was made and the batch was non-empty: there may be
             # more behind it (an empty/duplicate reply ends the loop).
             if accepted_any and body:
@@ -2051,6 +2800,27 @@ class Node:
             )
         elif mtype is MsgType.FILTERS:
             pass  # reply frame: meaningful to light clients only
+        elif mtype is MsgType.GETSNAPSHOT:
+            # Snapshot serving (chain/snapshot.py): manifest or a chunk
+            # range of the latest checkpoint state.  Range-capped and
+            # governor-admitted like every other query; an ASSUMED node
+            # (or a chain too short for a checkpoint) answers "none".
+            start, count = body
+            records = self._snapshot_records()
+            if records is None:
+                await self._send_guarded(peer, protocol.encode_snapshot_none())
+            elif count == 0:
+                await self._send_guarded(
+                    peer, protocol.encode_snapshot_manifest(records[0])
+                )
+            else:
+                chunks = records[1][start : start + min(count, SNAPSHOT_BATCH)]
+                self.metrics.snapshot_chunks_served += len(chunks)
+                await self._send_guarded(
+                    peer, protocol.encode_snapshot_chunks(start, chunks)
+                )
+        elif mtype is MsgType.SNAPSHOT:
+            await self._handle_snapshot(body, peer)
         elif mtype is MsgType.GETSTATUS:
             # Operator probe (`p1 status`): the same JSON the node logs,
             # served over the wire — deliberately NOT in _SHED_DROPS, so
@@ -2473,11 +3243,18 @@ class Node:
 
         loop = asyncio.get_running_loop()
         while self._running:
-            if self._store_degraded or self.governor.shedding:
-                # Serve-only / SHED: a sealed block would be refused at
-                # the door (degraded disk) or assembled under memory
-                # pressure the node is trying to shed — don't burn the
-                # CPU.  Mining resumes the moment the state clears.
+            if (
+                self._store_degraded
+                or self.governor.shedding
+                or self.validation_state != VALIDATED
+            ):
+                # Serve-only / SHED / ASSUMED: a sealed block would be
+                # refused at the door (degraded disk), assembled under
+                # memory pressure the node is trying to shed, or built
+                # on state this node has not yet validated (mining on an
+                # assumed tip would WAGER hashpower on a peer's claim) —
+                # don't burn the CPU.  Mining resumes when the state
+                # clears / the revalidation flips.
                 await asyncio.sleep(0.25)
                 continue
             candidate = self._assemble()
@@ -2598,7 +3375,32 @@ class Node:
                 "body_refetches": self.chain.body_refetches,
                 "body_cache_blocks": self.config.body_cache_blocks,
                 "mining_paused": self.governor.shedding
-                or self._store_degraded,
+                or self._store_degraded
+                or self.validation_state != VALIDATED,
+            },
+            # Untrusted snapshot sync (round 12, chain/snapshot.py): the
+            # node's trust posture and the snapshot plane's telemetry —
+            # an operator reading "assumed" knows every answer is
+            # conditioned on a snapshot still being revalidated.
+            "snapshot": {
+                "state": self.validation_state,
+                "base_height": self.chain.base_height,
+                "checkpoint_interval": self.chain.checkpoint_interval,
+                "checkpoints": len(self.chain.state_checkpoints),
+                "fetching": self._snap_fetch is not None,
+                "revalidating": self._bg_chain is not None,
+                "bg_height": (
+                    self._bg_chain.height
+                    if self._bg_chain is not None
+                    else None
+                ),
+                "fetches": self.metrics.snapshot_fetches,
+                "chunks_served": self.metrics.snapshot_chunks_served,
+                "flips": self.metrics.snapshot_flips,
+                "divergences": self.metrics.snapshot_divergences,
+                "fallbacks": self.metrics.snapshot_fallbacks,
+                "stalls": self.metrics.snapshot_stalls,
+                "revalidated_blocks": self.metrics.revalidated_blocks,
             },
             # Query serving plane (round 9): read-traffic counters (how
             # many proofs/filters this node served and at what cache hit

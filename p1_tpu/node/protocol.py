@@ -122,6 +122,21 @@ Messages:
              and account ids with zero false negatives (a non-match is
              proof of absence).  The server caps ``count`` like the
              other range queries — ask again from where the reply ended.
+- GETSNAPSHOT: u32 start chunk + u16 count — snapshot-state sync
+             (chain/snapshot.py).  count 0 asks for the MANIFEST
+             (height, block hash, state root, per-chunk digests, the
+             full anchor block); count >= 1 asks for that chunk range.
+             Served range-capped and governor-admitted like every
+             other query; an ASSUMED node answers "none" (it must not
+             relay state it has not itself validated).
+- SNAPSHOT:  u8 kind — 0 none (no snapshot available), 1 manifest
+             (u32 len + manifest payload), 2 chunks (u32 start + u16
+             count + count * (u32 len + chunk payload)).  Everything
+             inside is checkable against the manifest: the receiver
+             verifies each chunk's digest AS IT ARRIVES and the state
+             root at the end — a peer lying mid-transfer is caught on
+             the first bad chunk.  The payloads are exactly the
+             snapshot-file records, so wire and disk cannot drift.
 """
 
 from __future__ import annotations
@@ -172,8 +187,10 @@ _LEN = struct.Struct(">I")
 #: the operator status probe (GETSTATUS/STATUS — `p1 status` renders a
 #: running node's full status JSON, overload block included); v10 the
 #: query serving plane (GETFILTERS/FILTERS — compact block filters for
-#: light-client sync by filter match, chain/filters.py).
-PROTOCOL_VERSION = 10
+#: light-client sync by filter match, chain/filters.py); v11 untrusted
+#: snapshot sync (GETSNAPSHOT/SNAPSHOT — chunked ledger-state snapshots
+#: with a self-describing manifest, chain/snapshot.py).
+PROTOCOL_VERSION = 11
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -204,6 +221,8 @@ class MsgType(enum.IntEnum):
     STATUS = 24
     GETFILTERS = 25
     FILTERS = 26
+    GETSNAPSHOT = 27
+    SNAPSHOT = 28
 
 
 @dataclasses.dataclass(frozen=True)
@@ -526,6 +545,40 @@ def patch_proof_tip(payload: bytes, tip_height: int) -> bytes:
     )
 
 
+def encode_getsnapshot(start_chunk: int = 0, count: int = 0) -> bytes:
+    """``count`` 0 = manifest request; >= 1 = that chunk range."""
+    if not 0 <= start_chunk <= 0xFFFFFFFF:
+        raise ValueError("bad snapshot start chunk")
+    if not 0 <= count <= 0xFFFF:
+        raise ValueError("bad snapshot chunk count")
+    return bytes([MsgType.GETSNAPSHOT]) + struct.pack(">IH", start_chunk, count)
+
+
+def encode_snapshot_none() -> bytes:
+    return bytes([MsgType.SNAPSHOT, 0])
+
+
+def encode_snapshot_manifest(manifest_payload: bytes) -> bytes:
+    return (
+        bytes([MsgType.SNAPSHOT, 1])
+        + _LEN.pack(len(manifest_payload))
+        + manifest_payload
+    )
+
+
+def encode_snapshot_chunks(start: int, chunk_payloads: list[bytes]) -> bytes:
+    if len(chunk_payloads) > 0xFFFF:
+        raise ValueError("too many chunks for one SNAPSHOT frame")
+    parts = [
+        bytes([MsgType.SNAPSHOT, 2]),
+        struct.pack(">IH", start, len(chunk_payloads)),
+    ]
+    for payload in chunk_payloads:
+        parts.append(_LEN.pack(len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
 def encode_getproof(txid: bytes) -> bytes:
     if len(txid) != 32:
         raise ValueError("txid must be 32 bytes")
@@ -811,6 +864,44 @@ def _decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in FILTERS")
         return mtype, (start, entries)
+    if mtype is MsgType.GETSNAPSHOT:
+        if len(body) != 6:
+            raise ValueError("bad GETSNAPSHOT")
+        return mtype, struct.unpack(">IH", body)
+    if mtype is MsgType.SNAPSHOT:
+        if len(body) < 1:
+            raise ValueError("bad SNAPSHOT")
+        kind = body[0]
+        if kind == 0:
+            if len(body) != 1:
+                raise ValueError("trailing bytes in SNAPSHOT")
+            return mtype, ("none",)
+        if kind == 1:
+            if len(body) < 1 + _LEN.size:
+                raise ValueError("truncated SNAPSHOT manifest")
+            (mlen,) = _LEN.unpack_from(body, 1)
+            if len(body) != 1 + _LEN.size + mlen:
+                raise ValueError("bad SNAPSHOT manifest length")
+            return mtype, ("manifest", body[1 + _LEN.size :])
+        if kind == 2:
+            if len(body) < 7:
+                raise ValueError("truncated SNAPSHOT chunks")
+            start, n = struct.unpack_from(">IH", body, 1)
+            off = 7
+            chunks = []
+            for _ in range(n):
+                if len(body) < off + _LEN.size:
+                    raise ValueError("truncated SNAPSHOT chunk")
+                (clen,) = _LEN.unpack_from(body, off)
+                off += _LEN.size
+                if len(body) < off + clen:
+                    raise ValueError("truncated SNAPSHOT chunk entry")
+                chunks.append(body[off : off + clen])
+                off += clen
+            if off != len(body):
+                raise ValueError("trailing bytes in SNAPSHOT")
+            return mtype, ("chunks", start, chunks)
+        raise ValueError(f"bad SNAPSHOT kind {kind}")
     if mtype is MsgType.GETPROOF:
         if len(body) != 32:
             raise ValueError("bad GETPROOF")

@@ -604,6 +604,192 @@ def wan(
     return net.run(main())
 
 
+# -- snapshot join (untrusted snapshot sync) -----------------------------
+
+
+def snapshot_join(
+    nodes: int = 16,
+    chain_blocks: int = 10,
+    seed: int = 0,
+    difficulty: int = 8,
+    interval: int = 4,
+    lie: str | None = None,
+    liar_height: int = 12,
+    verdict_timeout_vs: float = 300.0,
+    wall_limit_s: float | None = 240.0,
+) -> dict:
+    """Untrusted snapshot sync (chain/snapshot.py) at mesh scale.
+
+    Honest form (``lie=None``): a fresh node joins a converged mesh
+    with ``--snapshot-sync`` on, boots ASSUMED from a peer-served
+    checkpoint snapshot, serves balance queries immediately, and must
+    flip to fully-validated once the background replay reproduces the
+    state root.  The report measures the assumed-boot and flip times in
+    virtual seconds, and re-checks every balance the joiner reported
+    while ASSUMED against the audit view of the validated chain — the
+    never-contradicted invariant.
+
+    Lying form (``lie`` in "balance"/"root"/"truncate"/"stall"): the
+    joiner's FIRST peer is a hostile snapshot server running that
+    pathology on a taller fork.  ok = the joiner detects/contains it
+    (divergence + quarantine for the internally-consistent "balance"
+    lie; refusal/failover for the rest), ends fully-validated, and the
+    whole network still converges with the ledger conserved."""
+    from p1_tpu.chain.ledger import balances as audit_balances
+    from p1_tpu.node.testing import FaultPlan, HostilePeer, make_blocks
+
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    WALLET = "snapshot-wallet"
+
+    async def main():
+        rng = random.Random(seed ^ 0x54A9)
+        for i in range(nodes):
+            await net.add_node(
+                peers=[
+                    net.host_name(j) for j in _topology_peers(rng, i, 3)
+                ],
+                snapshot_interval=interval,
+                **({"miner_id": WALLET} if i == 0 else {}),
+            )
+        hosts = list(net.nodes)
+        miner = net.nodes[hosts[0]]
+        assert await net.run_until(
+            net.links_up, 60, step=0.1, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        for _ in range(chain_blocks):
+            await net.mine_on(miner, spacing_s=1.0)
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == chain_blocks,
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged pre-join"
+
+        peers = [hosts[0], hosts[1]]
+        liar = None
+        if lie is not None:
+            from p1_tpu.node.protocol import MsgType
+
+            if lie in ("balance", "root"):
+                plan = FaultPlan(snapshot_lie=lie)
+            elif lie == "truncate":
+                plan = FaultPlan(snapshot_chunks=1)
+            else:
+                plan = FaultPlan(swallow=frozenset({MsgType.GETSNAPSHOT}))
+            src = "66.9.9.1"
+            liar = HostilePeer(
+                make_blocks(liar_height, difficulty, miner_id="snapliar"),
+                plan=plan,
+                transport=net.net.host(src),
+                host=src,
+                rng=random.Random(seed * 31 + 7),
+            )
+            await liar.start()
+            peers = [f"{src}:{liar.port}", hosts[0]]
+
+        join_at = net.clock.now
+        joiner = await net.add_node(
+            name="10.99.9.9",
+            peers=peers,
+            snapshot_sync=True,
+            snapshot_interval=interval,
+            snapshot_min_lead=2,
+        )
+        assumed = await net.run_until(
+            lambda: joiner.validation_state == "assumed",
+            60, step=0.1, wall_limit_s=wall_limit_s,
+        )
+        assumed_vs = net.clock.now - join_at
+        samples: list[tuple[int, bytes, int]] = []
+
+        def sample():
+            if joiner.validation_state == "assumed":
+                samples.append(
+                    (
+                        joiner.chain.height,
+                        joiner.chain.tip_hash,
+                        joiner.chain.balance(WALLET),
+                    )
+                )
+            return False
+
+        await net.run_until(
+            sample, 2.0, step=0.5, wall_limit_s=wall_limit_s
+        )
+        verdict = await net.run_until(
+            lambda: joiner.validation_state == "validated"
+            and joiner._bg_chain is None,
+            verdict_timeout_vs, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        verdict_vs = net.clock.now - join_at
+        # Post-verdict: one more honest block must reach the joiner.
+        await net.mine_on(miner, spacing_s=1.0)
+        settled = await net.run_until(
+            lambda: net.converged(), 120, step=0.25,
+            wall_limit_s=wall_limit_s,
+        )
+        contradicted = 0
+        ref = net.nodes[hosts[0]].chain
+        if joiner.metrics.snapshot_flips:
+            for height, tip_hash, reported in samples:
+                if ref.main_hash_at(height) != tip_hash:
+                    continue  # claim's block reorged away: retracted
+                blocks = [
+                    ref._block_at(ref.main_hash_at(h))
+                    for h in range(height + 1)
+                ]
+                if audit_balances(blocks).get(WALLET, 0) != reported:
+                    contradicted += 1
+        report = _report(
+            net, "snapshot-join", t0,
+            lie=lie,
+            assumed=assumed,
+            assumed_virtual_s=round(assumed_vs, 3),
+            verdict=verdict,
+            verdict_virtual_s=round(verdict_vs, 3),
+            flips=joiner.metrics.snapshot_flips,
+            divergences=joiner.metrics.snapshot_divergences,
+            assumed_samples=len(samples),
+            samples_contradicted=contradicted,
+        )
+        if lie is None:
+            report["ok"] = bool(
+                assumed
+                and verdict
+                and settled
+                and joiner.metrics.snapshot_flips == 1
+                and joiner.metrics.snapshot_divergences == 0
+                and contradicted == 0
+                and report["ledger_conserved"]
+            )
+        elif lie == "balance":
+            # Internally consistent lie: adopted, then CAUGHT by the
+            # background replay — quarantined, fallen back, converged.
+            report["ok"] = bool(
+                assumed
+                and verdict
+                and settled
+                and joiner.metrics.snapshot_divergences >= 1
+                and joiner.metrics.snapshot_flips == 0
+                and report["ledger_conserved"]
+            )
+        else:
+            # root/truncate/stall: refused or failed over BEFORE any
+            # state was trusted — the joiner may end up assuming an
+            # honest peer's snapshot instead (and must then flip).
+            report["ok"] = bool(
+                verdict
+                and settled
+                and contradicted == 0
+                and report["ledger_conserved"]
+            )
+        if liar is not None:
+            await liar.stop()
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
 # -- registry / CLI entry ------------------------------------------------
 
 SCENARIOS = {
@@ -612,6 +798,7 @@ SCENARIOS = {
     "churn": churn_storm,
     "eclipse": eclipse,
     "wan": wan,
+    "snapshot-join": snapshot_join,
 }
 
 

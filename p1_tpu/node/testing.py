@@ -55,6 +55,7 @@ _FAULTABLE = frozenset(
         MsgType.GETHEADERS,
         MsgType.GETBLOCKTXN,
         MsgType.GETMEMPOOL,
+        MsgType.GETSNAPSHOT,
     }
 )
 
@@ -134,6 +135,17 @@ class FaultPlan:
     hello_height: int | None = None
     #: MEMPOOL reply shape: the ``more`` flag on served pages.
     mempool_more: bool = False
+    #: Snapshot-serving pathologies (chain/snapshot.py, GETSNAPSHOT).
+    #: ``snapshot_lie`` corrupts the SERVED STATE: "balance" inflates
+    #: one account by 1000 with the manifest root computed over the lie
+    #: (internally consistent — only background revalidation can catch
+    #: it), "root" flips a state-root byte (caught at assembly, before
+    #: any trust is extended).  ``snapshot_chunks`` truncates the serve:
+    #: only the first N chunk requests are answered, then silence (the
+    #: crash/stall-mid-transfer profile; compose with ``swallow`` for a
+    #: server that never answers at all).
+    snapshot_lie: str | None = None
+    snapshot_chunks: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -384,6 +396,39 @@ class HostilePeer:
         self._tasks: dict[asyncio.Task, None] = {}
         self._live: dict[_Session, None] = {}
         self._fault_hits = 0
+        self._snapshot_records = None  # lazy (manifest, chunks) cache
+
+    def snapshot_records(self) -> tuple[bytes, list[bytes]]:
+        """(manifest payload, chunk payloads) of the served chain's tip
+        state — with the plan's ``snapshot_lie`` applied.  A "balance"
+        lie is INTERNALLY CONSISTENT (the root commits to the lie), so
+        every wire-level check passes and only background revalidation
+        against the real history can expose it — exactly the attack the
+        ASSUMED state exists to contain."""
+        if self._snapshot_records is not None:
+            return self._snapshot_records
+        from p1_tpu.chain import snapshot as chain_snapshot
+        from p1_tpu.chain.ledger import Ledger
+
+        ledger = Ledger()
+        for block in self.blocks:
+            ledger.apply_block(block)
+        balances = ledger.snapshot()
+        nonces = ledger.nonces_snapshot()
+        if self.plan.snapshot_lie == "balance":
+            victim = sorted(balances)[0] if balances else "phantom"
+            balances[victim] = balances.get(victim, 0) + 1000
+        manifest_payload, chunks = chain_snapshot.build_records(
+            len(self.blocks) - 1, self.blocks[-1], balances, nonces
+        )
+        if self.plan.snapshot_lie == "root":
+            # Flip one state-root byte INSIDE the manifest payload: the
+            # joiner's assembly check must refuse before adopting.
+            manifest = chain_snapshot.parse_manifest(manifest_payload)
+            bad = bytes([manifest_payload[37] ^ 0x01]) + manifest_payload[38:]
+            manifest_payload = manifest_payload[:37] + bad
+        self._snapshot_records = (manifest_payload, chunks)
+        return self._snapshot_records
 
     # -- lifecycle -------------------------------------------------------
 
@@ -519,6 +564,21 @@ class HostilePeer:
                 return None
             return protocol.encode_blocktxn(
                 bhash, [block.txs[j].serialize() for j in indices]
+            )
+        if mtype is MsgType.GETSNAPSHOT:
+            start, count = body
+            manifest_payload, chunks = self.snapshot_records()
+            if count == 0:
+                return protocol.encode_snapshot_manifest(manifest_payload)
+            limit = (
+                self.plan.snapshot_chunks
+                if self.plan.snapshot_chunks is not None
+                else len(chunks)
+            )
+            if start >= limit:
+                return None  # truncated serve: stall mid-transfer
+            return protocol.encode_snapshot_chunks(
+                start, chunks[start : min(start + count, limit)]
             )
         return None
 

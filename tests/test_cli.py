@@ -921,3 +921,102 @@ class TestServe:
         finally:
             proc.terminate()
             proc.wait(timeout=30)
+
+class TestSnapshotCLI:
+    """`p1 snapshot create/verify/info` — the established exit-code
+    contract (0 clean / 1 salvageable / 2 unrecoverable) + help smoke."""
+
+    @staticmethod
+    def _cli(*argv, timeout=110):
+        return subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "snapshot", *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd="/root/repo",
+        )
+
+    def test_help_smoke(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "snapshot", "--help"],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0
+        assert "create" in proc.stdout and "verify" in proc.stdout
+
+    def test_create_verify_info_round_trip(self, tmp_path):
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.node.testing import make_blocks
+
+        store = tmp_path / "store.dat"
+        s = ChainStore(store)
+        for b in make_blocks(10, 8, miner_id="cli-m")[1:]:
+            s.append(b)
+        s.close()
+        snap = tmp_path / "snap.p1s"
+        proc = self._cli(
+            "create", "--store", str(store), "--file", str(snap),
+            "--interval", "4",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["height"] == 8 and out["accounts"] == 1
+        proc = self._cli("verify", "--file", str(snap))
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout.strip())["status"] == "clean"
+        proc = self._cli("info", "--file", str(snap))
+        assert proc.returncode == 0
+        info = json.loads(proc.stdout.strip())
+        assert info["height"] == 8 and "trust" in info
+
+    def test_verify_salvageable_exit_1(self, tmp_path):
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.node.testing import make_blocks
+
+        store = tmp_path / "store.dat"
+        s = ChainStore(store)
+        for b in make_blocks(8, 8)[1:]:
+            s.append(b)
+        s.close()
+        snap = tmp_path / "snap.p1s"
+        assert (
+            self._cli(
+                "create", "--store", str(store), "--file", str(snap),
+                "--interval", "4",
+            ).returncode
+            == 0
+        )
+        with open(snap, "ab") as fh:
+            fh.write(b"trailing garbage")
+        proc = self._cli("verify", "--file", str(snap))
+        assert proc.returncode == 1, (proc.stdout, proc.stderr[-500:])
+        assert json.loads(proc.stdout.strip())["status"] == "salvageable"
+
+    def test_unrecoverable_exit_2(self, tmp_path):
+        junk = tmp_path / "junk.p1s"
+        junk.write_bytes(b"not a snapshot at all")
+        assert self._cli("verify", "--file", str(junk)).returncode == 2
+        assert (
+            self._cli(
+                "verify", "--file", str(tmp_path / "absent.p1s")
+            ).returncode
+            == 2
+        )
+        # create on a store too short for any checkpoint: unrecoverable.
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.node.testing import make_blocks
+
+        store = tmp_path / "short.dat"
+        s = ChainStore(store)
+        for b in make_blocks(2, 8)[1:]:
+            s.append(b)
+        s.close()
+        proc = self._cli(
+            "create", "--store", str(store),
+            "--file", str(tmp_path / "x.p1s"), "--interval", "4",
+        )
+        assert proc.returncode == 2
+        assert "checkpoint" in proc.stderr

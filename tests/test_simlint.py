@@ -124,6 +124,17 @@ class TestWallClockLint:
             + "\n  ".join(stale)
         )
 
+    def test_snapshot_plane_is_clock_free_from_day_one(self):
+        """Round 12's new module enters the lint covered and CLEAN: no
+        direct wall-clock constructs, no allowlist grant — snapshot
+        integrity checking and (de)serialization are pure functions of
+        bytes, and granting the module a clock seam it does not need
+        would only invite one.  The node-side fetch/revalidation
+        machinery lives in node/node.py under ITS existing grant and
+        reads time only through ``Node.clock``."""
+        assert _scan(PKG / "chain" / "snapshot.py") == set()
+        assert "chain/snapshot.py" not in ALLOWED
+
     def test_node_core_is_fully_seam_routed(self):
         """The headline: the node's consensus/session core reads NO
         host clock at all — every deadline, ban window, telemetry stamp
