@@ -325,6 +325,26 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Static analysis plane (round 13, p1_tpu/analysis): unsettled
+    # finding count (unallowlisted + stale grants — tier-1 holds it at
+    # zero, so ANY nonzero here is drift the round record must show)
+    # and the whole-package pass's wall time (the acceptance budget is
+    # ~5 s on this 1-vCPU host; creeping past it would push `p1 lint`
+    # out of the edit loop).
+    try:
+        from p1_tpu.analysis import run_analysis
+
+        t0 = time.perf_counter()
+        lint = run_analysis()
+        extra["lint_wall_s"] = round(time.perf_counter() - t0, 3)
+        extra["lint_findings"] = (
+            len(lint.violations) + len(lint.stale) + len(lint.parse_errors)
+        )
+        extra["lint_granted"] = len(lint.granted)
+        extra["lint_rules"] = len(lint.rules)
+    except ImportError:
+        pass  # installed as a bare package without the analysis tree
+
     from p1_tpu.hashx.perf_record import RECORDED_CPU_BASELINE_HPS
 
     print(

@@ -1,0 +1,119 @@
+"""The audited exceptions: rule -> file -> construct key -> REASON.
+
+Every entry is a sentence a reviewer can audit, not a bare pass.  Both
+directions are enforced by the engine (tests/test_analysis.py keeps
+the tree settled in tier-1):
+
+- a finding with no grant here fails the build;
+- a grant here that no finding consumes fails the build too — stale
+  grants rot into blanket permissions.
+
+Wall-clock grants migrated verbatim (same files, same constructs) from
+the retired tokenizer lint in tests/test_simlint.py; the reasons are
+its audit comments.  Two historical notes that shaped that list and
+still bind future edits:
+
+- node/protocol.py held a ``time.time`` grant for encode_block's
+  default send stamp until round 11: the codec now encodes 0.0 = "no
+  stamp" and every caller stamps from its own transport clock — the
+  stamp is INSIDE the frame bytes, so a codec-side host-clock read
+  made simulated flood traces nondeterministic.  Do not re-grant it.
+- chain/snapshot.py entered coverage clock-free with ZERO grants
+  (round 12) and must stay that way: snapshot integrity checking and
+  (de)serialization are pure functions of bytes, and granting the
+  module a clock seam it does not need would only invite one
+  (tests/test_simlint.py pins this by name).
+
+The four rules with no entries below — lost-task, unseeded-rng,
+set-iteration, await-state — currently hold over the WHOLE package
+with zero exceptions (round 13 fixed the two pre-existing findings:
+chaos.py's set-literal probe iteration and supervision.py's
+implicitly-seeded fallback rng rather than granting them).  Keep it
+that way where possible: for these rules a fix is almost always
+smaller than an audit-proof reason.
+"""
+
+from __future__ import annotations
+
+#: rule name -> file (relative to p1_tpu/) -> grant key -> audited reason.
+GRANTS: dict[str, dict[str, dict[str, str]]] = {
+    "wall-clock": {
+        # -- async product code running under the (possibly virtual)
+        #    loop: asyncio.sleep is loop-relative, sim-compatible BY
+        #    CONSTRUCTION — granted per file so a NEW module acquiring
+        #    sleeps is a deliberate edit, not a silent pass.
+        "node/node.py": {
+            "asyncio.sleep": "node coroutines sleep on their own loop; "
+            "the simulator virtualizes the loop itself",
+        },
+        "node/client.py": {
+            "asyncio.sleep": "light-client backoff sleeps ride the "
+            "caller's loop (virtual under netsim)",
+        },
+        # -- the simulator itself: sleeps are virtual here, and
+        #    time.monotonic guards REAL wall budgets (SimWallTimeout)
+        #    plus the scenario reports' wall_s — deliberate host reads.
+        "node/netsim.py": {
+            "time.monotonic": "SimWallTimeout real-wall budget + report "
+            "wall_s: deliberate host-clock reads about the sim, not in it",
+            "asyncio.sleep": "the virtual loop's own sleep primitive",
+        },
+        "node/scenarios.py": {
+            "time.monotonic": "scenario wall_s reporting and wall "
+            "budgets (same split as netsim.py)",
+            "asyncio.sleep": "scenario driver sleeps on the virtual loop",
+        },
+        "node/chaos.py": {
+            "time.monotonic": "chaos sweeps' SimWallTimeout budget and "
+            "report wall_s (same split as scenarios.py)",
+            "asyncio.sleep": "chaos schedules sleep on the virtual loop",
+        },
+        # -- harness/tooling that drives REAL processes and sockets on
+        #    the host clock by design (subprocess meshes, soak drivers,
+        #    operator runners) — not part of the simulated node.
+        "node/runner.py": {
+            "time.time": "operator soak runner: wall-clock deadlines "
+            "over real processes",
+            "time.monotonic": "real elapsed/rate figures for the soak "
+            "report",
+            "asyncio.sleep": "paces a REAL node's status polling",
+        },
+        "node/netharness.py": {
+            "time.time": "subprocess-mesh harness deadlines over real "
+            "sockets",
+            "asyncio.sleep": "real-socket settle/poll pacing",
+        },
+        "node/byzantine.py": {
+            "asyncio.sleep": "attacker session pacing under the "
+            "(possibly virtual) loop",
+        },
+        "node/testing.py": {
+            "asyncio.sleep": "hostile/greedy peer harness pacing under "
+            "the (possibly virtual) loop",
+        },
+        # -- the read-replica serving plane: a real-socket, separate-
+        #    process tier (`p1 serve`) out of the simulator's scope.
+        "node/queryplane.py": {
+            "time.monotonic": "replica uptime/QPS windows on the host "
+            "clock (separate process, never simulated)",
+            "asyncio.sleep": "replica refresh pacing on its own real loop",
+        },
+        # -- benchmark timing, not node behavior.
+        "chain/replay.py": {
+            "time.perf_counter": "replay throughput figures (the "
+            "benchmark IS a wall-clock measurement)",
+        },
+    },
+    "lost-task": {},
+    "unseeded-rng": {},
+    "set-iteration": {},
+    "blocking-in-async": {
+        # Currently EMPTY: no direct blocking calls run on any async
+        # loop today (store fsyncs go through sync helpers called from
+        # sync paths or asyncio.to_thread — see node.py's
+        # _checkpoint_mempool for the house pattern).  Grants added
+        # here are acknowledged ROADMAP item-5 debt: each one names a
+        # call the multi-core stage split must move off-loop.
+    },
+    "await-state": {},
+}

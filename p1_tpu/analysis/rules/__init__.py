@@ -1,0 +1,13 @@
+"""The rule set.  Importing this package registers every rule; the
+canonical list is what lives here — docs/LINT.md catalogs each rule's
+definition, the historical bug or ROADMAP item that motivates it, and
+how to grant an exception."""
+
+from p1_tpu.analysis.rules import (  # noqa: F401  (registration side effect)
+    awaitstate,
+    blocking,
+    losttask,
+    rng,
+    setiter,
+    wallclock,
+)

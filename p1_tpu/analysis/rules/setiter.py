@@ -1,0 +1,96 @@
+"""set-iteration: consensus and sim paths must not iterate unordered.
+
+Python sets iterate in hash-table order, which varies with insertion
+history and (for str keys under hash randomization) across processes.
+Round 7 fixed this class BY HAND twice to get byte-identical sim
+traces: peer/address bookkeeping moved from ``set`` to insertion-
+ordered ``dict[key, None]`` so relay fan-out and dial order stopped
+depending on hash order.  Any *new* ``for x in some_set_expression``
+in a covered path reintroduces trace divergence — and in consensus
+code, ordering-dependent tie-breaks.
+
+Flagged — direct iteration (for / async for / comprehension clauses)
+over an expression that is structurally a set:
+
+- a set literal or a ``set(...)``/``frozenset(...)`` call;
+- a binary set operation (``-``/``|``/``&``/``^``) with such an
+  operand, or with a ``.keys()`` view operand (the "dict-keys
+  difference" shape: ``d.keys() - seen``);
+- a ``.difference/.union/.intersection/.symmetric_difference`` call.
+
+Not flagged: ``sorted(set(...))`` (the sort normalizes the order —
+and structurally the loop iterates the ``sorted`` call, not the set);
+membership tests; iteration over a plain ``dict``/``.keys()`` view
+(insertion-ordered by language guarantee); sets reaching the loop
+through a variable (type inference is out of scope — the fixture
+corpus and review carry that residue).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from p1_tpu.analysis.base import Rule, dotted_name, register
+from p1_tpu.analysis.findings import Finding
+
+_SET_METHODS = frozenset(
+    {"difference", "union", "intersection", "symmetric_difference"}
+)
+_SET_OPS = (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call):
+        dotted = dotted_name(node.func)
+        if dotted in ("set", "frozenset"):
+            return True
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SET_METHODS
+        ):
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, _SET_OPS):
+        return _is_set_expr(node.left) or _is_set_expr(node.right) or (
+            _is_keys_view(node.left) or _is_keys_view(node.right)
+        )
+    return False
+
+
+def _is_keys_view(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "keys"
+        and not node.args
+    )
+
+
+@register
+class SetIterationRule(Rule):
+    name = "set-iteration"
+    title = "iteration over an unordered set expression"
+    #: The deterministic-trace product tree, same coverage as wall-clock.
+    scope = ("node/", "chain/", "mempool/")
+
+    def check(self, tree: ast.Module, rel: str) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            iters: list[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if _is_set_expr(it):
+                    yield self.finding(
+                        rel,
+                        it,
+                        "iterating an unordered set expression — sort it, "
+                        "or keep insertion order with dict[key, None] "
+                        "(the round-7 trace-determinism fix)",
+                        "set-expr",
+                    )

@@ -683,6 +683,31 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     sub.add_parser("bench", help="headline benchmark (one JSON line)")
+
+    p = sub.add_parser(
+        "lint",
+        help="determinism/async-safety static analysis over p1_tpu "
+        "(exit 0 clean, 1 findings or stale grants, 2 usage)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="full machine-readable report on stdout",
+    )
+    p.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="run only this rule (repeatable; default: all registered "
+        "rules — see docs/LINT.md for the catalog)",
+    )
+    p.add_argument(
+        "--granted",
+        action="store_true",
+        help="also list allowlisted findings with their grant reasons",
+    )
     return parser
 
 
@@ -1725,6 +1750,51 @@ def cmd_net(args) -> int:
     return run_net(args)
 
 
+def cmd_lint(args) -> int:
+    """`p1 lint`: the AST determinism/async-safety pass (p1_tpu/analysis).
+
+    Exit-code contract (tests/test_cli.py pins it): 0 = every rule
+    clean (no unallowlisted findings, no stale grants), 1 = violations,
+    2 = usage (argparse errors and unknown --rule names)."""
+    from p1_tpu.analysis import RULES, run_analysis
+    from p1_tpu.analysis.allowlist import GRANTS
+
+    if args.rule:
+        unknown = [r for r in args.rule if r not in RULES]
+        if unknown:
+            print(
+                f"p1 lint: unknown rule(s) {', '.join(sorted(unknown))} "
+                f"(have: {', '.join(sorted(RULES))})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [RULES[r] for r in args.rule]
+    else:
+        rules = None
+
+    report = run_analysis(rules=rules)
+    if args.as_json:
+        print(json.dumps(report.to_json()))
+    else:
+        for f in report.violations:
+            print(f)
+        for s in report.stale:
+            print(f"stale grant: {s}")
+        for e in report.parse_errors:
+            print(f"parse error: {e}")
+        if args.granted:
+            for f in report.granted:
+                reason = GRANTS[f.rule][f.file][f.key]
+                print(f"granted: {f}  [{reason}]")
+        print(
+            f"p1 lint: {report.files} files, {len(report.rules)} rules, "
+            f"{len(report.violations)} violation(s), "
+            f"{len(report.granted)} granted, {len(report.stale)} stale "
+            f"grant(s)"
+        )
+    return 0 if report.clean else 1
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root (the driver contract), one level above
     # the package — resolve it by path so `p1 bench` works from any cwd.
@@ -1768,6 +1838,7 @@ def main(argv=None) -> int:
         "net": cmd_net,
         "sim": cmd_sim,
         "chaos": cmd_chaos,
+        "lint": cmd_lint,
         "bench": cmd_bench,
     }[args.cmd]
     return handler(args)

@@ -889,7 +889,11 @@ class _ChaosRunner:
         for host, node in self.net.nodes.items():
             chain = node.chain
             tip = chain.tip
-            for height in {1, chain.height // 2, chain.height}:
+            # sorted: the dedup set must not pick the probe order, or
+            # the violation list (and any repro built from it) rides
+            # hash order — the exact class `p1 lint`'s set-iteration
+            # rule pins.
+            for height in sorted({1, chain.height // 2, chain.height}):
                 bhash = chain.main_hash_at(height)
                 if bhash is None:
                     continue

@@ -234,6 +234,12 @@ class NodeMetrics:
     #: spent their whole failover budget.  The compact-block GETBLOCKTXN
     #: round and paged mempool sync are supervised under the same
     #: deadline with their own stall counters.
+    #: Background tasks (dials, sync failovers, recovery loops) that
+    #: died of an UNEXPECTED exception — observed and logged by their
+    #: done-callbacks instead of rotting in the GC's "exception was
+    #: never retrieved" limbo (the lost-task lint rule's bug class).
+    #: Nonzero here always deserves a look at the error log.
+    task_crashes: int = 0
     sync_stalls: int = 0
     sync_failovers: int = 0
     sync_demotions: int = 0
@@ -648,7 +654,21 @@ class Node:
     # -- lifecycle -------------------------------------------------------
 
     def _untrack_session(self, task) -> None:
+        """Done-callback for fire-and-forget session tasks (dials, sync
+        failovers): untrack, and OBSERVE a crash.  Without the
+        ``exception()`` read, a task dying of a bug is silent until the
+        GC maybe logs "exception was never retrieved" — the round-3
+        dead-recovery-loop failure shape the lost-task lint rule pins.
+        Expected connection-layer failures are handled inside the tasks
+        themselves; anything surfacing HERE is a programming error, so
+        it is logged loudly and counted."""
         self._sessions.pop(task, None)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self.metrics.task_crashes += 1
+            log.error("session task %r died: %r", task.get_name(), exc)
 
     def _addr_book_path(self):
         return (

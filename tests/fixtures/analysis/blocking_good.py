@@ -1,0 +1,29 @@
+"""GOOD fixture: blocking work dispatched OFF the loop.
+
+The sync helper may open/fsync freely — it runs in a worker via
+``asyncio.to_thread`` (node.py's ``_checkpoint_mempool`` house
+pattern).  A nested sync ``def`` resets the async context: its body
+runs wherever it is CALLED, which for these helpers is off-loop.
+"""
+
+import asyncio
+import os
+import time
+
+
+def sync_append(path, payload) -> None:
+    with open(path, "ab") as fh:
+        fh.write(payload)
+        os.fsync(fh.fileno())
+
+
+async def checkpoint(path, payload) -> None:
+    await asyncio.to_thread(sync_append, path, payload)
+
+
+async def pace() -> None:
+    await asyncio.sleep(0.01)  # the loop-relative sleep spelling
+
+
+def bench() -> None:
+    time.sleep(0.01)  # sync context: no loop to stall

@@ -1,0 +1,26 @@
+"""BAD fixture: spawned task handles nobody holds.
+
+``_store_fail`` reproduces the round-3 historical bug (then in
+node/node.py): the store-recovery loop was spawned fire-and-forget,
+died of an unhandled exception, and the node sat degraded serve-only
+forever with nothing logged — nobody held the handle, so nobody
+observed the death.  The fix is the ``_spawn_store_recovery`` +
+``_store_recovery_done`` pattern: track, log, respawn.
+"""
+
+import asyncio
+
+
+class Node:
+    async def _store_fail(self) -> None:
+        asyncio.create_task(self._store_recovery_loop())  # LINT
+
+    async def _dial(self, addr) -> None:
+        task = asyncio.create_task(self._dial_once(addr))  # LINT
+
+    async def _legacy_spawn(self) -> None:
+        asyncio.ensure_future(self._dial_once(None))  # LINT
+
+    async def _store_recovery_loop(self) -> None: ...
+
+    async def _dial_once(self, addr) -> None: ...

@@ -1,0 +1,31 @@
+"""GOOD fixture: every handle is stored, awaited, or callback'd."""
+
+import asyncio
+
+
+class Node:
+    def __init__(self):
+        self._sessions = {}
+        self._task = None
+
+    async def spawn_stored(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def spawn_tracked(self) -> None:
+        # the node's session pattern: container + done-callback that
+        # unregisters AND observes a crash
+        task = asyncio.create_task(self._loop())
+        self._sessions[task] = None
+        task.add_done_callback(self._sessions.pop)
+
+    async def spawn_awaited(self) -> None:
+        await asyncio.create_task(self._loop())
+
+    async def spawn_cancelled_later(self) -> None:
+        t = asyncio.create_task(self._loop())
+        try:
+            await asyncio.sleep(1)
+        finally:
+            t.cancel()
+
+    async def _loop(self) -> None: ...

@@ -1,0 +1,20 @@
+"""BAD fixture: unseeded/ambient randomness (sim traces diverge)."""
+
+import random
+
+
+def jitter() -> float:
+    rng = random.Random()  # LINT
+    return rng.random()
+
+
+def pick(items):
+    return random.choice(items)  # LINT
+
+
+def roll() -> float:
+    return random.random()  # LINT
+
+
+def shuffle_peers(peers) -> None:
+    random.shuffle(peers)  # LINT

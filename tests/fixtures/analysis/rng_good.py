@@ -1,0 +1,23 @@
+"""GOOD fixture: every draw descends from a derived seed — or spells
+OS entropy explicitly where production randomness is the point."""
+
+import random
+import secrets
+
+
+def jitter(seed: int) -> float:
+    rng = random.Random(seed ^ 0x70B0)  # derived: the scenarios.py idiom
+    return rng.random()
+
+
+def draw(rng: random.Random, items):
+    return rng.choice(items)  # instance draw, injected by the caller
+
+
+def identity_nonce() -> int:
+    return secrets.randbits(64) | 1  # production identity: entropy intended
+
+
+def production_rng() -> random.Random:
+    # explicit OS-entropy seed: the supervision.py round-13 fix spelling
+    return random.Random(secrets.randbits(64))

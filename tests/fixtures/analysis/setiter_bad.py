@@ -1,0 +1,32 @@
+"""BAD fixture: iteration order rides the hash table.
+
+``announce`` reproduces the round-7 historical class (relay/dial order
+depended on set order until peer bookkeeping moved to insertion-ordered
+dicts); ``probe`` reproduces round 13's chaos.py finding (invariant
+probe heights iterated from a set literal, so violation-report order —
+and any repro shrunk from it — rode hash order).
+"""
+
+
+def announce(want, have, send):
+    for h in set(want) - set(have):  # LINT
+        send(h)
+
+
+def probe(height: int):
+    for h in {1, height // 2, height}:  # LINT
+        yield h
+
+
+def unseen(book: dict, seen: dict):
+    for key in book.keys() - seen.keys():  # LINT
+        yield key
+
+
+def union_scan(a, b):
+    return [x for x in set(a) | set(b)]  # LINT
+
+
+def trimmed(peers, banned):
+    for p in frozenset(peers).difference(banned):  # LINT
+        yield p

@@ -1,0 +1,23 @@
+"""GOOD fixture: dedup without surrendering the iteration order."""
+
+
+def announce(want, have, send):
+    for h in sorted(set(want) - set(have)):  # the sort normalizes
+        send(h)
+
+
+def fanout(peers: dict):
+    # dict[key, None] as an insertion-ordered set: the round-7 fix
+    for peer in peers:
+        yield peer
+
+
+def probe(height: int):
+    for h in sorted({1, height // 2, height}):  # the chaos.py r13 fix
+        yield h
+
+
+def membership(want, have):
+    # sets for MEMBERSHIP are fine — only iteration leaks the order
+    have_set = set(have)
+    return [h for h in want if h not in have_set]

@@ -1,0 +1,33 @@
+"""BAD fixture: every `# LINT` line must be flagged by wall-clock.
+
+``encode_block`` reproduces the round-11 historical bug (then in
+node/protocol.py): a codec DEFAULT stamping send time from the host
+clock put nondeterminism inside frame bytes, so simulated flood traces
+diverged run-to-run.  The fix encoded 0.0 = "no stamp" and moved
+stamping to callers' transport clocks.
+"""
+
+import asyncio
+import time
+from datetime import datetime
+
+
+def encode_block(payload: bytes, when=None) -> bytes:
+    stamp = when if when is not None else time.time()  # LINT
+    return payload + repr(stamp).encode()
+
+
+def deadline(budget_s: float) -> float:
+    return time.monotonic() + budget_s  # LINT
+
+
+def bench() -> float:
+    return time.perf_counter()  # LINT
+
+
+def log_stamp() -> str:
+    return datetime.now().isoformat()  # LINT
+
+
+async def pace() -> None:
+    await asyncio.sleep(0.1)  # LINT

@@ -1,0 +1,23 @@
+"""GOOD fixture: clock access routed through the injectable seam.
+
+The bare ``time.monotonic`` default is the house pattern the AST rule
+handles structurally: a REFERENCE is not a call, so no grant is needed
+(the retired tokenizer scanner got this right only by substring luck).
+"""
+
+import time
+
+
+def deadline(budget_s: float, clock=time.monotonic) -> float:
+    return clock() + budget_s
+
+
+class Node:
+    def __init__(self, clock):
+        self.clock = clock
+
+    def stamp(self) -> float:
+        return self.clock.time()  # the seam's clock, not the host's
+
+    def age(self, since: float) -> float:
+        return self.clock.monotonic() - since

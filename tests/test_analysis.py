@@ -1,0 +1,252 @@
+"""The determinism/async-safety analyzer (p1_tpu/analysis).
+
+Three layers, mirroring the retired wall-clock lint's structure but
+generalized over the whole rule registry:
+
+1. **The tier-1 gate**: every registered rule over the whole package —
+   zero unallowlisted findings, zero stale grants, zero parse errors.
+   This is the test that makes the analyzer ENFORCED rather than
+   advisory.
+2. **The fixture corpus**: per rule, a known-bad module (every line
+   marked ``# LINT`` flagged at exactly that line, nothing else) and a
+   known-good module (zero findings).  The bad fixtures include a
+   reproduction of each historical bug the rule would have caught
+   (round 11 codec stamp, round 3 dead recovery loop, round 7/13 set
+   iteration...), so the rules provably cover the incidents that
+   motivated them.
+3. **The settlement machinery**: grants suppress exactly their
+   (rule, file, key); unused grants and grants on vanished files or
+   unknown rules surface as stale.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+import pytest
+
+from p1_tpu.analysis import RULES, run_analysis
+from p1_tpu.analysis.engine import PKG_ROOT
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+
+#: rule name -> fixture file prefix.
+_RULE_FIXTURES = {
+    "wall-clock": "wallclock",
+    "lost-task": "losttask",
+    "unseeded-rng": "rng",
+    "set-iteration": "setiter",
+    "blocking-in-async": "blocking",
+    "await-state": "awaitstate",
+}
+
+
+def _rule_findings(rule_name: str, path: Path):
+    """Run ONE rule over a fixture, under a rel path inside every
+    rule's scope (the fixture corpus tests rule logic, not scoping)."""
+    tree = ast.parse(path.read_bytes(), filename=path.name)
+    return list(RULES[rule_name].check(tree, f"node/{path.name}"))
+
+
+def _marked_lines(path: Path) -> set[int]:
+    return {
+        i
+        for i, line in enumerate(path.read_text().splitlines(), start=1)
+        if line.rstrip().endswith("# LINT")
+    }
+
+
+class TestTier1Gate:
+    def test_whole_package_settles_clean(self):
+        """THE gate: ≥6 rules over every module in p1_tpu, everything
+        either fixed or granted with a reason, no grant unused."""
+        report = run_analysis()
+        assert len(report.rules) >= 6, report.rules
+        assert report.files >= 60, report.files  # the walk found the tree
+        assert not report.parse_errors, report.parse_errors
+        assert not report.violations, "unallowlisted findings:\n  " + "\n  ".join(
+            str(f) for f in report.violations
+        )
+        assert not report.stale, "stale grants:\n  " + "\n  ".join(report.stale)
+        assert report.clean
+
+    def test_registry_matches_fixture_corpus(self):
+        """Every registered rule carries a bad/good fixture pair — a
+        new rule cannot land untested, and a renamed rule cannot orphan
+        its fixtures silently."""
+        assert set(RULES) == set(_RULE_FIXTURES)
+        for prefix in _RULE_FIXTURES.values():
+            assert (FIXTURES / f"{prefix}_bad.py").exists(), prefix
+            assert (FIXTURES / f"{prefix}_good.py").exists(), prefix
+
+    def test_analyzer_is_fast_enough_for_tier1(self):
+        """The whole-package pass must stay interactive (the acceptance
+        budget is ~5 s on a 1-vCPU host; the generous bound here exists
+        to catch an accidental O(n^2) pass, not to time the machine)."""
+        import time
+
+        t0 = time.perf_counter()
+        run_analysis()
+        assert time.perf_counter() - t0 < 15.0
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("rule_name,prefix", sorted(_RULE_FIXTURES.items()))
+    def test_bad_fixture_flagged_at_exact_lines(self, rule_name, prefix):
+        path = FIXTURES / f"{prefix}_bad.py"
+        expected = _marked_lines(path)
+        assert expected, f"{path.name} carries no # LINT markers"
+        got = {f.line for f in _rule_findings(rule_name, path)}
+        assert got == expected, (
+            f"{rule_name} over {path.name}: flagged {sorted(got)}, "
+            f"marked {sorted(expected)}"
+        )
+
+    @pytest.mark.parametrize("rule_name,prefix", sorted(_RULE_FIXTURES.items()))
+    def test_good_fixture_is_clean(self, rule_name, prefix):
+        path = FIXTURES / f"{prefix}_good.py"
+        findings = _rule_findings(rule_name, path)
+        assert not findings, [str(f) for f in findings]
+
+    def test_findings_carry_file_line_rule_detail(self):
+        f = _rule_findings("lost-task", FIXTURES / "losttask_bad.py")[0]
+        assert f.file == "node/losttask_bad.py"
+        assert f.rule == "lost-task"
+        assert f.line > 0 and f.detail
+        assert str(f).startswith(f"node/losttask_bad.py:{f.line}: [lost-task]")
+
+
+class TestHistoricalReproductions:
+    """Each rule's bad fixture embeds the incident that motivated it;
+    these tests name the incidents so the corpus cannot quietly drop
+    one in a refactor."""
+
+    def test_round11_codec_host_stamp_is_caught(self):
+        # node/protocol.py's encode_block default put time.time() INSIDE
+        # frame bytes — the wall-clock rule flags the reproduction.
+        path = FIXTURES / "wallclock_bad.py"
+        assert any(
+            f.key == "time.time" and "encode_block" in path.read_text()
+            for f in _rule_findings("wall-clock", path)
+        )
+
+    def test_round3_dead_recovery_loop_is_caught(self):
+        # The fire-and-forget store-recovery spawn whose silent death
+        # stranded the node degraded forever.
+        findings = _rule_findings("lost-task", FIXTURES / "losttask_bad.py")
+        assert any(f.key == "_store_fail" for f in findings)
+
+    def test_round7_and_round13_set_iteration_is_caught(self):
+        # Relay fan-out over a set difference (r7) and the chaos plane's
+        # set-literal probe heights (r13, fixed in this round).
+        findings = _rule_findings("set-iteration", FIXTURES / "setiter_bad.py")
+        assert len(findings) >= 2
+
+
+class TestSettlement:
+    """The allowlist machinery itself, on a tiny synthetic tree."""
+
+    def _tiny_pkg(self, tmp_path: Path) -> Path:
+        root = tmp_path / "pkg"
+        root.mkdir()
+        (root / "mod.py").write_text(
+            "import random\n\n\ndef f():\n    return random.random()\n"
+        )
+        return root
+
+    def test_ungranted_finding_is_a_violation(self, tmp_path):
+        report = run_analysis(
+            root=self._tiny_pkg(tmp_path),
+            rules=[RULES["unseeded-rng"]],
+            grants={},
+        )
+        assert [f.key for f in report.violations] == ["random.random"]
+        assert not report.stale
+
+    def test_grant_suppresses_and_is_consumed(self, tmp_path):
+        report = run_analysis(
+            root=self._tiny_pkg(tmp_path),
+            rules=[RULES["unseeded-rng"]],
+            grants={"unseeded-rng": {"mod.py": {"random.random": "test"}}},
+        )
+        assert not report.violations and not report.stale
+        assert [f.key for f in report.granted] == ["random.random"]
+        assert report.clean
+
+    def test_unused_grant_goes_stale(self, tmp_path):
+        report = run_analysis(
+            root=self._tiny_pkg(tmp_path),
+            rules=[RULES["unseeded-rng"]],
+            grants={
+                "unseeded-rng": {
+                    "mod.py": {
+                        "random.random": "used",
+                        "random.shuffle": "nothing emits this",
+                    },
+                    "gone.py": {"random.random": "file vanished"},
+                }
+            },
+        )
+        assert sorted(report.stale) == [
+            "unseeded-rng: gone.py: file no longer exists",
+            "unseeded-rng: mod.py: grant 'random.shuffle' never used",
+        ]
+        assert not report.clean
+
+    def test_partial_run_leaves_other_rules_grants_alone(self, tmp_path):
+        """`p1 lint --rule X` must not report rule Y's grants stale."""
+        report = run_analysis(
+            root=self._tiny_pkg(tmp_path),
+            rules=[RULES["lost-task"]],
+            grants={"unseeded-rng": {"mod.py": {"random.random": "r"}}},
+        )
+        assert not report.stale and not report.violations
+
+    def test_parse_error_is_reported_not_skipped(self, tmp_path):
+        root = tmp_path / "pkg"
+        root.mkdir()
+        (root / "broken.py").write_text("def f(:\n")
+        report = run_analysis(root=root, rules=[RULES["lost-task"]], grants={})
+        assert report.parse_errors and not report.clean
+
+    def test_real_package_files_walk(self):
+        rels = [rel for rel, _ in __import__(
+            "p1_tpu.analysis.engine", fromlist=["package_files"]
+        ).package_files(PKG_ROOT)]
+        assert "node/node.py" in rels
+        assert "analysis/engine.py" in rels  # the analyzer analyzes itself
+        assert not any("__pycache__" in r for r in rels)
+
+
+class TestGrantHygiene:
+    def test_grant_under_unknown_rule_is_stale_even_on_partial_runs(
+        self, tmp_path
+    ):
+        """A renamed rule must not orphan its grant table silently."""
+        root = tmp_path / "pkg"
+        root.mkdir()
+        (root / "mod.py").write_text("x = 1\n")
+        report = run_analysis(
+            root=root,
+            rules=[RULES["lost-task"]],
+            grants={"no-such-rule": {"mod.py": {"k": "r"}}},
+        )
+        assert report.stale == ["no-such-rule: no such rule"]
+
+    def test_every_registered_rule_has_an_allowlist_section(self):
+        """The allowlist names every rule (even if empty) so a reviewer
+        sees the full settlement surface in one file."""
+        from p1_tpu.analysis.allowlist import GRANTS
+
+        assert set(GRANTS) == set(RULES)
+
+    def test_every_grant_carries_a_nonempty_reason(self):
+        from p1_tpu.analysis.allowlist import GRANTS
+
+        for rule, by_file in GRANTS.items():
+            for rel, keys in by_file.items():
+                for key, reason in keys.items():
+                    assert (
+                        isinstance(reason, str) and len(reason) >= 10
+                    ), f"{rule}/{rel}/{key}: grant reason too thin"

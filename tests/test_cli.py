@@ -1020,3 +1020,55 @@ class TestSnapshotCLI:
         )
         assert proc.returncode == 2
         assert "checkpoint" in proc.stderr
+
+
+class TestLint:
+    """`p1 lint` (round 13): the determinism/async-safety analyzer's
+    exit-code contract — 0 = every rule settles clean against the
+    allowlist, 1 = violations or stale grants, 2 = usage — plus the
+    JSON report shape the round records consume."""
+
+    def _lint(self, *argv):
+        return subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "lint", *argv],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+
+    def test_help_smoke(self):
+        proc = self._lint("--help")
+        assert proc.returncode == 0
+        assert "--json" in proc.stdout and "--rule" in proc.stdout
+
+    def test_clean_tree_exit_0(self):
+        proc = self._lint()
+        assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+        assert "0 violation(s)" in proc.stdout
+        assert "0 stale grant(s)" in proc.stdout
+
+    def test_json_report_shape(self):
+        proc = self._lint("--json")
+        assert proc.returncode == 0
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["clean"] is True
+        assert len(out["rules"]) >= 6
+        assert out["violations"] == [] and out["stale"] == []
+        # granted findings carry the Finding shape the docs promise
+        f = out["granted"][0]
+        assert set(f) == {"file", "line", "rule", "detail", "key"}
+
+    def test_single_rule_run(self):
+        proc = self._lint("--rule", "wall-clock")
+        assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+        assert "1 rules" in proc.stdout
+
+    def test_unknown_rule_is_usage_error_exit_2(self):
+        proc = self._lint("--rule", "no-such-rule")
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
+
+    def test_bad_flag_is_usage_error_exit_2(self):
+        proc = self._lint("--no-such-flag")
+        assert proc.returncode == 2

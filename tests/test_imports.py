@@ -44,6 +44,9 @@ def test_walk_found_the_tree():
     names = _all_modules()
     assert len(names) > 30, names
     for expected in (
+        "p1_tpu.analysis.engine",
+        "p1_tpu.analysis.rules.wallclock",
+        "p1_tpu.analysis.rules.awaitstate",
         "p1_tpu.core.keys",
         "p1_tpu.core._ed25519",
         "p1_tpu.core.sigcache",

@@ -765,3 +765,61 @@ class TestMempoolUnit:
         # reorg abandons the t1 block: t1 comes back
         pool.apply_block_delta((block_with([t1]),), (block_with([t2]),))
         assert t1.txid() in pool and t2.txid() not in pool
+
+
+class TestLostTaskObservation:
+    """Round 13 lost-task audit fix: fire-and-forget session tasks
+    (dials, sync failovers) ride ``_sessions`` + ``_untrack_session``;
+    a task dying with an exception must be OBSERVED — logged and
+    counted in ``metrics.task_crashes`` — not stranded in the GC's
+    "exception was never retrieved" limbo (the round-3
+    dead-recovery-loop failure shape the lost-task lint rule pins)."""
+
+    def test_session_task_crash_is_logged_and_counted(self, caplog):
+        import logging
+
+        holder = {}
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            holder["node"] = node
+            try:
+
+                async def boom():
+                    raise RuntimeError("session bug")
+
+                task = asyncio.get_running_loop().create_task(boom())
+                node._sessions[task] = None
+                task.add_done_callback(node._untrack_session)
+                assert await wait_until(
+                    lambda: node.metrics.task_crashes == 1
+                )
+                assert task not in node._sessions
+            finally:
+                await node.stop()
+
+        with caplog.at_level(logging.ERROR, logger="p1_tpu.node"):
+            run(scenario())
+        assert holder["node"].metrics.task_crashes == 1
+        assert any(
+            "died" in rec.getMessage() for rec in caplog.records
+        ), [rec.getMessage() for rec in caplog.records]
+
+    def test_cancelled_session_task_is_not_a_crash(self):
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                task = asyncio.get_running_loop().create_task(
+                    asyncio.sleep(30)
+                )
+                node._sessions[task] = None
+                task.add_done_callback(node._untrack_session)
+                task.cancel()
+                assert await wait_until(lambda: task not in node._sessions)
+                assert node.metrics.task_crashes == 0
+            finally:
+                await node.stop()
+
+        run(scenario())

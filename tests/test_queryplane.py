@@ -972,3 +972,55 @@ class TestImportHealthExtension:
             "p1_tpu.node.queryplane",
         ):
             importlib.import_module(name)
+
+
+class TestRefreshLoopSupervision:
+    def test_refresh_loop_crash_is_observed_and_respawned(
+        self, tmp_path, caplog
+    ):
+        """Round 13 lost-task audit fix: the refresh loop handles
+        expected per-tick faults (OSError/ValueError) itself, but an
+        UNEXPECTED exception used to kill the task silently — the
+        replica then served an ever-staler tip with no sign of trouble
+        until stop().  The done-callback must log the wreck and respawn
+        the loop while the server is still running."""
+        import logging
+
+        store = tmp_path / "chain.dat"
+        save_chain(build_chain(3, difficulty=1), store)
+
+        async def scenario():
+            srv = await serve_replica(store, 1, refresh_interval_s=0.01)
+            try:
+                first = srv._refresh_task
+                real_refresh = srv.view.refresh
+
+                def boom():
+                    raise RuntimeError("refresh bug")
+
+                srv.view.refresh = boom
+                assert await wait_until(lambda: first.done(), timeout=10)
+                assert await wait_until(
+                    lambda: srv._refresh_task is not None
+                    and srv._refresh_task is not first,
+                    timeout=10,
+                )
+                # The respawned loop is live: the next tick calls the
+                # (healed) refresh again.
+                healed = asyncio.Event()
+
+                def heal():
+                    healed.set()
+                    return real_refresh()
+
+                srv.view.refresh = heal
+                assert await wait_until(healed.is_set, timeout=10)
+            finally:
+                await srv.stop()
+
+        with caplog.at_level(logging.ERROR, logger="p1_tpu.queryplane"):
+            run(scenario())
+        assert any(
+            "refresh loop died" in rec.getMessage()
+            for rec in caplog.records
+        ), [rec.getMessage() for rec in caplog.records]

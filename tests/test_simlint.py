@@ -1,145 +1,90 @@
-"""Static wall-clock lint: keep node/ and chain/ simulator-compatible.
+"""Wall-clock lint headline tests, now riding the AST analyzer.
 
-The transport seam (node/transport.py) exists so every clock read in
-the node goes through an injectable ``Clock`` and every sleep/deadline
-through the event loop — which is what lets node/netsim.py virtualize a
-thousand nodes deterministically.  One future ``time.time()`` in a
-consensus or session path silently re-couples the node to the host
-clock: the sim still RUNS, but deadlines stop scaling with virtual time
-and same-seed traces drift.  This tier-1 lint greps the product tree
-for direct wall-clock constructs outside an explicit allowlist, so the
-hole is caught at commit time, not three rounds later in a flaky soak.
+Round 13 retired this file's tokenizer scanner in favor of
+``p1_tpu/analysis`` (rules/wallclock.py): same patterns, same file
+coverage (node/, chain/, mempool/), same allowlist CONTENTS (now in
+p1_tpu/analysis/allowlist.py with per-grant reasons), but matched
+structurally — only real ``ast.Call`` nodes count, so an
+injectable-clock default argument (``clock=time.monotonic``) is clean
+by construction rather than by token-join accident, and a name merely
+*ending* in a pattern can no longer false-positive.
 
-``asyncio.sleep`` / ``asyncio.wait_for`` are loop-relative — the
-simulator virtualizes the loop itself, so they are sim-compatible BY
-CONSTRUCTION and allowed wherever async code runs under the node's
-loop.  They are still matched and allowlisted per file: a *new* module
-acquiring sleeps is worth a deliberate allowlist edit (is this file
-really always run under the virtual loop?), not a silent pass.
+What this file keeps is the HEADLINE guarantees, under their original
+names, so the migration provably regressed no coverage:
+
+- every wall-clock construct outside the allowlist fails (the generic
+  sweep also runs in tests/test_analysis.py with every other rule);
+- the allowlist carries no stale grants;
+- chain/snapshot.py is clock-free with ZERO grants;
+- node/node.py's consensus core reads no host clock at all.
+
+The full-tree/all-rules gate and the per-rule fixture corpus live in
+tests/test_analysis.py.
 """
 
-import tokenize
-from pathlib import Path
-
-PKG = Path(__file__).resolve().parent.parent / "p1_tpu"
-
-#: Constructs that read the HOST clock (or sleep) directly.
-_PATTERNS = (
-    "time.time(",
-    "time.monotonic(",
-    "time.perf_counter(",
-    "datetime.now(",
-    "asyncio.sleep(",
-)
-
-#: file (relative to p1_tpu/) -> allowed constructs, each with a reason
-#: a reviewer can audit.  Anything NOT listed here must be clock-seam
-#: clean; anything listed but unused fails too (stale grants rot).
-ALLOWED: dict[str, set[str]] = {
-    # (The seam itself — node/transport.py — and the injectable-clock
-    # DEFAULT arguments elsewhere hold bare ``time.monotonic``
-    # references without calling them; the tokenizer scan below only
-    # flags calls, so they need no grants.  node/protocol.py held a
-    # ``time.time(`` grant for encode_block's default send stamp until
-    # round 11: the codec now encodes 0.0 = "no stamp" and every caller
-    # stamps from its own transport clock — the stamp is INSIDE the
-    # frame bytes, so a codec-side host-clock read made simulated flood
-    # traces nondeterministic.)
-    # Async product code running under the (possibly virtual) loop.
-    "node/node.py": {"asyncio.sleep("},
-    "node/client.py": {"asyncio.sleep("},
-    # The simulator itself: asyncio.sleep IS virtual here, and
-    # time.monotonic guards REAL wall budgets (SimWallTimeout) plus the
-    # scenario reports' wall_s — deliberate host-clock reads.
-    "node/netsim.py": {"time.monotonic(", "asyncio.sleep("},
-    "node/scenarios.py": {"time.monotonic(", "asyncio.sleep("},
-    # The chaos plane: same split as scenarios.py — sleeps are virtual,
-    # time.monotonic is the SimWallTimeout budget + report wall_s.
-    "node/chaos.py": {"time.monotonic(", "asyncio.sleep("},
-    # Harness/tooling that drives REAL processes and sockets on the
-    # host clock by design (subprocess meshes, soak drivers, operator
-    # runners) — not part of the simulated node.
-    "node/runner.py": {"time.time(", "time.monotonic(", "asyncio.sleep("},
-    "node/netharness.py": {"time.time(", "asyncio.sleep("},
-    "node/byzantine.py": {"asyncio.sleep("},
-    "node/testing.py": {"asyncio.sleep("},
-    # The read-replica serving plane: a real-socket, separate-process
-    # tier (`p1 serve`) that is out of the simulator's scope.
-    "node/queryplane.py": {"time.monotonic(", "asyncio.sleep("},
-    # Benchmark timing (replay throughput figures), not node behavior.
-    "chain/replay.py": {"time.perf_counter("},
-}
-
-def _scan(path: Path) -> set[str]:
-    """Patterns present as CODE (comments and strings stripped; tokens
-    re-joined without whitespace, so ``time.time (...)`` and
-    ``time.time(...)`` both read ``time.time(`` while a bare
-    ``clock=time.monotonic`` default-argument reference does not)."""
-    with open(path, "rb") as fh:
-        code = "".join(
-            tok.string
-            for tok in tokenize.tokenize(fh.readline)
-            if tok.type not in (tokenize.COMMENT, tokenize.STRING)
-        )
-    return {pat for pat in _PATTERNS if pat in code}
+from p1_tpu.analysis import RULES, run_analysis
+from p1_tpu.analysis.allowlist import GRANTS
+from p1_tpu.analysis.engine import PKG_ROOT
 
 
-def _product_files():
-    # mempool/ joined the covered set in round 11: pool admission
-    # stamps and TTL ages ride the node's injected clock now, so chaos
-    # schedules that crash/recover nodes see deterministic checkpoint
-    # ages — and stay that way.
-    for sub in ("node", "chain", "mempool"):
-        for path in sorted((PKG / sub).glob("*.py")):
-            yield f"{sub}/{path.name}", path
+def _wallclock_report():
+    return run_analysis(rules=[RULES["wall-clock"]])
 
 
 class TestWallClockLint:
     def test_no_direct_wall_clock_outside_the_allowlist(self):
-        violations = []
-        for rel, path in _product_files():
-            found = _scan(path)
-            extra = found - ALLOWED.get(rel, set())
-            if extra:
-                violations.append(f"{rel}: {sorted(extra)}")
-        assert not violations, (
+        report = _wallclock_report()
+        assert not report.violations, (
             "direct wall-clock/sleep constructs outside the blessed "
-            "seams (route them through the node's Clock, or extend the "
-            "allowlist with a reason):\n  " + "\n  ".join(violations)
+            "seams (route them through the node's Clock, or extend "
+            "p1_tpu/analysis/allowlist.py with a reason):\n  "
+            + "\n  ".join(str(f) for f in report.violations)
         )
+        assert not report.parse_errors, report.parse_errors
 
     def test_allowlist_carries_no_stale_grants(self):
-        stale = []
-        files = dict(_product_files())
-        for rel, allowed in ALLOWED.items():
-            path = files.get(rel)
-            if path is None:
-                stale.append(f"{rel}: file no longer exists")
-                continue
-            unused = allowed - _scan(path)
-            if unused:
-                stale.append(f"{rel}: {sorted(unused)} never occurs")
-        assert not stale, (
+        report = _wallclock_report()
+        assert not report.stale, (
             "allowlist grants nothing uses (tighten the list):\n  "
-            + "\n  ".join(stale)
+            + "\n  ".join(report.stale)
         )
 
     def test_snapshot_plane_is_clock_free_from_day_one(self):
-        """Round 12's new module enters the lint covered and CLEAN: no
-        direct wall-clock constructs, no allowlist grant — snapshot
-        integrity checking and (de)serialization are pure functions of
-        bytes, and granting the module a clock seam it does not need
-        would only invite one.  The node-side fetch/revalidation
-        machinery lives in node/node.py under ITS existing grant and
-        reads time only through ``Node.clock``."""
-        assert _scan(PKG / "chain" / "snapshot.py") == set()
-        assert "chain/snapshot.py" not in ALLOWED
+        """Round 12's module stays lint-covered and CLEAN: no direct
+        wall-clock constructs, no allowlist grant — snapshot integrity
+        checking and (de)serialization are pure functions of bytes, and
+        granting the module a clock seam it does not need would only
+        invite one.  The node-side fetch/revalidation machinery lives
+        in node/node.py under ITS existing grant and reads time only
+        through ``Node.clock``."""
+        report = _wallclock_report()
+        assert not any(
+            f.file == "chain/snapshot.py" for f in report.findings
+        ), [str(f) for f in report.findings if f.file == "chain/snapshot.py"]
+        assert "chain/snapshot.py" not in GRANTS["wall-clock"]
 
     def test_node_core_is_fully_seam_routed(self):
         """The headline: the node's consensus/session core reads NO
         host clock at all — every deadline, ban window, telemetry stamp
-        and mining timestamp goes through ``self.clock``."""
-        found = _scan(PKG / "node" / "node.py")
-        assert "time.time(" not in found
-        assert "time.monotonic(" not in found
-        assert "time.perf_counter(" not in found
+        and mining timestamp goes through ``self.clock``.  Its only
+        grant is loop-relative ``asyncio.sleep``."""
+        keys = {
+            f.key for f in _wallclock_report().findings
+            if f.file == "node/node.py"
+        }
+        assert "time.time" not in keys
+        assert "time.monotonic" not in keys
+        assert "time.perf_counter" not in keys
+        assert set(GRANTS["wall-clock"]["node/node.py"]) == {"asyncio.sleep"}
+
+    def test_default_arg_references_are_structurally_clean(self):
+        """What the AST migration BUYS over the tokenizer: the seam
+        itself (node/transport.py) holds bare ``time.monotonic``
+        references as injectable defaults without calling them, and
+        needs no grant — the rule counts calls, not spellings."""
+        assert (PKG_ROOT / "node" / "transport.py").exists()
+        assert not any(
+            f.file == "node/transport.py"
+            for f in _wallclock_report().findings
+        )
+        assert "node/transport.py" not in GRANTS["wall-clock"]
