@@ -189,6 +189,24 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Telemetry plane (round 14): what the stage spans cost the same
+    # ingest pipeline — blocks/s through the node's dispatch front door
+    # with telemetry on vs off (benchmarks/telemetry_overhead.py), the
+    # with-telemetry rate reported against the SAME recorded host-ingest
+    # constant so a creeping observability tax shows up in the bench
+    # JSON like any other regression.
+    try:
+        from benchmarks.telemetry_overhead import bench_quick as tel_quick
+
+        to = tel_quick(blocks=300, repeats=3)
+        extra["ingest_with_telemetry_bps"] = to["ingest_telemetry_bps"]
+        extra["ingest_with_telemetry_vs_recorded"] = round(
+            to["ingest_telemetry_bps"] / RECORDED_HOST_INGEST_BPS, 2
+        )
+        extra["telemetry_overhead_pct"] = to["overhead_pct"]
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Untrusted-path validation (round 8): quick same-session
     # revalidation measurement — serial vs batched signature lane on a
     # small store — reported against the ONE recorded constant

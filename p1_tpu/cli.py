@@ -272,6 +272,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(on by default; the budgets sit far above honest rates and "
         "only clip protocol-valid floods)",
     )
+    p.add_argument(
+        "--no-telemetry",
+        action="store_true",
+        help="disable the telemetry plane's latency recording "
+        "(node/telemetry.py stage spans + histograms; counters and "
+        "`p1 status` stay live — recording is observer-only, so this "
+        "is an overhead knob, never a behavior change)",
+    )
     _add_retarget(p)
 
     p = sub.add_parser(
@@ -282,6 +290,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--difficulty", type=int, default=16, help="chain selector")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="N",
+        help="re-poll every N seconds until Ctrl-C (clean exit 0) — a "
+        "live operator view without shell loops",
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
+        "metrics",
+        help="query a running node's (or replica's) telemetry registry "
+        "over the wire (node/telemetry.py): per-stage block-pipeline "
+        "latency histograms, query latency, counters",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    fmt = p.add_mutually_exclusive_group()
+    fmt.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="raw registry snapshot JSON instead of the human table",
+    )
+    fmt.add_argument(
+        "--prom",
+        action="store_true",
+        help="Prometheus text exposition (scrape-ready)",
+    )
     _add_retarget(p)
 
     p = sub.add_parser("tx", help="submit a signed transaction to a running node")
@@ -632,6 +671,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--region-nodes", type=int, default=None, help="wan nodes per region"
     )
+    p.add_argument(
+        "--no-telemetry",
+        action="store_true",
+        help="run the scenario's nodes with telemetry recording off — "
+        "the trace digest must match the telemetry-on run (the "
+        "observer contract; tests compare exactly this)",
+    )
 
     p = sub.add_parser(
         "chaos",
@@ -961,12 +1007,67 @@ def cmd_node(args) -> int:
 def cmd_status(args) -> int:
     """Query a running node's full status JSON (`p1 status`) — the same
     object the node logs, served over the wire (GETSTATUS/STATUS, v9),
-    overload block included.  Works even while the node sheds load."""
+    overload block included.  Works even while the node sheds load.
+
+    ``--watch N`` re-polls every N seconds until Ctrl-C (clean exit 0)
+    — the live operator view that used to need a shell loop.  One poll
+    failing mid-watch prints the error and keeps watching (a node
+    restarting must not kill the dashboard); without --watch a failure
+    is exit 1 as before."""
+    import time as _time
+
     from p1_tpu.node.client import get_status
 
+    watch = getattr(args, "watch", None)
+    if watch is not None and watch <= 0:
+        print("--watch needs a positive interval", file=sys.stderr)
+        return 2
     try:
-        status = asyncio.run(
-            get_status(
+        while True:
+            try:
+                status = asyncio.run(
+                    get_status(
+                        args.host,
+                        args.port,
+                        args.difficulty,
+                        retarget=_retarget_rule(args),
+                    )
+                )
+                print(
+                    json.dumps(status, indent=2, sort_keys=True), flush=True
+                )
+            except (
+                ConnectionError,
+                OSError,
+                ValueError,
+                asyncio.TimeoutError,
+                asyncio.IncompleteReadError,
+            ) as e:
+                print(f"status query failed: {e}", file=sys.stderr)
+                if watch is None:
+                    return 1
+            if watch is None:
+                return 0
+            _time.sleep(watch)
+    except KeyboardInterrupt:
+        # Ctrl-C is how a watch ENDS, not an error: exit clean wherever
+        # in the poll/sleep cycle it lands.
+        return 0
+
+
+def cmd_metrics(args) -> int:
+    """Query a node's (or `p1 serve` replica's) telemetry registry
+    (`p1 metrics`, GETMETRICS/METRICS v12) and render it: human latency
+    table by default, ``--json`` for the raw snapshot, ``--prom`` for
+    Prometheus text exposition.  The render runs on the wire payload —
+    the CLI holds no registry of its own, so what you see is exactly
+    what the node exported."""
+    from p1_tpu.node.client import get_metrics
+    from p1_tpu.node.telemetry import format_prometheus, format_table
+
+    try:
+        snap = asyncio.run(
+            get_metrics(
                 args.host,
                 args.port,
                 args.difficulty,
@@ -980,9 +1081,17 @@ def cmd_status(args) -> int:
         asyncio.TimeoutError,
         asyncio.IncompleteReadError,
     ) as e:
-        print(f"status query failed: {e}", file=sys.stderr)
+        print(f"metrics query failed: {e}", file=sys.stderr)
         return 1
-    print(json.dumps(status, indent=2, sort_keys=True))
+    if args.as_json:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    elif args.prom:
+        sys.stdout.write(format_prometheus(snap))
+    else:
+        for key in ("role", "miner_id", "height"):
+            if key in snap:
+                print(f"{key}: {snap[key]}")
+        print(format_table(snap))
     return 0
 
 
@@ -1628,6 +1737,8 @@ def cmd_sim(args) -> int:
         "cycles": args.cycles,
         "attackers": args.attackers,
         "region_nodes": args.region_nodes,
+        # Only passed when disabling: scenarios default telemetry on.
+        "telemetry": False if args.no_telemetry else None,
     }
     kwargs = {
         k: v for k, v in flag_map.items() if v is not None and k in accepted
@@ -1823,6 +1934,7 @@ def main(argv=None) -> int:
         "replay": cmd_replay,
         "node": cmd_node,
         "status": cmd_status,
+        "metrics": cmd_metrics,
         "tx": cmd_tx,
         "keygen": cmd_keygen,
         "account": cmd_account,

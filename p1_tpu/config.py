@@ -139,6 +139,15 @@ class NodeConfig:
     #: across nodes for served snapshot heights to line up with what
     #: joiners can revalidate; it is a policy knob, never consensus.
     snapshot_interval: int = 0
+    #: Telemetry plane (node/telemetry.py): per-stage latency histograms
+    #: over the block pipeline (admission/validate/store/relay), query
+    #: request latency, and supervision backoff timing, exported over
+    #: GETMETRICS / `p1 metrics`.  Counters (NodeMetrics/status()) stay
+    #: live either way; False removes every telemetry clock read —
+    #: recording is observer-only by contract (the sim determinism pair
+    #: proves the trace digest is identical in both states), so this
+    #: knob exists for overhead control, not correctness.
+    telemetry: bool = True
     #: Re-run the full stateless validation (PoW, merkle, Ed25519) over
     #: every stored block at boot instead of the trusted fast resume.
     #: The store is this node's own flocked append-only log of blocks it

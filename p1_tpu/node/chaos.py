@@ -774,6 +774,8 @@ class _ChaosRunner:
         violations.extend(self._check_caches())
         violations.extend(self._check_assumed_samples())
 
+        from p1_tpu.node.telemetry import propagation_summary_ms
+
         heights = net.heights()
         report = {
             "events": len(events),
@@ -787,6 +789,15 @@ class _ChaosRunner:
             "reorgs_total": sum(
                 n.metrics.reorgs for n in net.nodes.values()
             ),
+            # Telemetry timeline (round 14): survivor-side propagation
+            # latency under the whole fault schedule, virtual-time —
+            # the "how did gossip feel while the mesh burned" figure a
+            # convergence bit cannot carry.
+            "telemetry": {
+                "propagation": propagation_summary_ms(
+                    n.telemetry for n in net.nodes.values()
+                )
+            },
             "violations": violations,
         }
         await net.stop_all()

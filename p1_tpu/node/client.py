@@ -340,6 +340,34 @@ async def get_status(
     return await asyncio.wait_for(_run(), timeout)
 
 
+async def get_metrics(
+    host: str,
+    port: int,
+    difficulty: int,
+    timeout: float = 10.0,
+    retarget=None,
+) -> dict:
+    """Fetch a node's (or a `p1 serve` replica's) telemetry registry
+    snapshot (`p1 metrics`, v12): counters, gauges, and the per-stage
+    latency histograms of node/telemetry.py.  Unlike GETSTATUS this
+    probe is shed under overload — a refused scrape times out here and
+    the caller retries later."""
+
+    async def _run() -> dict:
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+            await protocol.write_frame(writer, protocol.encode_getmetrics())
+            while True:
+                mtype, body = await _read_msg(reader, writer)
+                if mtype is MsgType.METRICS:
+                    return body
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def get_account(
     host: str,
     port: int,

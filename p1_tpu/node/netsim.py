@@ -557,6 +557,7 @@ class SimNet:
         default_profile: LinkProfile | None = None,
         keep_trace: bool = False,
         store_dir=None,
+        telemetry: bool = True,
     ):
         from pathlib import Path
 
@@ -565,6 +566,11 @@ class SimNet:
 
         self.seed = seed
         self.difficulty = difficulty
+        #: Default for every spawned node's ``config.telemetry`` —
+        #: recording reads only the VIRTUAL clock, so flipping this must
+        #: not move the trace digest (the observer contract the
+        #: determinism pair in tests/test_telemetry.py pins).
+        self.telemetry = telemetry
         self.clock = VirtualClock()
         self.net = SimTransport(
             self.clock,
@@ -625,6 +631,7 @@ class SimNet:
         cfg.setdefault("mine", False)
         cfg.setdefault("mempool_ttl_s", 0.0)
         cfg.setdefault("rng_seed", self.rng.getrandbits(48))
+        cfg.setdefault("telemetry", self.telemetry)
         if self.store_dir is not None:
             cfg.setdefault("store_path", str(self.store_dir / f"{host}.dat"))
         peer_strs = tuple(

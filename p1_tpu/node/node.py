@@ -172,6 +172,7 @@ _MSG_CLASS = {
     MsgType.GETSTATUS: CLASS_QUERIES,
     MsgType.GETFILTERS: CLASS_QUERIES,
     MsgType.GETSNAPSHOT: CLASS_QUERIES,
+    MsgType.GETMETRICS: CLASS_QUERIES,
 }
 
 #: Frames dropped while the node is in the SHED overload state.
@@ -192,99 +193,121 @@ _SHED_DROPS = frozenset(
         # Snapshot serving is a pure capacity consumer (a joiner can
         # retry any peer later); under SHED it goes quiet with the rest.
         MsgType.GETSNAPSHOT,
+        # The telemetry export sheds too — GETSTATUS is the minimal
+        # health probe and stays up; the full latency dump is capacity
+        # an overloaded node may refuse (scrapers retry).
+        MsgType.GETMETRICS,
     }
 )
 
 
-@dataclasses.dataclass
-class NodeMetrics:
-    """Counters surfaced by ``Node.metrics()`` (SURVEY.md §5 metrics)."""
+#: NodeMetrics counter fields, in their historical (dataclass) order.
+#: Families, for readers: block/tx flow (mined/accepted/rejected/reorgs,
+#: hashes), compact relay (BIP152-style hits/fetches/bytes saved), wire
+#: traffic (counted at the one send choke point and the session read
+#: loop), liveness probes, lost-task crash observation, request
+#: supervision (stalls/failovers/demotions — see node/supervision.py),
+#: storage durability (chain/store.py degraded mode), the query serving
+#: plane, and untrusted snapshot sync (round 12).
+_METRIC_COUNTERS = (
+    "blocks_mined",
+    "blocks_accepted",
+    "blocks_rejected",
+    "reorgs",
+    "txs_accepted",
+    "hashes_done",
+    "cblocks_sent",
+    "cblocks_received",
+    "cblock_tx_hits",
+    "cblock_tx_fetched",
+    "cblock_bytes_saved",
+    "bytes_sent",
+    "bytes_received",
+    "pings_sent",
+    "peers_evicted_idle",
+    "task_crashes",
+    "sync_stalls",
+    "sync_failovers",
+    "sync_demotions",
+    "sync_exhausted",
+    "cblock_fetch_stalls",
+    "mempool_sync_stalls",
+    "store_errors",
+    "store_retries",
+    "store_recoveries",
+    "store_blocks_deferred",
+    "proofs_served",
+    "filters_served",
+    "filter_bytes_served",
+    "snapshot_fetches",
+    "snapshot_chunks_served",
+    "snapshot_flips",
+    "snapshot_divergences",
+    "snapshot_fallbacks",
+    "snapshot_stalls",
+    "revalidated_blocks",
+)
+#: Float-valued point-in-time fields (mining timing).
+_METRIC_GAUGES = ("mine_elapsed_s", "last_block_time_s")
 
-    blocks_mined: int = 0
-    blocks_accepted: int = 0
-    blocks_rejected: int = 0
-    reorgs: int = 0
-    txs_accepted: int = 0
-    hashes_done: int = 0
-    mine_elapsed_s: float = 0.0
-    last_block_time_s: float = 0.0
-    #: Compact block relay (BIP152-style): pushes sent/received compactly,
-    #: mempool reconstruction hits vs. transactions that needed a
-    #: GETBLOCKTXN round trip, and gossip bytes elided vs. full BLOCKs.
-    cblocks_sent: int = 0
-    cblocks_received: int = 0
-    cblock_tx_hits: int = 0
-    cblock_tx_fetched: int = 0
-    cblock_bytes_saved: int = 0
-    #: Actual p2p wire traffic (frame payloads + 4-byte length prefixes),
-    #: counted at the one send choke point (_Peer.send) and the session
-    #: read loop — what the compact-relay savings are measured against.
-    bytes_sent: int = 0
-    bytes_received: int = 0
-    #: Liveness layer (protocol v8): keepalive probes sent to silent
-    #: peers, and peers evicted for staying silent through one.
-    pings_sent: int = 0
-    peers_evicted_idle: int = 0
-    #: Request supervision (node/supervision.py): progress deadlines on
-    #: multi-round fetches.  ``sync_stalls`` counts locator syncs that
-    #: advanced nothing within the deadline; ``sync_failovers`` the
-    #: locator re-issues to a different peer; ``sync_demotions`` the
-    #: sync-priority demotions charged to stallers (never bans — slowness
-    #: is not a violation); ``sync_exhausted`` catch-up episodes that
-    #: spent their whole failover budget.  The compact-block GETBLOCKTXN
-    #: round and paged mempool sync are supervised under the same
-    #: deadline with their own stall counters.
-    #: Background tasks (dials, sync failovers, recovery loops) that
-    #: died of an UNEXPECTED exception — observed and logged by their
-    #: done-callbacks instead of rotting in the GC's "exception was
-    #: never retrieved" limbo (the lost-task lint rule's bug class).
-    #: Nonzero here always deserves a look at the error log.
-    task_crashes: int = 0
-    sync_stalls: int = 0
-    sync_failovers: int = 0
-    sync_demotions: int = 0
-    sync_exhausted: int = 0
-    cblock_fetch_stalls: int = 0
-    mempool_sync_stalls: int = 0
-    #: Storage durability layer (chain/store.py + _store_append): store
-    #: write/fsync failures observed (ENOSPC, EIO...), recovery-loop
-    #: retry attempts, successful recoveries (degraded -> normal), and
-    #: blocks refused at the door while degraded (serve-only mode — the
-    #: peers re-serve them after recovery via the locator sync).
-    store_errors: int = 0
-    store_retries: int = 0
-    store_recoveries: int = 0
-    store_blocks_deferred: int = 0
-    #: Query serving plane (round 9): inclusion proofs served (found
-    #: replies only) and compact block filters served, with the filter
-    #: payload bytes — the read-traffic telemetry ``status()["queries"]``
-    #: reports next to the proof/filter cache hit rates.
-    proofs_served: int = 0
-    filters_served: int = 0
-    filter_bytes_served: int = 0
-    #: Untrusted snapshot sync (round 12, chain/snapshot.py).
-    #: ``snapshot_fetches`` counts snapshot downloads this node STARTED
-    #: (as a joiner); ``snapshot_chunks_served`` what it served to
-    #: others; ``snapshot_flips`` ASSUMED→VALIDATED transitions after a
-    #: matching background revalidation; ``snapshot_divergences`` lies
-    #: caught (root/hash mismatch — the snapshot is quarantined and the
-    #: server demoted); ``snapshot_fallbacks`` falls back to genesis IBD
-    #: (every divergence is also a fallback); ``snapshot_stalls``
-    #: supervised snapshot/revalidation rounds that timed out;
-    #: ``revalidated_blocks`` history replayed by the background lane.
-    snapshot_fetches: int = 0
-    snapshot_chunks_served: int = 0
-    snapshot_flips: int = 0
-    snapshot_divergences: int = 0
-    snapshot_fallbacks: int = 0
-    snapshot_stalls: int = 0
-    revalidated_blocks: int = 0
-    #: Rolling window of block propagation delays (peer's gossip send ->
-    #: our acceptance), seconds — SURVEY §5's "host-side timing of gossip
-    #: round-trips".  Bounded so a long-lived node's memory is too.
-    propagation_delays_s: collections.deque = dataclasses.field(
-        default_factory=lambda: collections.deque(maxlen=1024)
-    )
+
+class NodeMetrics:
+    """Counters surfaced by ``Node.status()`` (SURVEY.md §5 metrics).
+
+    Round 14: the storage moved onto the telemetry registry
+    (node/telemetry.py) so every counter is exportable over GETMETRICS /
+    `p1 metrics` / Prometheus — but the ATTRIBUTE surface is unchanged:
+    ``metrics.blocks_mined += 1`` still works everywhere it always did
+    (``__getattr__``/``__setattr__`` route to the registry), and the
+    ``status()`` key contract is pinned byte-for-byte by
+    tests/test_telemetry.py.  Unknown attribute names still raise
+    AttributeError — a typo must not silently mint a counter.
+    """
+
+    __slots__ = ("registry", "propagation_delays_s")
+
+    def __init__(self, registry=None):
+        from p1_tpu.node.telemetry import MetricsRegistry
+
+        object.__setattr__(
+            self,
+            "registry",
+            registry if registry is not None else MetricsRegistry(),
+        )
+        #: Rolling window of block propagation delays (peer's gossip
+        #: send -> our acceptance), seconds — SURVEY §5's "host-side
+        #: timing of gossip round-trips".  Bounded; kept as a raw deque
+        #: (the historical ``propagation_summary`` contract) alongside
+        #: the registry's ``block.propagation_s`` histogram.
+        object.__setattr__(
+            self, "propagation_delays_s", collections.deque(maxlen=1024)
+        )
+        for name in _METRIC_COUNTERS:
+            self.registry.counter(name)
+        for name in _METRIC_GAUGES:
+            self.registry.gauge(name)
+
+    def __getattr__(self, name):
+        registry = object.__getattribute__(self, "registry")
+        c = registry.counters.get(name)
+        if c is not None:
+            return c.value
+        g = registry.gauges.get(name)
+        if g is not None:
+            return g.value
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        registry = object.__getattribute__(self, "registry")
+        c = registry.counters.get(name)
+        if c is not None:
+            c.value = value
+            return
+        g = registry.gauges.get(name)
+        if g is not None:
+            g.value = value
+            return
+        raise AttributeError(name)
 
     @property
     def hashes_per_sec(self) -> float:
@@ -424,6 +447,49 @@ class Node:
         #: deterministically in one process.
         self.transport = transport if transport is not None else SOCKET_TRANSPORT
         self.clock = self.transport.clock
+        #: Telemetry plane (node/telemetry.py): counters, gauges, and
+        #: per-stage latency histograms, reading time ONLY through the
+        #: transport clock — wall time live, virtual time under the
+        #: simulator.  Recording is observer-only by contract: the
+        #: determinism pair (tests/test_telemetry.py) pins that a
+        #: simulated run's trace digest is byte-identical with the
+        #: plane enabled and disabled.
+        from p1_tpu.node.telemetry import MetricsRegistry, NodeLogAdapter
+
+        self.telemetry = MetricsRegistry(
+            clock=self.clock.monotonic, enabled=config.telemetry
+        )
+        #: Hot-path instrumentation, pre-resolved: the block pipeline
+        #: dispatches thousands of frames a second, and the generic
+        #: ``registry.span()`` (dict lookup + context-manager + span
+        #: allocation per region) measurably taxes it — the stage spans
+        #: below use ``_tel_clock`` stamps + cached histogram refs
+        #: instead (~half the cost; benchmarks/telemetry_overhead.py is
+        #: the receipt).  ``_tel_clock is None`` IS the disabled check.
+        if self.telemetry.enabled:
+            self._tel_clock = self.clock.monotonic
+            self._h_frame = self.telemetry.histogram("stage.frame_s")
+            self._h_admission = self.telemetry.histogram(
+                "stage.admission_s"
+            )
+            self._h_validate = self.telemetry.histogram("stage.validate_s")
+            self._h_store = self.telemetry.histogram("stage.store_s")
+            self._h_relay = self.telemetry.histogram("stage.relay_s")
+            self._h_query = self.telemetry.histogram("query.request_s")
+        else:
+            self._tel_clock = None
+        #: Deterministic 1-in-8 sampler for the PER-FRAME micro stages
+        #: (frame decode, admission): they run for every frame at
+        #: microsecond durations, so full recording would tax the hot
+        #: path for distributions that a uniform sample captures
+        #: identically.  The block stages (validate/store/relay) and
+        #: query latency record every event.  A counter, not an RNG —
+        #: sampling must not perturb simulated determinism.
+        self._tel_tick = 0
+        #: Identity-carrying logger: every record is prefixed with this
+        #: node's host:port, so multi-node processes (`p1 net`, the
+        #: simulator, netharness) stop interleaving anonymously.
+        self.log = NodeLogAdapter(log, self._log_ident)
         #: Node-local RNG.  None (production) draws identity from the
         #: OS; a seeded instance (config.rng_seed, or injected directly)
         #: makes the node's identity AND its supervision jitter a pure
@@ -502,7 +568,7 @@ class Node:
             # virtual time under the simulator like every node deadline.
             clock=self.clock.monotonic,
         )
-        self.metrics = NodeMetrics()
+        self.metrics = NodeMetrics(registry=self.telemetry)
         #: ``store`` is injectable (tests pass a fault-injecting
         #: ``chain/testing.py`` FaultStore); by default the config path
         #: decides persistence.
@@ -653,6 +719,13 @@ class Node:
 
     # -- lifecycle -------------------------------------------------------
 
+    def _log_ident(self) -> str:
+        """This node's log attribution: the configured host plus the
+        BOUND port once the listener is up (before that, the configured
+        one — 0 for ephemeral test nodes, which still disambiguates by
+        host under the simulator)."""
+        return f"{self.config.host}:{self.port if self.port else self.config.port}"
+
     def _untrack_session(self, task) -> None:
         """Done-callback for fire-and-forget session tasks (dials, sync
         failovers): untrack, and OBSERVE a crash.  Without the
@@ -668,7 +741,7 @@ class Node:
         exc = task.exception()
         if exc is not None:
             self.metrics.task_crashes += 1
-            log.error("session task %r died: %r", task.get_name(), exc)
+            self.log.error("session task %r died: %r", task.get_name(), exc)
 
     def _addr_book_path(self):
         return (
@@ -707,7 +780,7 @@ class Node:
             return
         restored, dropped = load_mempool(self.mempool, path)
         if restored or dropped:
-            log.info(
+            self.log.info(
                 "mempool resumed: %d restored, %d dropped on revalidation",
                 restored,
                 dropped,
@@ -724,7 +797,7 @@ class Node:
             save_mempool(self.mempool, path)
             self._mempool_saved_at = self.mempool.mutations
         except OSError as e:
-            log.warning("could not persist mempool %s: %s", path, e)
+            self.log.warning("could not persist mempool %s: %s", path, e)
 
     async def _checkpoint_mempool(self) -> None:
         """Periodic crash checkpoint: skipped when the pool is unchanged
@@ -754,7 +827,7 @@ class Node:
             await self._mempool_io
             self._mempool_saved_at = mutations
         except OSError as e:
-            log.warning("could not persist mempool %s: %s", path, e)
+            self.log.warning("could not persist mempool %s: %s", path, e)
         finally:
             self._mempool_io = None
 
@@ -767,7 +840,7 @@ class Node:
         try:
             entries = json.loads(path.read_text())
         except (ValueError, OSError) as e:
-            log.warning("ignoring unreadable address book %s: %s", path, e)
+            self.log.warning("ignoring unreadable address book %s: %s", path, e)
             return
         # Two formats: the current {"tried": [...], "new": [...]} split
         # and the legacy flat list (loaded as "new" — a restart earns
@@ -778,14 +851,14 @@ class Node:
             if not isinstance(tried_rows, list) or not isinstance(
                 new_rows, list
             ):
-                log.warning("ignoring malformed address book %s", path)
+                self.log.warning("ignoring malformed address book %s", path)
                 return
         elif isinstance(entries, list):
             tried_rows, new_rows = [], entries
         else:
             # Parsable-but-wrong content is just as corrupt as unparsable
             # bytes — the book is a cache, never worth failing startup.
-            log.warning("ignoring malformed address book %s", path)
+            self.log.warning("ignoring malformed address book %s", path)
             return
 
         def _rows(rows, limit):
@@ -827,7 +900,7 @@ class Node:
             )
             tmp.replace(path)  # atomic: never a torn book
         except OSError as e:
-            log.warning("could not persist address book %s: %s", path, e)
+            self.log.warning("could not persist address book %s: %s", path, e)
 
     def _try_snapshot_resume(self) -> bool:
         """Resume a node that crashed (or stopped) in the ASSUMED state:
@@ -856,13 +929,13 @@ class Node:
             first.block_hash() == ghash or first.prev_hash == ghash
         ):
             # The flip's store rewrite landed; only the unlink is owed.
-            log.info("stale snapshot sidecar after a completed flip — removing")
+            self.log.info("stale snapshot sidecar after a completed flip — removing")
             snap_path.unlink()
             return False
         try:
             snap = chain_snapshot.load_snapshot(snap_path)
         except (OSError, SnapshotError) as e:
-            log.error(
+            self.log.error(
                 "snapshot sidecar unreadable (%s) — quarantining; booting "
                 "via ordinary IBD",
                 e,
@@ -894,7 +967,7 @@ class Node:
         self._snap_meta = snap.manifest
         if self.config.body_cache_blocks > 0:
             chain.body_source = self.store
-        log.warning(
+        self.log.warning(
             "resumed in ASSUMED state from snapshot at height %d "
             "(tip %d) — background revalidation restarting",
             snap.height,
@@ -987,7 +1060,7 @@ class Node:
                 # governor loop sweeps; the source survives the resume).
                 self.chain.body_source = self.store
             if self.chain.height:
-                log.info(
+                self.log.info(
                     "resumed chain height=%d tip=%s",
                     self.chain.height,
                     self.chain.tip_hash.hex()[:16],
@@ -1005,7 +1078,7 @@ class Node:
             self._on_inbound, self.config.host, self.config.port
         )
         self.port = self._server.port
-        log.info("listening on %s:%d", self.config.host, self.port)
+        self.log.info("listening on %s:%d", self.config.host, self.port)
         for host, port in self.config.peer_addrs():
             self._tasks.append(asyncio.create_task(self._dial_loop(host, port)))
         if self.config.target_peers > 0:
@@ -1092,7 +1165,7 @@ class Node:
                 # A mine loop that already died of its own exception re-raises
                 # it here; stop()/stop_mining() must still run the rest of
                 # teardown (sessions, server socket, store).
-                log.exception("mine task ended with error")
+                self.log.exception("mine task ended with error")
             if self._mine_task in self._tasks:
                 self._tasks.remove(self._mine_task)
             self._mine_task = None
@@ -1114,7 +1187,7 @@ class Node:
                 ):
                     # Nothing else can surface a failure on this path (the
                     # mine loop is already gone) — don't lose it.
-                    log.error("post-seal block handling failed: %r", r)
+                    self.log.error("post-seal block handling failed: %r", r)
 
     # -- storage durability (degraded serve-only mode) --------------------
 
@@ -1157,7 +1230,7 @@ class Node:
         if self._store_degraded:
             return
         self._store_degraded = True
-        log.error(
+        self.log.error(
             "store write failed (%s) — entering degraded serve-only mode "
             "(%d records pending)",
             exc,
@@ -1171,7 +1244,7 @@ class Node:
         if self.config.store_degraded_exit:
             # Escape hatch for operators who prefer a supervisor restart
             # to a degraded node: signal the CLI runner and stand down.
-            log.critical(
+            self.log.critical(
                 "store failed and --store-degraded-exit is set — "
                 "signaling shutdown"
             )
@@ -1197,7 +1270,7 @@ class Node:
         exc = task.exception()
         if exc is None:
             return
-        log.error("store recovery loop died: %r", exc)
+        self.log.error("store recovery loop died: %r", exc)
         if self._running and self._store_degraded:
             self._spawn_store_recovery()
 
@@ -1209,7 +1282,9 @@ class Node:
         locator sync — nothing was acknowledged, so nothing is owed."""
         sup = self._store_sup
         while self._running and self._store_degraded:
-            await asyncio.sleep(sup.record_stall())
+            retry_delay = sup.record_stall()
+            self.telemetry.observe("store.retry_backoff_s", retry_delay)
+            await asyncio.sleep(retry_delay)
             if not (self._running and self._store_degraded):
                 return
             self.metrics.store_retries += 1
@@ -1230,7 +1305,7 @@ class Node:
             self.metrics.store_recoveries += 1
             sup.attempts = 0
             sup.idle()
-            log.warning(
+            self.log.warning(
                 "store recovered — leaving degraded mode, backfilling "
                 "blocks refused meanwhile"
             )
@@ -1275,7 +1350,7 @@ class Node:
             peer=peer, asked_at=self.clock.monotonic()
         )
         self.metrics.snapshot_fetches += 1
-        log.info("requesting state snapshot from %s", peer.label)
+        self.log.info("requesting state snapshot from %s", peer.label)
         await self._send_guarded(peer, protocol.encode_getsnapshot(0, 0))
 
     def _validate_snapshot_manifest(self, manifest) -> None:
@@ -1322,7 +1397,7 @@ class Node:
         qualifies, else ordinary genesis IBD — the node always has a
         trust-free path forward."""
         self._snap_fetch = None
-        log.warning("snapshot fetch from %s failed: %s", peer.label, reason)
+        self.log.warning("snapshot fetch from %s failed: %s", peer.label, reason)
         if peer.writer in self._peers:
             peer.sync_demerits += 1
             self.metrics.sync_demotions += 1
@@ -1442,7 +1517,7 @@ class Node:
         self._snap_meta = snap.manifest
         self._snap_source = peer.host
         self._abort_inflight_search()  # mining pauses while ASSUMED
-        log.warning(
+        self.log.warning(
             "booted from snapshot: height=%d root=%s from %s — ASSUMED "
             "state, serving immediately; background revalidation starting",
             snap.height,
@@ -1458,7 +1533,7 @@ class Node:
                     chunk_payloads,
                 )
             except OSError as e:
-                log.warning("could not persist snapshot sidecar: %s", e)
+                self.log.warning("could not persist snapshot sidecar: %s", e)
         # Reset the store onto the assumed layout (anchor + descendants):
         # any genesis-connected records an outrun ordinary sync already
         # persisted would otherwise leave a mixed log the resume cannot
@@ -1523,7 +1598,7 @@ class Node:
         if not gone:
             staller.sync_demerits += 1
             self.metrics.sync_demotions += 1
-            log.warning(
+            self.log.warning(
                 "background revalidation stalled on %s — failing over",
                 staller.label,
             )
@@ -1614,7 +1689,7 @@ class Node:
         self.metrics.snapshot_flips += 1
         self._snap_meta = None
         self._snap_source = None
-        log.warning(
+        self.log.warning(
             "background revalidation CONFIRMED the snapshot — flipped to "
             "fully-validated at height %d",
             bg.height,
@@ -1647,7 +1722,7 @@ class Node:
         self._bg_sup.idle()
         self.metrics.snapshot_divergences += 1
         self.metrics.snapshot_fallbacks += 1
-        log.error(
+        self.log.error(
             "snapshot DIVERGED (%s) — quarantining it, demoting the "
             "serving peer, falling back to genesis IBD",
             reason,
@@ -1660,7 +1735,7 @@ class Node:
                     snap_path.with_name(snap_path.name + ".quarantine"),
                 )
             except OSError as e:
-                log.warning("could not quarantine snapshot sidecar: %s", e)
+                self.log.warning("could not quarantine snapshot sidecar: %s", e)
         host = self._snap_source
         if host:
             self._record_violation(host)
@@ -1719,7 +1794,7 @@ class Node:
             self.store.reindex_spans()
             self._store_pending.clear()
         except OSError as e:
-            log.error(
+            self.log.error(
                 "store rewrite after the validation flip failed (%s) — "
                 "keeping the previous layout; a restart will re-derive "
                 "state from the sidecar",
@@ -1773,7 +1848,7 @@ class Node:
                     self.chain.evict_bodies(self.config.body_cache_blocks)
                 if self.governor.observe(self._memory_gauge()):
                     if self.governor.shedding:
-                        log.warning(
+                        self.log.warning(
                             "overload: %d tracked bytes over the %d "
                             "watermark — SHED state (low-priority gossip "
                             "dropped, mining paused)",
@@ -1785,7 +1860,7 @@ class Node:
                         # shedding.
                         self._abort_inflight_search()
                     else:
-                        log.warning(
+                        self.log.warning(
                             "overload cleared: %d tracked bytes below the "
                             "low watermark — back to NORMAL",
                             self.governor.tracked_bytes,
@@ -1793,7 +1868,7 @@ class Node:
             except Exception:
                 # The governor must never die of one bad tick — it is
                 # the layer that keeps overload survivable.
-                log.exception("governor tick failed")
+                self.log.exception("governor tick failed")
 
     # -- p2p ------------------------------------------------------------
 
@@ -1825,7 +1900,7 @@ class Node:
         if len(window) >= BAN_SCORE_THRESHOLD:
             self._banned_until[host] = now + BAN_DURATION_S
             window.clear()
-            log.warning(
+            self.log.warning(
                 "banning %s for %.0fs after repeated protocol violations",
                 host,
                 BAN_DURATION_S,
@@ -1997,7 +2072,7 @@ class Node:
             if ttl > 0:
                 dropped = self.mempool.expire(ttl)
                 if dropped:
-                    log.info(
+                    self.log.info(
                         "expired %d stale mempool transactions", dropped
                     )
             # Periodic checkpoint so a crash (not just a clean stop)
@@ -2072,7 +2147,7 @@ class Node:
             except Exception:
                 # The supervisor must never die of one bad tick — it is
                 # the layer that un-wedges everything else.
-                log.exception("request supervision tick failed")
+                self.log.exception("request supervision tick failed")
 
     async def _check_block_sync(self) -> None:
         """The tentpole deadline: an in-flight locator sync that has
@@ -2090,7 +2165,7 @@ class Node:
         if not gone:
             staller.sync_demerits += 1
             self.metrics.sync_demotions += 1
-            log.warning(
+            self.log.warning(
                 "sync stall: %s advanced nothing in %.1fs — demoting "
                 "and failing over",
                 staller.label,
@@ -2103,13 +2178,17 @@ class Node:
             self.metrics.sync_exhausted += 1
             sup.attempts = 0
             sup.idle()
-            log.warning(
+            self.log.warning(
                 "sync failover budget exhausted (%d attempts); waiting "
                 "for a fresh trigger",
                 sup.attempts_max,
             )
             return
         delay = sup.record_stall()
+        # Supervision timing: the jittered backoff each stall armed —
+        # with the stall deadline itself, the latency a starved sync
+        # episode pays before its failover lands.
+        self.telemetry.observe("sync.backoff_s", delay)
         task = asyncio.create_task(self._failover_blocks(staller, delay))
         self._sessions[task] = None
         task.add_done_callback(self._untrack_session)
@@ -2127,7 +2206,7 @@ class Node:
             # reconnection, and a fresh handshake restarts the sync.
             return
         self.metrics.sync_failovers += 1
-        log.info(
+        self.log.info(
             "sync failover: re-issuing locator to %s", candidate.label
         )
         await self._request_blocks(candidate)
@@ -2157,7 +2236,7 @@ class Node:
                 peer.sync_demerits += 1
                 self.metrics.sync_demotions += 1
             last_staller = peer
-            log.warning(
+            self.log.warning(
                 "GETBLOCKTXN to %s stalled %.1fs — dropping "
                 "reconstruction of %s, recovering via locator sync",
                 peer.label,
@@ -2183,7 +2262,7 @@ class Node:
             self.metrics.mempool_sync_stalls += 1
             peer.sync_demerits += 1
             self.metrics.sync_demotions += 1
-            log.warning(
+            self.log.warning(
                 "mempool sync with %s stalled %.1fs — asking another "
                 "peer",
                 peer.label,
@@ -2348,7 +2427,7 @@ class Node:
             if inbound:
                 self._handshaking -= 1
                 inbound = False  # the finally below must not double-count
-            log.info("peer %s connected (their height %d)", label, hello.tip_height)
+            self.log.info("peer %s connected (their height %d)", label, hello.tip_height)
             peer.hello_height = hello.tip_height
             peer.is_node = bool(hello.nonce)  # 0 = one-shot tooling client
             if hello.listen_port:
@@ -2475,7 +2554,7 @@ class Node:
             OSError,
             _Refused,
         ) as e:
-            log.info("peer %s closed: %s", label, e)
+            self.log.info("peer %s closed: %s", label, e)
             if isinstance(e, protocol.ProtocolError) and not isinstance(
                 e, protocol.ChainMismatch
             ):
@@ -2499,7 +2578,24 @@ class Node:
         return registered
 
     async def _dispatch(self, peer: _Peer, payload: bytes) -> None:
-        mtype, body = protocol.decode(payload)
+        # Wire-frame stage span: the decode cost a frame pays before any
+        # admission or state work (the block pipeline's first leg).
+        # ``clk`` is None iff telemetry is disabled; ``sclk`` is
+        # additionally None on the 7-of-8 frames the micro-stage
+        # sampler skips (see _tel_tick) — frame/admission ride sclk,
+        # query latency below rides clk and records every event.
+        clk = self._tel_clock
+        sclk = None
+        if clk is not None:
+            self._tel_tick += 1
+            if not (self._tel_tick & 7):
+                sclk = clk
+        if sclk is not None:
+            t0 = sclk()
+            mtype, body = protocol.decode(payload)
+            self._h_frame.observe(sclk() - t0)
+        else:
+            mtype, body = protocol.decode(payload)
         # Overload front door (node/governor.py), BEFORE any state or
         # compute is spent on the frame.  SHED drops low-priority
         # traffic wholesale; admission charges the peer's class budget
@@ -2514,23 +2610,36 @@ class Node:
             self.governor.shed_drop()
             return
         cls = _MSG_CLASS.get(mtype)
-        if cls is not None and not self.governor.admit(peer.budget, cls):
-            if peer.budget.owes_violation(cls) and peer.host:
-                log.warning(
-                    "admission budget exceeded: dropping %s flood from %s",
-                    cls,
-                    peer.label,
-                )
-                self._record_violation(peer.host)
-                if self._is_banned(peer.host):
-                    # The score just crossed the ban threshold: sever the
-                    # live session too — the accept-time refusal alone
-                    # would let the flooder keep this socket for the
-                    # whole ban and never feel it.
-                    raise _Refused(
-                        f"{cls} flood from {peer.label}: banned"
+        if cls is not None:
+            if sclk is not None:
+                t0 = sclk()
+                admitted = self.governor.admit(peer.budget, cls)
+                self._h_admission.observe(sclk() - t0)
+            else:
+                admitted = self.governor.admit(peer.budget, cls)
+            if not admitted:
+                if peer.budget.owes_violation(cls) and peer.host:
+                    self.log.warning(
+                        "admission budget exceeded: dropping %s flood from %s",
+                        cls,
+                        peer.label,
                     )
-            return
+                    self._record_violation(peer.host)
+                    if self._is_banned(peer.host):
+                        # The score just crossed the ban threshold: sever
+                        # the live session too — the accept-time refusal
+                        # alone would let the flooder keep this socket for
+                        # the whole ban and never feel it.
+                        raise _Refused(
+                            f"{cls} flood from {peer.label}: banned"
+                        )
+                return
+        # Query-plane request latency: one admitted GET* frame from
+        # decode-done to reply-sent (every branch below falls through to
+        # the common exit, so one stamp pair covers them all).
+        query_t0 = (
+            clk() if clk is not None and cls == CLASS_QUERIES else None
+        )
         if mtype is MsgType.BLOCK:
             sent_ts, block = body
             await self._handle_block(block, origin=peer, sent_ts=sent_ts)
@@ -2848,8 +2957,15 @@ class Node:
             await self._send_guarded(
                 peer, protocol.encode_status(self.status())
             )
-        elif mtype is MsgType.STATUS:
-            pass  # reply frame: meaningful to querying clients only
+        elif mtype is MsgType.GETMETRICS:
+            # Telemetry probe (`p1 metrics`): the registry snapshot —
+            # per-stage latency histograms, counters, gauges.  IS shed
+            # under overload (unlike GETSTATUS): scrapers retry.
+            await self._send_guarded(
+                peer, protocol.encode_metrics(self.telemetry_snapshot())
+            )
+        elif mtype in (MsgType.STATUS, MsgType.METRICS):
+            pass  # reply frames: meaningful to querying clients only
         elif mtype is MsgType.PING:
             await self._send_guarded(peer, protocol.encode_pong(body))
         elif mtype is MsgType.PONG:
@@ -2858,6 +2974,8 @@ class Node:
             pass  # reply frames: meaningful to querying clients only
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
+        if query_t0 is not None:
+            self._h_query.observe(clk() - query_t0)
 
     def _proof_payload(self, txid: bytes) -> bytes:
         """The wire PROOF reply for ``txid``, through the chain's proof
@@ -2901,7 +3019,7 @@ class Node:
             and transport.get_write_buffer_size() > self.governor.write_queue_max
         ):
             self.governor.peers_dropped_squat += 1
-            log.warning(
+            self.log.warning(
                 "write queue for %s over %d bytes — dropping the "
                 "squatting peer",
                 peer.label,
@@ -2995,7 +3113,7 @@ class Node:
             bhash, header.difficulty
         ):
             self.metrics.blocks_rejected += 1
-            log.warning("rejected compact block from %s: bad work", peer.label)
+            self.log.warning("rejected compact block from %s: bad work", peer.label)
             return
         self.metrics.cblocks_received += 1
         txs: list = [None] * cb.ntx
@@ -3042,13 +3160,13 @@ class Node:
             return  # answered twice / evicted meanwhile / never asked
         indices = sorted(pending.want)
         if len(txs) != len(indices):
-            log.warning("BLOCKTXN wrong count from %s", peer.label)
+            self.log.warning("BLOCKTXN wrong count from %s", peer.label)
             return
         for i, tx in zip(indices, txs):
             if tx.txid() != pending.want[i]:
                 # The reply does not match the advertised block — drop the
                 # reconstruction; the chain heals via sync if it was real.
-                log.warning("BLOCKTXN txid mismatch from %s", peer.label)
+                self.log.warning("BLOCKTXN txid mismatch from %s", peer.label)
                 return
             pending.txs[i] = tx
         self.metrics.cblock_tx_fetched += len(indices)
@@ -3081,7 +3199,11 @@ class Node:
         # most once per process lifetime (docs/PERF.md "host ingest
         # plane").  Only mempool-reconstructed compact blocks serialize
         # fresh, once, on first use (their full frame never arrived).
+        clk = self._tel_clock
+        t0 = clk() if clk is not None else 0.0
         res = self.chain.add_block(block)
+        if clk is not None:
+            self._h_validate.observe(clk() - t0)
         if res.status is AddStatus.ACCEPTED:
             # Any accepted block is catch-up progress no matter who
             # served it: the supervised sync's deadline and attempt
@@ -3103,13 +3225,18 @@ class Node:
                 # passed a stamp) and the codec's 0.0 "no stamp" encode
                 # (protocol.encode_block) — so an unstamped tooling push
                 # can't record a nonsense epoch-sized delay.
-                self.metrics.propagation_delays_s.append(
-                    max(0.0, self.clock.wall() - sent_ts)
-                )
+                prop_delay = max(0.0, self.clock.wall() - sent_ts)
+                self.metrics.propagation_delays_s.append(prop_delay)
+                # Histogram twin of the raw window: virtual-time under
+                # the sim, so scenarios assert p95 propagation bounds.
+                self.telemetry.observe("block.propagation_s", prop_delay)
             self.metrics.blocks_accepted += 1
             # incl. cascaded orphans; a failing disk degrades, never
             # unwinds this handler (_store_append).
+            t0 = clk() if clk is not None else 0.0
             self._store_append(res.connected)
+            if clk is not None:
+                self._h_store.observe(clk() - t0)
             for b in res.connected:
                 # Serving plane: build each connected block's compact
                 # filter while its body is hot (incremental-at-connect;
@@ -3125,7 +3252,7 @@ class Node:
                 self.mempool.apply_block_delta(res.removed, res.added)
                 self._abort_inflight_search()
                 tip = self.chain.tip
-                log.info(
+                self.log.info(
                     "tip height=%d hash=%s nonce=%d txs=%d reorg=%d source=%s",
                     self.chain.height,
                     tip.block_hash().hex()[:16],
@@ -3135,8 +3262,14 @@ class Node:
                     origin.label if origin else "local",
                 )
             if gossip:
+                # Relay-fan-out stage span: encode + the concurrent send
+                # round (awaits included — the figure is what a tip push
+                # costs this event loop end to end).
+                t0 = clk() if clk is not None else 0.0
                 payload, saved_per_peer = self._block_gossip_payload(block)
                 n = await self._gossip(payload, skip=origin)
+                if clk is not None:
+                    self._h_relay.observe(clk() - t0)
                 if saved_per_peer and n:
                     # Per delivered peer: each would otherwise have
                     # received the full BLOCK frame.
@@ -3146,7 +3279,7 @@ class Node:
             await self._request_blocks(origin)
         elif res.status is AddStatus.REJECTED:
             self.metrics.blocks_rejected += 1
-            log.warning(
+            self.log.warning(
                 "rejected block from %s: %s",
                 origin.label if origin else "local",
                 res.reason,
@@ -3255,7 +3388,7 @@ class Node:
             # A silently dead miner looks like a healthy idle node; make
             # the failure loud here — stop_mining() swallows (logs) the
             # re-raise so teardown still completes.
-            log.exception("mining loop died")
+            self.log.exception("mining loop died")
             raise
 
     async def _mine_loop_inner(self) -> None:
@@ -3291,7 +3424,7 @@ class Node:
             block = Block(sealed, candidate.txs)
             self.metrics.blocks_mined += 1
             self.metrics.last_block_time_s = self.clock.monotonic() - t0
-            log.info(
+            self.log.info(
                 "mined height=%d nonce=%d txs=%d t=%.3fs hps=%.0f",
                 self.chain.height + 1,
                 sealed.nonce,
@@ -3317,6 +3450,18 @@ class Node:
 
     def peer_count(self) -> int:
         return len(self._peers)
+
+    def telemetry_snapshot(self) -> dict:
+        """The METRICS wire payload (`p1 metrics`): the registry dump
+        plus just enough identity to label a scrape.  Distinct from
+        ``status()`` — that is the curated operator view; this is the
+        raw catalog every exporter renders from."""
+        return {
+            "role": "node",
+            "miner_id": self.miner_id,
+            "height": self.chain.height,
+            **self.telemetry.snapshot(),
+        }
 
     def status(self) -> dict:
         """The two BASELINE metrics + node state, JSON-ready."""

@@ -129,6 +129,15 @@ Messages:
              Served range-capped and governor-admitted like every
              other query; an ASSUMED node answers "none" (it must not
              relay state it has not itself validated).
+- GETMETRICS: empty body — telemetry probe (`p1 metrics`): ask a node
+             (or a `p1 serve` replica) for its metrics registry snapshot
+             (node/telemetry.py — counters, gauges, per-stage latency
+             histograms).  Unlike GETSTATUS it IS shed under overload:
+             the status probe is the minimal health signal and stays up;
+             the full latency export is a capacity consumer an
+             overloaded node may refuse.
+- METRICS:   the registry snapshot as canonical JSON (utf-8) — same
+             growth-without-version-bump rationale as STATUS.
 - SNAPSHOT:  u8 kind — 0 none (no snapshot available), 1 manifest
              (u32 len + manifest payload), 2 chunks (u32 start + u16
              count + count * (u32 len + chunk payload)).  Everything
@@ -189,8 +198,10 @@ _LEN = struct.Struct(">I")
 #: query serving plane (GETFILTERS/FILTERS — compact block filters for
 #: light-client sync by filter match, chain/filters.py); v11 untrusted
 #: snapshot sync (GETSNAPSHOT/SNAPSHOT — chunked ledger-state snapshots
-#: with a self-describing manifest, chain/snapshot.py).
-PROTOCOL_VERSION = 11
+#: with a self-describing manifest, chain/snapshot.py); v12 the
+#: telemetry plane (GETMETRICS/METRICS — the metrics registry snapshot
+#: of node/telemetry.py, served by nodes and replicas).
+PROTOCOL_VERSION = 12
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -223,6 +234,8 @@ class MsgType(enum.IntEnum):
     FILTERS = 26
     GETSNAPSHOT = 27
     SNAPSHOT = 28
+    GETMETRICS = 29
+    METRICS = 30
 
 
 @dataclasses.dataclass(frozen=True)
@@ -428,6 +441,21 @@ def encode_status(status: dict) -> bytes:
 
     return bytes([MsgType.STATUS]) + json.dumps(
         status, separators=(",", ":")
+    ).encode("utf-8")
+
+
+def encode_getmetrics() -> bytes:
+    return bytes([MsgType.GETMETRICS])
+
+
+def encode_metrics(snapshot: dict) -> bytes:
+    """A metrics registry snapshot (node/telemetry.py) as canonical
+    JSON — same shape rationale as STATUS: the metric catalog grows
+    every round and must not cost a wire version per addition."""
+    import json
+
+    return bytes([MsgType.METRICS]) + json.dumps(
+        snapshot, separators=(",", ":")
     ).encode("utf-8")
 
 
@@ -787,15 +815,19 @@ def _decode(payload: bytes):
         if body:
             raise ValueError("bad GETSTATUS")
         return mtype, None
-    if mtype is MsgType.STATUS:
+    if mtype is MsgType.GETMETRICS:
+        if body:
+            raise ValueError("bad GETMETRICS")
+        return mtype, None
+    if mtype in (MsgType.STATUS, MsgType.METRICS):
         import json
 
         try:
             status = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, ValueError) as e:
-            raise ValueError(f"bad STATUS payload: {e}") from e
+            raise ValueError(f"bad {mtype.name} payload: {e}") from e
         if not isinstance(status, dict):
-            raise ValueError("bad STATUS payload: not an object")
+            raise ValueError(f"bad {mtype.name} payload: not an object")
         return mtype, status
     if mtype in (MsgType.PING, MsgType.PONG):
         if len(body) != 8:

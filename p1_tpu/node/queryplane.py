@@ -462,6 +462,14 @@ class QueryPlaneServer:
         self.idle_timeout_s = idle_timeout_s
         self.reuse_port = reuse_port
         self.governor = governor or ResourceGovernor()
+        # Telemetry registry (node/telemetry.py): replica-side query
+        # latency + the counters below, served over GETMETRICS exactly
+        # like the consensus node's.  Host clock by design — the
+        # replica is a real-socket separate-process tier the simulator
+        # never runs.
+        from p1_tpu.node.telemetry import MetricsRegistry
+
+        self.telemetry = MetricsRegistry()
         self.instance_nonce = secrets.randbits(64) | 1
         self._server: asyncio.Server | None = None
         self._sessions: set[asyncio.Task] = set()
@@ -626,10 +634,11 @@ class QueryPlaneServer:
                 ):
                     self.admission_dropped += 1
                     continue
-                reply = self._answer(mtype, body)
-                if reply is not None:
-                    self._count_query(mtype)
-                    await protocol.write_frame(writer, reply)
+                with self.telemetry.span("query.request_s"):
+                    reply = self._answer(mtype, body)
+                    if reply is not None:
+                        self._count_query(mtype)
+                        await protocol.write_frame(writer, reply)
         except (
             asyncio.IncompleteReadError,
             asyncio.TimeoutError,
@@ -661,6 +670,17 @@ class QueryPlaneServer:
             )
         if mtype is MsgType.GETSTATUS:
             return protocol.encode_status(self.status())
+        if mtype is MsgType.GETMETRICS:
+            # The replica serves its own registry — a fleet scrape sees
+            # every worker's latency surface, not just the writer's.
+            return protocol.encode_metrics(
+                {
+                    "role": "replica",
+                    "height": v.tip_height,
+                    "queries_total": sum(self.queries_served.values()),
+                    **self.telemetry.snapshot(),
+                }
+            )
         if mtype is MsgType.PING:
             return protocol.encode_pong(body)
         return None  # pushes / ledger queries: not this plane's job
@@ -673,6 +693,7 @@ _QUERY_TYPES = frozenset(
         MsgType.GETPROOF,
         MsgType.GETBLOCKS,
         MsgType.GETSTATUS,
+        MsgType.GETMETRICS,
     }
 )
 

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import logging
 import sys
 import time
 
@@ -54,6 +53,7 @@ async def run_node(args, miner=None) -> int:
             getattr(args, "mem_watermark_mb", 0.0) * (1 << 20)
         ),
         body_cache_blocks=getattr(args, "body_cache", 0),
+        telemetry=not getattr(args, "no_telemetry", False),
     )
     node = Node(config, miner=miner)
     await node.start()
@@ -74,7 +74,11 @@ async def run_node(args, miner=None) -> int:
             else:
                 deadline = time.time() + args.duration
             window = max(0.0, deadline - time.time())
-            logging.info("mining window: %.2fs until deadline", window)
+            # Through the node's identity adapter (node/telemetry.py
+            # NodeLogAdapter): in a multi-node process (`p1 net`,
+            # netharness workers sharing stderr) this line must say
+            # WHICH node's window it is.
+            node.log.info("mining window: %.2fs until deadline", window)
             await asyncio.wait({fatal}, timeout=window)
             if fatal.done():
                 rc = 4

@@ -59,6 +59,8 @@ def _topology_peers(rng: random.Random, i: int, degree: int) -> list[int]:
 
 
 def _report(net: SimNet, scenario: str, t0: float, **extra) -> dict:
+    from p1_tpu.node.telemetry import propagation_summary_ms
+
     report = {
         "scenario": scenario,
         "seed": net.seed,
@@ -75,6 +77,15 @@ def _report(net: SimNet, scenario: str, t0: float, **extra) -> dict:
         "reorgs_total": sum(
             n.metrics.reorgs for n in net.nodes.values()
         ),
+        # Telemetry timeline (round 14): the nodes' propagation
+        # histograms merged, in VIRTUAL milliseconds — what lets a
+        # scenario assert a p95 propagation bound instead of bare
+        # convergence.  None when telemetry is disabled.
+        "telemetry": {
+            "propagation": propagation_summary_ms(
+                n.telemetry for n in net.nodes.values()
+            )
+        },
         **extra,
     }
     report["trace_digest"] = net.trace_digest()
@@ -94,13 +105,17 @@ def partition_heal(
     difficulty: int = 8,
     heal_timeout_vs: float = 180.0,
     wall_limit_s: float | None = 420.0,
+    telemetry: bool = True,
 ) -> dict:
     """The flagship: mesh splits ``split``/1-``split``, both sides mine,
     the cut heals, one tip wins everywhere.  ok = global convergence at
     the majority chain's height, mass reorgs on the minority side, and
     exact ledger conservation, all inside ``heal_timeout_vs`` virtual
-    seconds of the heal."""
-    net = SimNet(seed=seed, difficulty=difficulty)
+    seconds of the heal.  ``telemetry=False`` disables the nodes'
+    latency recording — the trace digest must not move (the round-14
+    observer contract; tests/test_telemetry.py runs this scenario both
+    ways and compares)."""
+    net = SimNet(seed=seed, difficulty=difficulty, telemetry=telemetry)
     t0 = time.monotonic()
 
     async def main():
@@ -509,18 +524,24 @@ def wan(
     difficulty: int = 8,
     inter_bandwidth_bps: float = 100e6,
     wall_limit_s: float | None = 240.0,
+    telemetry: bool = True,
+    propagation_p95_bound_ms: float = 1500.0,
 ) -> dict:
     """Four regions (us/eu/asia/au) with asymmetric inter-region
     latency and shaped bandwidth; blocks are mined round-robin across
-    regions.  ok = global convergence, and the measured propagation
-    p95 actually shows the geography (at least one inter-region one-way
-    latency) — the proof the latency model is load-bearing, and the rig
-    for propagation studies."""
+    regions.  ok = global convergence, the measured propagation p95
+    actually shows the geography (at least one inter-region one-way
+    latency — the proof the latency model is load-bearing), AND — from
+    the round-14 telemetry histograms — the mesh-wide virtual-time
+    propagation p95 stays under ``propagation_p95_bound_ms``: a few
+    gossip hops across the worst configured path, an actual latency SLO
+    instead of bare convergence."""
     regions = ("us", "eu", "asia", "au")
     net = SimNet(
         seed=seed,
         difficulty=difficulty,
         default_profile=LinkProfile(latency_s=0.002, jitter_s=0.001),
+        telemetry=telemetry,
     )
     t0 = time.monotonic()
 
@@ -592,11 +613,23 @@ def wan(
             min_inter_region_latency_ms=min_inter_ms,
             geography_visible=max_p95_ms >= min_inter_ms,
         )
+        # The telemetry-histogram SLO: mesh-wide p95 propagation (in
+        # virtual ms, merged across every node) under the bound.  With
+        # telemetry disabled there is no histogram to assert on — the
+        # SLO is vacuously out of scope and `ok` falls back to the
+        # pre-round-14 criteria.
+        prop = report["telemetry"]["propagation"]
+        report["propagation_p95_bound_ms"] = propagation_p95_bound_ms
+        report["propagation_bounded"] = (
+            prop is None or prop["p95_ms"] <= propagation_p95_bound_ms
+        )
         report["ok"] = bool(
             done
             and report["converged"]
             and report["ledger_conserved"]
             and report["geography_visible"]
+            and report["propagation_bounded"]
+            and (not telemetry or prop is not None)
         )
         await net.stop_all()
         return report

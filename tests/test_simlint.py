@@ -77,6 +77,20 @@ class TestWallClockLint:
         assert "time.perf_counter" not in keys
         assert set(GRANTS["wall-clock"]["node/node.py"]) == {"asyncio.sleep"}
 
+    def test_telemetry_plane_is_clock_free_with_zero_grants(self):
+        """Round 14's module ships lint-covered and CLEAN: the
+        telemetry plane's whole contract is that it reads time only
+        through its injected clock (the node passes the transport
+        clock), so a direct wall-clock call here would break virtual-
+        time measurement AND the sim determinism pair at once.  The
+        ``time.monotonic`` spellings in the file are injectable default
+        arguments — references the AST rule correctly ignores."""
+        report = _wallclock_report()
+        assert not any(
+            f.file == "node/telemetry.py" for f in report.findings
+        ), [str(f) for f in report.findings if f.file == "node/telemetry.py"]
+        assert "node/telemetry.py" not in GRANTS["wall-clock"]
+
     def test_default_arg_references_are_structurally_clean(self):
         """What the AST migration BUYS over the tokenizer: the seam
         itself (node/transport.py) holds bare ``time.monotonic``
