@@ -231,11 +231,58 @@ def main() -> None:
             < REVALIDATE_DEGRADED_FRACTION * RECORDED_REVALIDATE_BPS
         ):
             extra["revalidate_degraded"] = True
-        from p1_tpu.core.keys import BACKEND as SIG_BACKEND
+        from p1_tpu.core import keys as _keys
 
-        extra["sig_backend"] = SIG_BACKEND
+        extra["sig_backend"] = _keys.backend()
     except ImportError:
         pass
+
+    # Native + device Ed25519 (round 15): per-signature cost of the
+    # native C++ batch engine against its recorded pin, and — only when
+    # P1_BENCH_DEVICE is set, because every mesh shape pays a
+    # multi-minute XLA compile on a small host — the device-sharded MSM
+    # (benchmarks/sig_verify.py has the full per-backend + scaling
+    # harness).  LOWER is better for both ratios.
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_SIG_DEVICE_MS,
+        RECORDED_SIG_NATIVE_MS,
+        SIG_DEGRADED_FACTOR,
+    )
+
+    try:
+        from p1_tpu.core import _ed25519_native
+
+        if _ed25519_native.available():
+            from benchmarks.sig_verify import _make_triples, _rate
+            from p1_tpu.core.keys import Keypair
+
+            kps = [Keypair.from_seed_text(f"bench-nat-{i}") for i in range(8)]
+            tr = _make_triples(1024, kps)
+            native_ms = 1e3 / _rate(
+                lambda: _ed25519_native.verify_batch(tr), 1024
+            )
+            extra["sig_native_ms"] = round(native_ms, 4)
+            extra["sig_native_vs_recorded"] = round(
+                native_ms / RECORDED_SIG_NATIVE_MS, 2
+            )
+            if native_ms > SIG_DEGRADED_FACTOR * RECORDED_SIG_NATIVE_MS:
+                extra["sig_native_degraded"] = True
+        import os as _os
+
+        if _os.environ.get("P1_BENCH_DEVICE"):
+            from benchmarks.sig_verify import bench_device
+
+            dv = bench_device(batch=256, device_counts=(8,), repeats=2)
+            if dv.get("device_us_per_sig"):
+                device_ms = dv["device_us_per_sig"] / 1e3
+                extra["sig_device_ms"] = round(device_ms, 2)
+                extra["sig_device_vs_recorded"] = round(
+                    device_ms / RECORDED_SIG_DEVICE_MS, 2
+                )
+                if device_ms > SIG_DEGRADED_FACTOR * RECORDED_SIG_DEVICE_MS:
+                    extra["sig_device_degraded"] = True
+    except ImportError:
+        pass  # bare install without the benchmarks/ tree
 
     # Query serving plane (round 9): quick same-session measurement of
     # cached proofs/s (benchmarks/query_plane.py), with the serial

@@ -1,19 +1,19 @@
-"""Signature-verification microbench: serial vs batch vs cached.
+"""Signature-verification microbench: every backend rung, one harness.
 
-The untrusted-path validation fast lane (round 8) rests on three claims:
-batched Ed25519 verification beats one-at-a-time calls, the verify-once
-cache makes re-checks free, and the pure-Python fallback's batch path —
-one multi-scalar multiplication per window — closes a useful fraction of
-the gap to the native wheel.  This harness measures all three on THIS
-machine, same contract as ``bench.py``: one JSON line, measured, no
-estimates.
+The untrusted-path validation fast lane (rounds 8 and 15) rests on the
+claims docs/PERF.md tables carry: batched Ed25519 beats one-at-a-time
+calls, the verify-once cache makes re-checks free, the native C++
+engine (native/ed25519.cpp) turns the wheel-less gap into a ~20×
+win, and the device-sharded JAX MSM scales with mesh size.  This
+harness measures all of it on THIS machine, same contract as
+``bench.py``: one JSON line, measured, no estimates.
 
-Rows cover both crypto backends where available: the ACTIVE backend
-(whatever ``core/keys.py`` resolved — the wheel when present) and the
-pure-Python fallback explicitly, so a wheel-equipped host reports both
-and a wheel-less CI image still shows the fallback's serial→batch gain
-next to the recorded constants the one-time warning cites
-(``_ed25519.RECORDED_SERIAL_MS`` / ``RECORDED_BATCH_MS``).
+Rows cover every backend rung the host can run (``core/keys.py``
+ladder): the ACTIVE backend, the pure-Python fallback explicitly, the
+native engine when a toolchain or cached build exists, and — behind
+``--device``, because each array shape pays a multi-minute XLA compile
+on a small host — the device MSM, including a devices-vs-throughput
+scaling row over 1/2/4/8-chip meshes (``device_scaling``).
 
 Optionally (``--store-blocks N``) builds an on-disk store and measures
 full untrusted revalidation three ways — serial (fast lane disabled),
@@ -56,20 +56,30 @@ def _rate(fn, payload_sigs: int, repeats: int = 3) -> float:
 
 
 def bench_micro(batch_sizes=(64, 256, 1024, 4096), serial_n=64) -> dict:
-    from p1_tpu.core import _ed25519, keys
+    from p1_tpu.core import _ed25519, _ed25519_native, keys
 
     keypairs = [keys.Keypair.from_seed_text(f"sigbench-{i}") for i in range(8)]
-    out: dict = {"backend": keys.BACKEND, "workers": keys.verify_workers()}
+    active = keys.backend()
+    out: dict = {"backend": active, "workers": keys.verify_workers()}
 
     triples = _make_triples(serial_n, keypairs)
     out["serial_us"] = round(
         1e6 / _rate(lambda: all(keys.verify(*t) for t in triples), serial_n), 1
     )
-    if keys.BACKEND != "pure-python":
+    if active != "pure-python":
         out["fallback_serial_us"] = round(
             1e6
             / _rate(
                 lambda: all(_ed25519.verify(*t) for t in triples), serial_n
+            ),
+            1,
+        )
+    if _ed25519_native.available():
+        out["native_serial_us"] = round(
+            1e6
+            / _rate(
+                lambda: all(_ed25519_native.verify(*t) for t in triples),
+                serial_n,
             ),
             1,
         )
@@ -80,15 +90,25 @@ def bench_micro(batch_sizes=(64, 256, 1024, 4096), serial_n=64) -> dict:
         out[f"batch{n}_us"] = round(
             1e6 / _rate(lambda: keys.verify_batch(tr), n), 1
         )
-        if keys.BACKEND != "pure-python":
+        if active != "pure-python":
             _ed25519._pubkey_point.cache_clear()
             out[f"fallback_batch{n}_us"] = round(
                 1e6 / _rate(lambda: _ed25519.verify_batch(tr), n), 1
+            )
+        if _ed25519_native.available() and active != "native":
+            out[f"native_batch{n}_us"] = round(
+                1e6 / _rate(lambda: _ed25519_native.verify_batch(tr), n), 1
             )
     biggest = max(batch_sizes)
     out["batch_speedup"] = round(
         out["serial_us"] / out[f"batch{biggest}_us"], 1
     )
+    if _ed25519_native.available():
+        # The headline the perf_record pin tracks: native ms/sig at the
+        # 1024 window, whichever rung is active.
+        key = "batch1024_us" if active == "native" else "native_batch1024_us"
+        if key in out:
+            out["native_batch_ms"] = round(out[key] / 1e3, 4)
 
     # Cached path: the verify-once memo a block connect hits for
     # mempool-resident transfers (txid-keyed, core/sigcache.py).
@@ -112,6 +132,54 @@ def bench_micro(batch_sizes=(64, 256, 1024, 4096), serial_n=64) -> dict:
         ),
         2,
     )
+    return out
+
+
+def bench_device(
+    batch: int = 512, device_counts=(1, 2, 4, 8), repeats: int = 3
+) -> dict:
+    """Devices-vs-throughput scaling for the sharded MSM path
+    (hashx/ed25519_msm.py): signatures/second through
+    ``verify_batch_device`` per mesh size, steady state (the one-time
+    XLA compile per mesh is paid by a warmup call and reported
+    separately — on real TPU pods it is once per pod lifetime).
+
+    Honesty note baked into the output: on a single-CPU host the mesh
+    is VIRTUAL (``--xla_force_host_platform_device_count``), so chips
+    share one core and the row measures the sharding seam's overhead,
+    not hardware scaling — docs/PERF.md prints it with exactly that
+    caveat, and docs/ROUND15.md has the tried/kept ledger.
+    """
+    import jax
+
+    from p1_tpu.core import keys
+    from p1_tpu.hashx import ed25519_msm
+
+    keypairs = [keys.Keypair.from_seed_text(f"sigbench-{i}") for i in range(8)]
+    tr = _make_triples(batch, keypairs)
+    out: dict = {"device_batch": batch, "device_rows": []}
+    avail = jax.device_count()
+    for n_dev in device_counts:
+        if n_dev > avail:
+            continue
+        t0 = time.perf_counter()
+        assert ed25519_msm.verify_batch_device(tr, n_devices=n_dev)
+        compile_s = time.perf_counter() - t0
+        rate = _rate(
+            lambda: ed25519_msm.verify_batch_device(tr, n_devices=n_dev),
+            batch,
+            repeats,
+        )
+        out["device_rows"].append(
+            {
+                "devices": n_dev,
+                "sigs_per_s": round(rate, 1),
+                "us_per_sig": round(1e6 / rate, 1),
+                "first_call_s": round(compile_s, 1),
+            }
+        )
+    if out["device_rows"]:
+        out["device_us_per_sig"] = out["device_rows"][-1]["us_per_sig"]
     return out
 
 
@@ -191,11 +259,24 @@ def main() -> None:
         help="also build an N-block store (1 signed transfer every other "
         "block) and measure full revalidation serial vs batch vs cached",
     )
+    ap.add_argument(
+        "--device",
+        action="store_true",
+        help="also measure the device-sharded JAX MSM "
+        "(hashx/ed25519_msm.py) with a devices-vs-throughput scaling "
+        "row — each mesh size pays one multi-minute XLA compile on a "
+        "small host, hence opt-in",
+    )
+    ap.add_argument(
+        "--device-batch", type=int, default=512, help="device window size"
+    )
     args = ap.parse_args()
 
     result = bench_micro(tuple(args.batch_sizes))
     if args.store_blocks:
         result.update(bench_revalidate(args.store_blocks))
+    if args.device:
+        result.update(bench_device(args.device_batch))
     try:
         load_1m, load_5m, _ = os.getloadavg()
         result["load_avg_1m"] = round(load_1m, 2)

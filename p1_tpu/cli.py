@@ -167,6 +167,18 @@ def build_parser() -> argparse.ArgumentParser:
         "releases the GIL); never changes validation outcomes",
     )
     p.add_argument(
+        "--sig-backend",
+        default="auto",
+        choices=["auto", "cryptography", "native", "fallback", "device"],
+        help="Ed25519 verification backend: auto resolves the ladder "
+        "(cryptography wheel > native C++ engine > pure-Python "
+        "fallback); native/cryptography pin a rung (degrading with a "
+        "warning if unavailable); fallback forces pure Python; device "
+        "routes batches through the JAX mesh multi-scalar "
+        "multiplication.  Never changes validation outcomes, only the "
+        "cost model",
+    )
+    p.add_argument(
         "--store-degraded-exit",
         action="store_true",
         help="exit (code 4) on the first store write failure instead of "

@@ -109,6 +109,16 @@ class NodeConfig:
     #: per window instead.  Worker count NEVER changes validation
     #: outcomes, only where the verify cost is paid.
     verify_workers: int = 0
+    #: Signature-verification backend (core/keys.py ladder, round 15).
+    #: "auto" (default) resolves wheel > native C++ engine > pure-Python
+    #: fallback; "cryptography"/"native" pin a rung (degrading down the
+    #: ladder with a warning if unavailable), "fallback" forces the
+    #: pure-Python tier, "device" opts batches into the JAX mesh
+    #: multi-scalar multiplication (hashx/ed25519_msm.py — a win on real
+    #: multi-chip meshes, not host CPUs).  Backend choice NEVER changes
+    #: validation outcomes — every rung is verdict- and error-text-
+    #: equivalent by test — only the cost model.
+    sig_backend: str = "auto"
     #: Deterministic identity/jitter seed.  None (production) draws the
     #: HELLO instance nonce and default miner id from the OS and leaves
     #: supervision backoff jitter on an unseeded RNG; a seed makes all

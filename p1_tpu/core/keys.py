@@ -20,23 +20,41 @@ a ledger mean anything.  Design (tpu rebuild, round 4):
 - Deterministic from a 32-byte seed, so tests can use fixed keys and the
   CLI can persist one JSON file per identity (``p1 keygen``).
 
-Validation fast lane (round 8).  Ed25519 verify costs ~100 µs native and
-~3 ms pure-Python, and it dominates every untrusted validation path, so
-this module carries three speed layers on top of the plain ``verify``:
+Validation fast lane (rounds 8 and 15).  Ed25519 verify costs ~100 µs
+with the wheel and ~3 ms pure-Python, and it dominates every untrusted
+validation path, so this module carries a backend LADDER plus three
+speed layers on top of the plain ``verify``:
 
+- **Backend ladder** (round 15): ``cryptography`` wheel > native C++
+  engine (native/ed25519.cpp via core/_ed25519_native.py — built
+  lazily, content-addressed, ~20× the pure-Python fallback on this
+  host) > pure-Python ``core/_ed25519.py``.  Resolution is lazy and
+  memoized (``backend()``); a missing wheel, missing compiler, or
+  failed build degrades one rung with a single log line and identical
+  semantics — every backend is pinned verdict- and error-text-
+  equivalent on every input by the torsion/corruption equivalence
+  matrix (tests/test_sigbatch.py, tests/test_native_ed25519.py).
+  ``set_sig_backend`` / ``NodeConfig.sig_backend`` / ``--sig-backend``
+  / ``P1_SIG_BACKEND`` force a rung (``fallback`` = pure-Python), or
+  opt batches into the ``device`` tier — the JAX multi-scalar
+  multiplication sharded over the chip mesh
+  (hashx/ed25519_msm.py, a win on real TPU meshes, not on host CPUs).
 - ``verify_batch(triples)`` — verify many (pubkey, sig, message) triples
   at once.  With the ``cryptography`` wheel the triples are chunked over
   a ``concurrent.futures`` thread pool (``set_verify_workers`` /
   ``config.verify_workers``; OpenSSL releases the GIL, so threads give
-  real parallelism on multi-core).  Without the wheel the pure-Python
-  fallback uses a genuine batch-verification equation — one multi-scalar
-  multiplication for the whole window plus an exact prime-subgroup gate
-  on every point (``_ed25519.verify_batch``), ~2× per signature at
-  revalidation window sizes — run in the calling thread (the fallback
-  holds the GIL, so a pool would add overhead, not parallelism) and
-  chunked so memory stays bounded.  Batch TRUE implies every triple is
-  serially valid; batch FALSE is not yet a verdict (the fallback gate
-  also rejects torsion-crafted inputs the serial equation tolerates).
+  real parallelism on multi-core) — the native engine's chunks use the
+  same pool (ctypes releases the GIL during the C call).  On the
+  pure-Python rung the fallback uses a genuine batch-verification
+  equation — one multi-scalar multiplication for the whole window plus
+  an exact prime-subgroup gate on every point
+  (``_ed25519.verify_batch``), ~2× per signature at revalidation window
+  sizes — run in the calling thread (it holds the GIL, so a pool would
+  add overhead, not parallelism) and chunked so memory stays bounded.
+  The native and device batches compute the SAME subgroup-gated
+  equation.  Batch TRUE implies every triple is serially valid; batch
+  FALSE is not yet a verdict (the gate also rejects torsion-crafted
+  inputs the serial equation tolerates).
 - ``first_invalid(triples)`` — serial-confirming locator used when a
   batch fails: sub-batches that pass are skipped (acceptance implies
   serial validity), everything else is settled by ``verify`` itself, so
@@ -72,6 +90,7 @@ except ImportError:  # the wheel is optional; fall back to pure Python
     HAVE_CRYPTOGRAPHY = False
 
 from p1_tpu.core import _ed25519 as _py_ed25519
+from p1_tpu.core import _ed25519_native as _native_ed25519
 
 #: Account-id prefix: distinguishes spendable (key-backed) accounts from
 #: free-form receive-only ids at a glance.
@@ -175,9 +194,93 @@ class Keypair:
 
 log = logging.getLogger(__name__)
 
-#: The active verification backend, named for telemetry
-#: (``status()["validation"]``) and the fallback's one-time warning.
-BACKEND = "cryptography" if HAVE_CRYPTOGRAPHY else "pure-python"
+#: Every signature backend this module can resolve, in ladder order.
+#: ``device`` is batch-only (hashx/ed25519_msm.py) and never enters
+#: auto-resolution — it is an explicit opt-in for real device meshes.
+SIG_BACKENDS = ("cryptography", "native", "pure-python", "device")
+
+#: Explicit backend override (``set_sig_backend``); None = auto ladder.
+_sig_backend: str | None = None
+#: Memoized auto/override resolution (native probing compiles once).
+_resolved: str | None = None
+
+
+def set_sig_backend(name: str | None) -> None:
+    """Pin the signature backend: ``auto``/None resolves the ladder
+    (wheel > native > pure-Python), ``cryptography``/``native`` force a
+    rung (falling back down the ladder with one warning if the rung is
+    unavailable), ``fallback``/``pure-python`` force the pure-Python
+    tier, ``device`` routes BATCHES through the JAX mesh MSM (serial
+    verifies keep the auto ladder — the device path only pays off at
+    window sizes).  Unknown names raise (a typo must not silently
+    change the validation cost model)."""
+    global _sig_backend, _resolved
+    if name in (None, "", "auto"):
+        _sig_backend = None
+    elif name == "fallback":
+        _sig_backend = "pure-python"
+    elif name in SIG_BACKENDS:
+        _sig_backend = name
+    else:
+        raise ValueError(
+            f"unknown signature backend {name!r} "
+            f"(choose from auto/fallback/{'/'.join(SIG_BACKENDS)})"
+        )
+    _resolved = None
+
+
+def backend() -> str:
+    """The ACTIVE serial-verification backend name, resolved lazily.
+
+    Resolution is memoized: probing the native rung may compile the
+    shared object once (content-addressed cache), and a failed probe is
+    remembered so a compiler-less image pays one attempt, not one per
+    call.  ``device`` overrides report ``device`` (that is where batch
+    work goes) while serial dispatch underneath keeps the auto ladder.
+    """
+    global _resolved
+    if _resolved is not None:
+        return _resolved
+    want = _sig_backend
+    if want is None:
+        want = os.environ.get("P1_SIG_BACKEND") or "auto"
+        if want == "fallback":
+            want = "pure-python"
+        if want not in SIG_BACKENDS and want != "auto":
+            log.warning("P1_SIG_BACKEND=%r unknown; using auto", want)
+            want = "auto"
+    if want == "cryptography" and not HAVE_CRYPTOGRAPHY:
+        log.warning(
+            "signature backend 'cryptography' requested but the wheel is "
+            "absent; resolving the auto ladder instead"
+        )
+        want = "auto"
+    if want == "native" and not _native_ed25519.available():
+        log.warning(
+            "signature backend 'native' requested but the engine did not "
+            "load (no compiler / build failure); resolving the auto ladder"
+        )
+        want = "auto"
+    if want == "auto":
+        if HAVE_CRYPTOGRAPHY:
+            want = "cryptography"
+        elif _native_ed25519.available():
+            want = "native"
+        else:
+            want = "pure-python"
+    _resolved = want
+    return want
+
+
+def _serial_backend() -> str:
+    """Where one-at-a-time verifies go: the active backend, except that
+    ``device`` is batch-only and serial work takes the ladder beneath."""
+    b = backend()
+    if b != "device":
+        return b
+    if HAVE_CRYPTOGRAPHY:
+        return "cryptography"
+    return "native" if _native_ed25519.available() else "pure-python"
 
 
 @dataclasses.dataclass
@@ -187,15 +290,24 @@ class VerifyStats:
     ones that went through ``verify_batch`` — together they are the
     node's "how much Ed25519 did we actually pay for" figure, and the
     no-double-verify regression tests assert their deltas are zero on
-    cache-hit paths (a cache hit touches neither counter)."""
+    cache-hit paths (a cache hit touches neither counter).
+    ``backends`` splits the same signature counts by the backend that
+    did the work (``status()["validation"]["backends"]``, the
+    MetricsRegistry export) — the key set is FIXED so the status wire
+    contract stays byte-pinnable."""
 
     serial: int = 0
     batched: int = 0
     batches: int = 0
     pool_dispatches: int = 0
+    backends: dict = dataclasses.field(
+        default_factory=lambda: {name: 0 for name in SIG_BACKENDS}
+    )
 
     def reset(self) -> None:
         self.serial = self.batched = self.batches = self.pool_dispatches = 0
+        for name in self.backends:
+            self.backends[name] = 0
 
 
 STATS = VerifyStats()
@@ -205,13 +317,19 @@ def _backend_verify(pubkey: bytes, sig: bytes, message: bytes) -> bool:
     """THE single-signature backend dispatch — every serial verify in
     the process funnels through here (tests spy on it)."""
     STATS.serial += 1
-    if not HAVE_CRYPTOGRAPHY:
-        return _py_ed25519.verify(pubkey, sig, message)
-    try:
-        ed25519.Ed25519PublicKey.from_public_bytes(pubkey).verify(sig, message)
-        return True
-    except (InvalidSignature, ValueError):
-        return False
+    which = _serial_backend()
+    STATS.backends[which] += 1
+    if which == "cryptography":
+        try:
+            ed25519.Ed25519PublicKey.from_public_bytes(pubkey).verify(
+                sig, message
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+    if which == "native":
+        return _native_ed25519.verify(pubkey, sig, message)
+    return _py_ed25519.verify(pubkey, sig, message)
 
 
 #: Bounded negative-verify memo.  Positive results are memoized at the
@@ -351,32 +469,87 @@ def _verify_chunk(triples) -> bool:
     return True
 
 
+def _batch_worker():
+    """``(backend_name, callable)`` one batch CHUNK runs through —
+    resolved per batch so ``set_sig_backend`` takes effect immediately.
+    Every worker computes the same subgroup-gated contract (or, for the
+    wheel, exact per-signature serial checks, which is strictly
+    stronger than 'batch TRUE implies serial TRUE')."""
+    b = backend()
+    if b == "device":
+        try:
+            from p1_tpu.hashx import ed25519_msm
+
+            return "device", ed25519_msm.verify_batch_device
+        except Exception as exc:  # jax missing/misconfigured: degrade
+            log.warning(
+                "device signature backend unavailable (%s); using the "
+                "host ladder for this process",
+                exc,
+            )
+            set_sig_backend(None)
+            b = backend()
+    if b == "cryptography":
+        return "cryptography", _verify_chunk
+    if b == "native":
+        return "native", _native_ed25519.verify_batch
+    return "pure-python", _py_ed25519.verify_batch
+
+
 def _use_pool(n_chunks: int) -> bool:
-    """Whether a batch's chunks go to the thread pool.  Only the wheel
-    path benefits: OpenSSL releases the GIL inside each verify, so
-    chunks genuinely overlap.  The pure-Python fallback holds the GIL
-    for its whole MSM — dispatching it to workers buys no parallelism,
-    just executor overhead and pool churn — so fallback chunks run in
-    the calling thread.  Tests monkeypatch this to force the pool and
-    exercise its shutdown/cancellation machinery without the wheel."""
-    return HAVE_CRYPTOGRAPHY and n_chunks > 1 and verify_workers() > 1
+    """Whether a batch's chunks go to the thread pool.  The wheel and
+    native paths benefit: OpenSSL releases the GIL inside each verify
+    and ctypes releases it around the native batch call, so chunks
+    genuinely overlap on multi-core.  The pure-Python fallback holds
+    the GIL for its whole MSM — dispatching it to workers buys no
+    parallelism, just executor overhead and pool churn — so fallback
+    chunks run in the calling thread; the device path schedules its own
+    mesh and must not be double-dispatched.  Tests monkeypatch this to
+    force the pool and exercise its shutdown/cancellation machinery
+    without the wheel."""
+    return (
+        backend() in ("cryptography", "native")
+        and n_chunks > 1
+        and verify_workers() > 1
+    )
 
 
 def _warn_fallback_once() -> None:
+    """One-time cost-model warning when batches run on the pure-Python
+    rung, naming the FASTEST backend this host could offer instead —
+    so no-wheel numbers are never read as regressions, and an operator
+    who merely lacks the toolchain learns the native rung exists."""
     global _fallback_warned
     if _fallback_warned:
         return
     _fallback_warned = True
+    from p1_tpu.hashx.perf_record import RECORDED_SIG_NATIVE_MS
+
+    if _sig_backend == "pure-python":
+        fastest = (
+            "the pure-Python fallback was FORCED via "
+            "--sig-backend/P1_SIG_BACKEND; 'auto' would pick a faster rung"
+        )
+    elif _native_ed25519.available():
+        # Reachable only by forcing pure-python off a native-capable
+        # host, handled above — kept for the belt-and-braces case.
+        fastest = "the native C++ engine is available on this host"
+    else:
+        fastest = (
+            "fastest available here; the native C++ engine "
+            f"(~{RECORDED_SIG_NATIVE_MS:.2f} ms/sig batched, recorded) "
+            "needs only a C++ toolchain, and the `cryptography` wheel "
+            "(~0.1 ms/sig) neither"
+        )
     log.warning(
         "pure-Python Ed25519 fallback is the active backend for batch "
         "verification: ~%.1f ms/signature serial, ~%.2f ms batched "
-        "(recorded on the 1-vCPU bench host) vs ~0.1 ms with the "
-        "`cryptography` wheel — roughly %d× slower end to end.  "
-        "Numbers measured without the wheel are NOT comparable to the "
-        "wheel-based records in docs/PERF.md.",
+        "(recorded on the 1-vCPU bench host) — %s.  Numbers measured on "
+        "this rung are NOT comparable to the wheel- or native-based "
+        "records in docs/PERF.md.",
         _py_ed25519.RECORDED_SERIAL_MS,
         _py_ed25519.RECORDED_BATCH_MS,
-        int(_py_ed25519.RECORDED_BATCH_MS / 0.1),
+        fastest,
     )
 
 
@@ -384,32 +557,33 @@ def verify_batch(triples) -> bool:
     """True only if EVERY (pubkey, sig, message) triple is serially
     valid (batch acceptance implies serial acceptance).
 
-    False means "not proven": usually a bad signature, but the fallback
-    batch also rejects torsion-crafted inputs the serial equation
+    False means "not proven": usually a bad signature, but the gated
+    batches also reject torsion-crafted inputs the serial equation
     tolerates (_ed25519.py's docstring) — use ``first_invalid`` to
     settle a failed batch with serial-identical semantics.
-    Dispatch: wheel → per-signature verifies chunked across the worker
-    pool (exact serial semantics, parallel on multi-core); fallback →
-    the pure-Python subgroup-gated batch equation per chunk, in the
-    calling thread (``_use_pool``).
+    Dispatch (``_batch_worker``): wheel → per-signature verifies
+    chunked across the worker pool (exact serial semantics, parallel on
+    multi-core); native → the C++ subgroup-gated batch per chunk, also
+    pool-parallel (ctypes releases the GIL); pure-Python → the fallback
+    MSM per chunk in the calling thread; device (opt-in) → the JAX mesh
+    MSM (hashx/ed25519_msm.py).
     """
     triples = list(triples)
     if not triples:
         return True
     STATS.batches += 1
     STATS.batched += len(triples)
-    if not HAVE_CRYPTOGRAPHY:
+    which, worker = _batch_worker()
+    if which == "pure-python":
         _warn_fallback_once()
     if len(triples) < BATCH_MIN:
         STATS.batched -= len(triples)  # accounted as serial below
         return _verify_serial_counted(triples)
+    STATS.backends[which] += len(triples)
     chunks = [
         triples[i : i + BATCH_CHUNK]
         for i in range(0, len(triples), BATCH_CHUNK)
     ]
-    worker = (
-        _verify_chunk if HAVE_CRYPTOGRAPHY else _py_ed25519.verify_batch
-    )
     if not _use_pool(len(chunks)):
         return all(worker(chunk) for chunk in chunks)
     n = verify_workers()
@@ -478,3 +652,13 @@ def first_invalid(triples) -> int | None:
 
     # Callers reach here right after a failed full batch: don't re-run it.
     return scan(0, len(triples), True)
+
+
+def __getattr__(name: str):
+    # Round-15 compat: ``BACKEND`` was a module constant when the
+    # ladder had two fixed rungs; with lazy native resolution it is a
+    # function (``backend()``).  Old importers keep working — the
+    # attribute read resolves the ladder at that moment.
+    if name == "BACKEND":
+        return backend()
+    raise AttributeError(name)

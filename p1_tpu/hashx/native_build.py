@@ -1,11 +1,15 @@
-"""Build the native SHA-256d core (p1_tpu/native/sha256d.cpp) on demand.
+"""Build the native crypto core (p1_tpu/native/*.cpp) on demand.
 
-The .so is machine-local (it carries a runtime SHA-NI dispatch but is built
-with the local toolchain), so it is compiled lazily into a content-addressed
-cache — first `get_backend("native")` pays one g++ invocation, everything
-after that is an mmap.  No setuptools, no pybind11: the C ABI + ctypes is
-the whole binding layer (this environment ships no pybind11; the CPython
-API would be overkill for four functions).
+One shared object carries both native engines — the SHA-256d miner/
+verifier core (sha256d.cpp, runtime SHA-NI dispatch) and the Ed25519
+batch verifier (ed25519.cpp, portable __int128 radix-51 field
+arithmetic).  The .so is machine-local (local toolchain), so it is
+compiled lazily into a content-addressed cache — the first consumer
+(`get_backend("native")` or the first signature-backend resolution in
+core/keys.py) pays one g++ invocation, everything after that is an
+mmap.  No setuptools, no pybind11: the C ABI + ctypes is the whole
+binding layer (this environment ships no pybind11; the CPython API
+would be overkill for a dozen functions).
 """
 
 from __future__ import annotations
@@ -15,7 +19,13 @@ import os
 import pathlib
 import subprocess
 
-SOURCE = pathlib.Path(__file__).resolve().parent.parent / "native" / "sha256d.cpp"
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+SOURCES = (
+    _NATIVE_DIR / "sha256d.cpp",
+    _NATIVE_DIR / "ed25519.cpp",
+)
+#: Kept for older callers/tests that referenced the single-source name.
+SOURCE = SOURCES[0]
 
 
 class NativeBuildError(RuntimeError):
@@ -32,12 +42,15 @@ def cache_dir() -> pathlib.Path:
 def build_lib(force: bool = False) -> pathlib.Path:
     """Compile (if needed) and return the shared library path.
 
-    Content-addressed by source hash: editing the .cpp invalidates the
-    cache automatically; concurrent builders race benignly via an atomic
-    rename of a per-pid temp file.
+    Content-addressed by the hash of every source: editing any .cpp
+    invalidates the cache automatically; concurrent builders race
+    benignly via an atomic rename of a per-pid temp file.
     """
-    tag = hashlib.sha256(SOURCE.read_bytes()).hexdigest()[:16]
-    out = cache_dir() / f"sha256d_{tag}.so"
+    h = hashlib.sha256()
+    for src in SOURCES:
+        h.update(src.read_bytes())
+    tag = h.hexdigest()[:16]
+    out = cache_dir() / f"p1native_{tag}.so"
     if out.exists() and not force:
         return out
     out.parent.mkdir(parents=True, exist_ok=True)
@@ -50,7 +63,7 @@ def build_lib(force: bool = False) -> pathlib.Path:
         "-fPIC",
         "-shared",
         "-fno-exceptions",
-        str(SOURCE),
+        *[str(src) for src in SOURCES],
         "-o",
         str(tmp),
     ]

@@ -44,26 +44,59 @@ RECORDED_HOST_INGEST_BPS = 22_000.0
 #: guard: host rates on the shared 1-vCPU box wobble with co-tenants.
 HOST_INGEST_DEGRADED_FRACTION = 0.5
 
-#: Untrusted-path revalidation (round 8): blocks/s through
+#: Untrusted-path revalidation: blocks/s through
 #: ``ChainStore.load_chain(trusted=False)`` on the bench shape (400
 #: blocks × 2 signed transfers, difficulty 1) with the batched-signature
-#: fast lane, measured 2026-08-04 on the 1-vCPU bench host with the
-#: pure-Python Ed25519 fallback active (the wheel is absent in this
-#: image — keys.py's one-time warning names the backend; a wheel-
-#: equipped host runs several times faster and should re-record).
-#: Re-pinned 2026-08-04 (loadavg 0.54) after the subgroup-gate
-#: consensus fix: the prior 1,100 blocks/s pin was measured with the
-#: ungated cofactored batch, whose extra speed was a consensus
-#: divergence (docs/ROUND8.md "Review fix") — ratios against the old
-#: pin would misread the fix as a ~3× regression.
+#: fast lane on the AUTO backend ladder.  Re-pinned 2026-08-05
+#: (loadavg 0.43) for the round-15 native Ed25519 engine — the auto
+#: ladder now resolves native on this toolchain-equipped wheel-less
+#: host, so the prior 329 blocks/s pin (pure-Python batch, re-pinned
+#: 2026-08-04 after the subgroup-gate consensus fix; the 1,100 pin
+#: before THAT was the ungated consensus-divergent batch) describes a
+#: rung this host no longer runs — ratios against it would misread the
+#: backend ladder as a 13× speedup of the same code.  A host without a
+#: C++ toolchain still lands on the fallback rung and should read its
+#: numbers against 329, which keys.py's one-time warning names.
 #: ``bench.py`` emits ``revalidate_vs_recorded`` against this figure —
 #: the denominator-pinning convention of RECORDED_CPU_BASELINE_HPS.
-RECORDED_REVALIDATE_BPS = 329.0
+RECORDED_REVALIDATE_BPS = 4376.0
+#: The retired pure-Python-batch pin (see above), kept for wheel-less
+#: toolchain-less hosts to read their fallback numbers against.
+RECORDED_REVALIDATE_FALLBACK_BPS = 329.0
 
 #: Same-session fraction below which the revalidation measurement is
 #: flagged degraded in the bench JSON (same tolerance rationale as the
 #: ingest guard).
 REVALIDATE_DEGRADED_FRACTION = 0.5
+
+#: Native C++ Ed25519 engine (round 15, native/ed25519.cpp):
+#: milliseconds per signature through ``keys.verify_batch`` on the
+#: native rung at the 1024-signature bench window, subgroup gate
+#: included, measured 2026-08-05 on the 1-vCPU bench host
+#: (benchmarks/sig_verify.py ``native_batch1024_us``).  The fallback
+#: warning in core/keys.py names this figure so a wheel-less,
+#: compiler-less operator knows what one `g++` buys.  ``bench.py``
+#: emits ``sig_native_ms`` against it.
+RECORDED_SIG_NATIVE_MS = 0.07
+
+#: Device-sharded JAX MSM (round 15, hashx/ed25519_msm.py):
+#: milliseconds per signature through ``verify_batch_device`` on the
+#: 8-virtual-device CPU mesh at the 512-signature bench window,
+#: subgroup gates included, measured 2026-08-05 on the 1-vCPU bench
+#: host (loadavg ≤4.7 — the per-mesh XLA compiles themselves).
+#: Context the number needs: on ONE CPU host the mesh is virtual, so
+#: this records the ARCHITECTURE cost (dispatch + vectorized int32
+#: field arithmetic sharing one core), not a speedup — ~13× slower
+#: than the pure-Python MSM here, which is why the device rung is
+#: opt-in.  The path exists for real multi-chip meshes; re-record
+#: there.  ``bench.py`` emits ``sig_device_ms`` against it (behind
+#: P1_BENCH_DEVICE).
+RECORDED_SIG_DEVICE_MS = 18.7
+
+#: Same-session factor over the recorded per-signature figures above
+#: which a measurement is flagged degraded in the bench JSON (LOWER is
+#: better for both; generous band for co-tenant noise).
+SIG_DEGRADED_FACTOR = 2.0
 
 #: Query serving plane (round 9): cached proofs/s through the proof
 #: cache's steady state — LRU payload hit + 4-byte tip patch per serve

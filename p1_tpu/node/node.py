@@ -553,6 +553,11 @@ class Node:
             # must survive multi-node test processes where the conftest
             # knob pinned workers=1 for determinism.
             keys.set_verify_workers(config.verify_workers)
+        if config.sig_backend != "auto":
+            # Same explicit-pin discipline for the signature backend
+            # (core/keys.py ladder): "auto" must not clobber another
+            # node's pin in multi-node test processes.
+            keys.set_sig_backend(config.sig_backend)
         # balance_of is a bound-late lambda (not a bound method) so the
         # store-resume path in start(), which REPLACES self.chain, keeps
         # the pool pointed at the live chain's ledger.  The chain tag is
@@ -3455,7 +3460,25 @@ class Node:
         """The METRICS wire payload (`p1 metrics`): the registry dump
         plus just enough identity to label a scrape.  Distinct from
         ``status()`` — that is the curated operator view; this is the
-        raw catalog every exporter renders from."""
+        raw catalog every exporter renders from.
+
+        The validation-backend counters (keys.STATS, round 15) are
+        synced into registry gauges HERE, on the export path only:
+        they are process-wide accumulators owned by core/keys.py, and
+        mirroring them at verify time would put a registry write on the
+        hot validation path for a number only scrapes read.  Gauges
+        (not counters) because the registry copy is a mirror, not the
+        source of truth."""
+        for name, value in (
+            ("validation.sigs_serial", keys.STATS.serial),
+            ("validation.sigs_batched", keys.STATS.batched),
+            ("validation.sigs_cached", self.sig_cache.hits),
+            *(
+                (f"validation.backend.{b}", keys.STATS.backends.get(b, 0))
+                for b in keys.SIG_BACKENDS
+            ),
+        ):
+            self.telemetry.gauge(name).value = value
         return {
             "role": "node",
             "miner_id": self.miner_id,
@@ -3591,7 +3614,15 @@ class Node:
                 "batches": keys.STATS.batches,
                 "serial": keys.STATS.serial,
                 "pool_dispatches": keys.STATS.pool_dispatches,
-                "backend": keys.BACKEND,
+                "backend": keys.backend(),
+                # Per-backend signature counts (round 15 ladder) — the
+                # key set is FIXED (every rung always present, zeros
+                # included) so the status wire contract stays
+                # byte-pinnable (tests/test_telemetry.py STATUS_KEYS).
+                "backends": {
+                    name: keys.STATS.backends.get(name, 0)
+                    for name in keys.SIG_BACKENDS
+                },
                 "workers": keys.verify_workers(),
             },
             # Conservation probe: with a coinbase in every block (ours) and

@@ -49,7 +49,9 @@ def test_walk_found_the_tree():
         "p1_tpu.analysis.rules.awaitstate",
         "p1_tpu.core.keys",
         "p1_tpu.core._ed25519",
+        "p1_tpu.core._ed25519_native",
         "p1_tpu.core.sigcache",
+        "p1_tpu.hashx.ed25519_msm",
         "p1_tpu.chain.replay",
         "p1_tpu.chain.filters",
         "p1_tpu.node.node",

@@ -1,0 +1,302 @@
+"""Native C++ Ed25519 engine: parity with the pure-Python oracle.
+
+The round-15 contract: the native backend (native/ed25519.cpp via
+core/_ed25519_native.py) may change WHERE the curve arithmetic runs,
+never WHAT is accepted — ``verify`` is bit-identical to the serial
+cofactorless ``_ed25519.verify`` on every input (torsion crafts it
+tolerates included), and ``verify_batch`` carries the exact subgroup-
+gated batch semantics (acceptance implies serial acceptance, False is
+not a verdict).  Plus the degradation contract: a missing compiler or
+failing build must leave the process on the pure-Python rung with one
+log line and zero behavior change.
+
+Build handling: the first ``available()`` call compiles the shared
+object into the content-addressed cache (or loads the cached build);
+on a toolchain-less image it fails once and every native-only test
+here SKIPS cleanly — the fallback-path tests still run.
+"""
+
+import random
+
+import pytest
+
+from p1_tpu.core import _ed25519 as py_ed
+from p1_tpu.core import _ed25519_native as native
+from p1_tpu.core import keys
+
+HAVE_NATIVE = native.available()
+needs_native = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="no C++ toolchain / native build unavailable"
+)
+
+
+def _triples(n, salt="n"):
+    out = []
+    for i in range(n):
+        seed = bytes([i % 5]) * 31 + bytes([len(salt) % 256])
+        msg = b"native-%d-%s" % (i, salt.encode())
+        out.append((py_ed.public_key(seed), py_ed.sign(seed, msg), msg))
+    return out
+
+
+def _corrupt(triple, how):
+    pubkey, sig, msg = triple
+    if how == "sig":
+        return (pubkey, sig[:20] + bytes([sig[20] ^ 1]) + sig[21:], msg)
+    if how == "msg":
+        return (pubkey, sig, msg + b"!")
+    if how == "key":
+        return (py_ed.public_key(b"\x07" * 32), sig, msg)
+    if how == "s_range":  # scalar >= group order: rejected pre-math
+        return (pubkey, sig[:32] + py_ed._Q.to_bytes(32, "little"), msg)
+    if how == "bad_y":  # non-canonical y >= p: decompression rejects
+        return (py_ed._P.to_bytes(32, "little"), sig, msg)
+    if how == "short":
+        return (pubkey[:31], sig, msg)
+    raise AssertionError(how)
+
+
+def _torsion_triple(*, cancel: bool):
+    """A signature carrying small-order torsion (the round-8 fixtures):
+    cancel=True is serially VALID (torsion cancels), cancel=False is
+    the chain-split craft serial rejects."""
+    t_enc = (
+        (py_ed._P - 1) if cancel else 0
+    ).to_bytes(32, "little")
+    a, prefix = py_ed._secret_expand(bytes(32))
+    torsion = py_ed._pt_decompress(t_enc)
+    a_pt = py_ed._pt_mul(a, py_ed._B)
+    pub = py_ed._pt_compress(
+        py_ed._pt_add(a_pt, torsion) if cancel else a_pt
+    )
+    for i in range(200):
+        msg = b"native-torsion-%d" % i
+        r = int.from_bytes(py_ed._sha512(prefix + msg), "little") % py_ed._Q
+        r_enc = py_ed._pt_compress(
+            py_ed._pt_add(py_ed._pt_mul(r, py_ed._B), torsion)
+        )
+        k = (
+            int.from_bytes(py_ed._sha512(r_enc + pub + msg), "little")
+            % py_ed._Q
+        )
+        if cancel and k % 2 == 0:
+            continue
+        return pub, r_enc + ((r + k * a) % py_ed._Q).to_bytes(32, "little"), msg
+    raise AssertionError("no usable k in 200 tries")
+
+
+@needs_native
+class TestNativeSerialParity:
+    """native.verify == _ed25519.verify, input for input."""
+
+    def test_rfc8032_vector(self):
+        seed = bytes.fromhex(
+            "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+        )
+        pub = py_ed.public_key(seed)
+        sig = py_ed.sign(seed, b"")
+        assert native.verify(pub, sig, b"")
+        assert not native.verify(pub, sig, b"x")
+
+    def test_corruption_matrix_matches_serial(self):
+        base = _triples(6, salt="ser")
+        for pos, triple in enumerate(base):
+            assert native.verify(*triple) and py_ed.verify(*triple)
+            for how in ("sig", "msg", "key", "s_range", "bad_y", "short"):
+                bad = _corrupt(triple, how)
+                assert native.verify(*bad) == py_ed.verify(*bad) == False, (
+                    pos,
+                    how,
+                )
+
+    def test_torsion_crafts_identical_verdicts(self):
+        # The serial rule TOLERATES cancelling torsion — the native
+        # serial path must accept exactly what pure Python accepts (a
+        # gated native serial would silently change consensus).
+        acc = _torsion_triple(cancel=True)
+        assert py_ed.verify(*acc) and native.verify(*acc)
+        rej = _torsion_triple(cancel=False)
+        assert not py_ed.verify(*rej) and not native.verify(*rej)
+
+    def test_random_mixes_match(self):
+        rng = random.Random(15)
+        base = _triples(12, salt="mix")
+        for _ in range(8):
+            batch = [
+                _corrupt(t, rng.choice(("sig", "msg")))
+                if rng.random() < 0.3
+                else t
+                for t in base
+            ]
+            for t in batch:
+                assert native.verify(*t) == py_ed.verify(*t)
+
+
+@needs_native
+class TestNativeBatch:
+    """native.verify_batch: the subgroup-gated batch contract."""
+
+    def test_q_constant_pinned(self):
+        # The C engine's transcribed gate scalar must BE the group
+        # order: B has exact order q, so gate(B) is True iff the
+        # constant is exactly q (any other scalar ≤ 2^256 maps B off
+        # the identity), and order-2 torsion must gate False.
+        assert native.in_subgroup(py_ed._pt_compress(py_ed._B)) is True
+        assert (
+            native.in_subgroup((py_ed._P - 1).to_bytes(32, "little")) is False
+        )
+
+    @pytest.mark.parametrize("n", [0, 1, 2, 7, 8, 9, 33])
+    def test_all_valid_accepts(self, n):
+        assert native.verify_batch(_triples(n))
+
+    def test_corruption_at_every_position_rejects(self):
+        base = _triples(10, salt="pos")
+        for pos in range(len(base)):
+            for how in ("sig", "msg", "key", "s_range", "bad_y", "short"):
+                bad = list(base)
+                bad[pos] = _corrupt(bad[pos], how)
+                assert not native.verify_batch(bad), (pos, how)
+
+    def test_batch_verdicts_match_fallback(self):
+        rng = random.Random(16)
+        base = _triples(16, salt="eq")
+        for _ in range(6):
+            batch = [
+                _corrupt(t, rng.choice(("sig", "msg")))
+                if rng.random() < 0.2
+                else t
+                for t in base
+            ]
+            assert native.verify_batch(batch) == py_ed.verify_batch(batch)
+
+    def test_torsion_gate_rejects_what_serial_tolerates(self):
+        # Batch acceptance implies serial acceptance — so the batch
+        # must NOT accept the cancelling craft serial tolerates (it is
+        # settled by first_invalid's serial confirmation upstream).
+        acc = _torsion_triple(cancel=True)
+        assert py_ed.verify(*acc)
+        assert not native.verify_batch([acc] * 8)
+        rej = _torsion_triple(cancel=False)
+        assert not native.verify_batch([rej] * 8)
+        # parity with the fallback batch on both
+        assert not py_ed.verify_batch([acc] * 8)
+        assert not py_ed.verify_batch([rej] * 8)
+
+    def test_gate_is_exact_vs_python_oracle(self):
+        rng = random.Random(25519)
+        t2 = (py_ed._P - 1).to_bytes(32, "little")
+        t4 = (0).to_bytes(32, "little")
+        cases = [t2, t4, py_ed._pt_compress(py_ed._B)]
+        for _ in range(6):
+            honest = py_ed._pt_mul(rng.randrange(1, py_ed._Q), py_ed._B)
+            cases.append(py_ed._pt_compress(honest))
+            for enc in (t2, t4):
+                mixed = py_ed._pt_add(honest, py_ed._pt_decompress(enc))
+                cases.append(py_ed._pt_compress(mixed))
+        for enc in cases:
+            pt = py_ed._pt_decompress(enc)
+            assert native.in_subgroup(enc) == py_ed._in_prime_subgroup(pt)
+        assert native.in_subgroup(py_ed._P.to_bytes(32, "little")) is None
+
+    def test_duplicate_pubkeys_dedup_safely(self):
+        # The seam gates each unique pubkey once; many sigs from one
+        # key must still verify (and reject) correctly.
+        tr = _triples(12, salt="dup")  # 5 unique keys by construction
+        assert native.verify_batch(tr)
+        bad = list(tr)
+        bad[11] = _corrupt(bad[11], "sig")
+        assert not native.verify_batch(bad)
+
+
+class TestBackendLadder:
+    """keys.py resolution: wheel > native > pure-python, per-backend
+    accounting, and graceful degradation when the build is absent."""
+
+    def teardown_method(self):
+        keys.set_sig_backend(None)
+
+    @needs_native
+    def test_auto_resolves_native_without_wheel(self):
+        if keys.HAVE_CRYPTOGRAPHY:
+            pytest.skip("wheel present: auto resolves cryptography")
+        keys.set_sig_backend(None)
+        assert keys.backend() == "native"
+
+    @needs_native
+    def test_native_work_counted_per_backend(self):
+        keys.set_sig_backend("native")
+        tr = _triples(keys.BATCH_MIN, salt="count")
+        keys.STATS.reset()
+        assert keys.verify_batch(tr)
+        assert keys.STATS.backends["native"] == len(tr)
+        keys._neg_cache.clear()
+        assert keys.verify(*tr[0])
+        assert keys.STATS.backends["native"] == len(tr) + 1
+
+    @needs_native
+    def test_first_invalid_serial_contract_on_native(self):
+        # first_invalid settles via serial verify — on the native rung
+        # that is the native serial path, whose verdicts are pinned
+        # identical above, so the left-first contract carries over.
+        keys.set_sig_backend("native")
+        base = _triples(24, salt="fi")
+        tors = _torsion_triple(cancel=True)
+        mixed = list(base)
+        mixed[2] = tors  # gate-rejected, serially valid
+        mixed[20] = _corrupt(mixed[20], "sig")
+        assert not keys.verify_batch(mixed)
+        assert keys.first_invalid(mixed) == 20
+        mixed[20] = base[20]
+        assert keys.first_invalid(mixed) is None
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError):
+            keys.set_sig_backend("sandybridge")
+
+    def test_build_absent_degrades_to_pure_python(self, monkeypatch):
+        # The graceful-degradation contract: with the native object
+        # unloadable, auto resolution lands on pure-python and every
+        # verify path still works — no exception escapes the seam.
+        from p1_tpu.hashx import native_build
+
+        monkeypatch.setattr(native, "_LIB", None)
+        monkeypatch.setattr(native, "_LOAD_FAILED", False)
+
+        def boom(force=False):
+            raise native_build.NativeBuildError("no toolchain (test)")
+
+        monkeypatch.setattr(native_build, "build_lib", boom)
+        try:
+            assert native.available() is False
+            assert native.load() is None  # memoized failure, no retry
+            if not keys.HAVE_CRYPTOGRAPHY:
+                keys.set_sig_backend(None)
+                assert keys.backend() == "pure-python"
+            # Forcing the absent rung degrades with a warning, not a crash.
+            keys.set_sig_backend("native")
+            tr = _triples(keys.BATCH_MIN, salt="absent")
+            assert keys.verify_batch(tr)
+            assert keys.verify(*tr[0])
+        finally:
+            keys.set_sig_backend(None)
+            monkeypatch.setattr(native, "_LOAD_FAILED", False)
+            monkeypatch.setattr(native, "_LIB", None)
+
+    def test_build_smoke_or_clean_skip(self, tmp_path, monkeypatch):
+        # The CI smoke: on a toolchain host, a cold cache builds a
+        # loadable object; without one, NativeBuildError surfaces and
+        # the test SKIPS instead of failing.
+        import ctypes
+
+        from p1_tpu.hashx import native_build
+
+        monkeypatch.setenv("P1_NATIVE_CACHE", str(tmp_path))
+        try:
+            path = native_build.build_lib()
+        except native_build.NativeBuildError as exc:
+            pytest.skip(f"no C++ toolchain: {exc}")
+        lib = ctypes.CDLL(str(path))
+        lib.p1_ed25519_impl.restype = ctypes.c_char_p
+        assert lib.p1_ed25519_impl()  # both engines in one object
+        assert lib.p1_has_shani() in (0, 1)

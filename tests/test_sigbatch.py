@@ -262,18 +262,21 @@ class TestVerifyBatchDispatch:
         # The pure-Python backend holds the GIL for its whole MSM, so
         # fanning its chunks out to worker threads is pure overhead —
         # fallback batches must run in the calling thread even when
-        # workers > 1 and the batch spans multiple chunks.
-        if keys.HAVE_CRYPTOGRAPHY:
-            pytest.skip("wheel present: pool dispatch is the intended path")
+        # workers > 1 and the batch spans multiple chunks.  The rung is
+        # FORCED (round 15): on a toolchain-equipped host the auto
+        # ladder resolves native, whose chunks rightly DO pool.
         old = keys._workers
         try:
+            keys.set_sig_backend("fallback")
             keys.set_verify_workers(2)
             tr = _triples(16, salt="nopool") * ((keys.BATCH_CHUNK // 16) + 1)
             keys.STATS.reset()
             assert keys.verify_batch(tr)
             assert keys.STATS.pool_dispatches == 0
+            assert keys.STATS.backends["pure-python"] == len(tr)
             assert keys._executor is None  # never even built
         finally:
+            keys.set_sig_backend(None)
             keys.set_verify_workers(old)
             keys.shutdown_verify_pool()
 
@@ -300,15 +303,24 @@ class TestVerifyBatchDispatch:
             keys.shutdown_verify_pool()
 
     def test_fallback_warning_fires_once(self, caplog):
-        if keys.HAVE_CRYPTOGRAPHY:
-            pytest.skip("wheel present: no fallback warning expected")
+        # Forced onto the pure-Python rung (the auto ladder resolves a
+        # faster backend wherever one exists): the one-time cost-model
+        # warning must fire exactly once, name the measured slowdown,
+        # and name the fastest backend story for THIS host.
         keys._fallback_warned = False
-        with caplog.at_level("WARNING", logger="p1_tpu.core.keys"):
-            keys.verify_batch(_triples(keys.BATCH_MIN, salt="warn"))
-            keys.verify_batch(_triples(keys.BATCH_MIN, salt="warn2"))
+        try:
+            keys.set_sig_backend("fallback")
+            with caplog.at_level("WARNING", logger="p1_tpu.core.keys"):
+                keys.verify_batch(_triples(keys.BATCH_MIN, salt="warn"))
+                keys.verify_batch(_triples(keys.BATCH_MIN, salt="warn2"))
+        finally:
+            keys.set_sig_backend(None)
+            keys._fallback_warned = False
         hits = [r for r in caplog.records if "pure-Python Ed25519" in r.message]
         assert len(hits) == 1
-        assert "ms" in hits[0].getMessage()  # names the measured slowdown
+        msg = hits[0].getMessage()
+        assert "ms" in msg  # names the measured slowdown
+        assert "FORCED" in msg  # ...and that this rung was an explicit pin
 
     @pytest.mark.slow
     def test_pool_cancellation_mid_batch(self, monkeypatch):
@@ -494,6 +506,99 @@ class TestCheckBlockEquivalence:
             m.setattr(keys, "BATCH_MIN", 1 << 30)
             serial_err = self._outcome(chain, block, SignatureCache())
         assert batch_err == serial_err == "bad transaction signature"
+
+
+#: Every signature backend THIS host can run for the equivalence
+#: matrix: the pure-Python rung always, the native C++ engine when a
+#: toolchain (or cached build) exists, the wheel when installed.  The
+#: device rung's matrix lives in tests/test_ed25519_device.py (slow —
+#: its jit compile dwarfs the tier-1 budget).
+_MATRIX_BACKENDS = ["fallback"]
+if keys._native_ed25519.available():
+    _MATRIX_BACKENDS.append("native")
+if keys.HAVE_CRYPTOGRAPHY:
+    _MATRIX_BACKENDS.append("cryptography")
+
+
+@pytest.fixture(params=_MATRIX_BACKENDS)
+def each_backend(request):
+    """Pin one backend rung for the duration of a test."""
+    keys.set_sig_backend(request.param)
+    yield request.param
+    keys.set_sig_backend(None)
+
+
+class TestBackendEquivalenceMatrix:
+    """Round-15 satellite: the SAME verdict and the SAME error text on
+    every backend rung, for every input — honest, corrupted at every
+    position, and torsion-crafted.  The serial lane (BATCH_MIN forced
+    high) on the pure-Python rung is the consensus baseline."""
+
+    def _outcome(self, txs, backend):
+        chain = _funded_chain()
+        block = _mine(chain.tip, txs)
+        try:
+            check_block(
+                block,
+                DIFF,
+                chain_tag=chain.genesis.block_hash(),
+                sig_cache=SignatureCache(),
+            )
+            return None
+        except ValidationError as e:
+            return str(e)
+
+    def test_valid_block_accepts_everywhere(self, each_backend):
+        txs = [Transaction.coinbase(account("m"), 4), *_transfers(10)]
+        assert self._outcome(txs, each_backend) is None
+
+    def test_corruption_at_every_position_same_error(
+        self, each_backend, monkeypatch
+    ):
+        txs = _transfers(10)
+        for pos in range(len(txs)):
+            bad_txs = list(txs)
+            bad_txs[pos] = dataclasses.replace(
+                bad_txs[pos],
+                sig=_corrupt((b"", bad_txs[pos].sig, b""), "sig")[1],
+            )
+            block_txs = [Transaction.coinbase(account("m"), 4), *bad_txs]
+            got = self._outcome(block_txs, each_backend)
+            with monkeypatch.context() as m:
+                # serial pure-Python: the consensus baseline
+                m.setattr(keys, "BATCH_MIN", 1 << 30)
+                keys.set_sig_backend("fallback")
+                try:
+                    want = self._outcome(block_txs, "serial")
+                finally:
+                    keys.set_sig_backend(each_backend)
+            assert got == want == "bad transaction signature", (
+                each_backend,
+                pos,
+            )
+
+    def test_torsion_fixtures_same_verdict_and_text(
+        self, each_backend, monkeypatch
+    ):
+        cases = [
+            (_torsion_tx(TAG, cancel=True), None),
+            (_torsion_tx(TAG, cancel=False), "bad transaction signature"),
+        ]
+        for crafted, expected in cases:
+            txs = [*_transfers(keys.BATCH_MIN), crafted]
+            got = self._outcome(txs, each_backend)
+            assert got == expected, (each_backend, expected)
+
+    def test_first_invalid_left_first_on_every_backend(self, each_backend):
+        base = _triples(24, salt="matrix-" + each_backend)
+        tors = _torsion_triple(cancel=True)  # serially valid, gate-rejected
+        mixed = list(base)
+        mixed[2] = tors
+        mixed[20] = _corrupt(mixed[20], "sig")
+        assert not keys.verify_batch(mixed)
+        assert keys.first_invalid(mixed) == 20
+        mixed[20] = base[20]
+        assert keys.first_invalid(mixed) is None
 
 
 class TestPreverify:

@@ -273,6 +273,11 @@ STATUS_KEYS = [
     "txs_accepted",
     "validation",
     "validation.backend",
+    "validation.backends",
+    "validation.backends.cryptography",
+    "validation.backends.device",
+    "validation.backends.native",
+    "validation.backends.pure-python",
     "validation.batched",
     "validation.batches",
     "validation.bytes",
@@ -339,6 +344,34 @@ class TestNodeMetricsCompat:
         assert snap["counters"]["blocks_accepted"] == 7
         assert snap["role"] == "node"
         assert node.status()["blocks_accepted"] == 7  # same storage
+
+    def test_validation_backend_gauges_exported(self):
+        # Round-15 satellite: keys.STATS mirrors into registry gauges on
+        # the export path, one per backend rung, fixed name set — the
+        # GETMETRICS/`p1 metrics`/Prometheus view of the ladder.
+        from p1_tpu.core import keys
+
+        node = _fresh_node()
+        keys.STATS.reset()
+        keys.verify_batch([])  # no work — gauges still materialize
+        snap = node.telemetry_snapshot()
+        for name in (
+            "validation.sigs_serial",
+            "validation.sigs_batched",
+            "validation.sigs_cached",
+            "validation.backend.cryptography",
+            "validation.backend.native",
+            "validation.backend.pure-python",
+            "validation.backend.device",
+        ):
+            assert name in snap["gauges"], name
+        # and the mirror tracks the source of truth
+        keys.STATS.backends["native"] += 3
+        keys.STATS.batched += 3
+        snap = node.telemetry_snapshot()
+        assert snap["gauges"]["validation.backend.native"] == 3
+        assert snap["gauges"]["validation.sigs_batched"] == 3
+        keys.STATS.reset()
 
 
 class TestLogAttribution:
