@@ -407,6 +407,11 @@ def main() -> None:
         )
         extra["lint_granted"] = len(lint.granted)
         extra["lint_rules"] = len(lint.rules)
+        # Round 16: the interprocedural layer's size — node/edge growth
+        # is the leading indicator of wall-time creep (the fixed point
+        # and the per-node summaries are both linear in these).
+        extra["lint_callgraph_nodes"] = lint.callgraph_nodes
+        extra["lint_callgraph_edges"] = lint.callgraph_edges
     except ImportError:
         pass  # installed as a bare package without the analysis tree
 

@@ -116,4 +116,72 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
         # call the multi-core stage split must move off-loop.
     },
     "await-state": {},
+    # -- transitive-blocking (round 16): THE ROADMAP-2 OFFLOAD WORK
+    #    LIST.  Each grant is one call chain, found by the whole-
+    #    package call graph, through which an async def blocks the
+    #    consensus loop today.  The reason names the pipeline stage
+    #    (wire framing → admission → validation → store → relay) the
+    #    multi-core split must move it to.  Removing a grant here
+    #    should mean the chain moved off-loop — not that the lint
+    #    stopped seeing it.
+    "transitive-blocking": {
+        "node/node.py": {
+            "Node._handle_block->ctypes.CDLL": "VALIDATE stage: "
+            "check_block's batched Ed25519 (native engine behind the "
+            "ctypes seam) runs on the loop — the split's worker-pool "
+            "stage; the PR-5 verify pool only covers the wheel backend",
+            "Node._handle_block->open": "STORE stage: _store_append → "
+            "ChainStore.append fsyncs the accepted block on the loop — "
+            "the durability barrier the split moves to a store worker",
+            "Node._dispatch->ctypes.CDLL": "VALIDATE stage: deep-sync "
+            "BLOCKS batches preverify signatures (native seam) inline "
+            "in the dispatcher — same worker-pool stage as "
+            "_handle_block's verify",
+            "Node._dispatch->os.fsync": "STORE stage: the BLOCKS "
+            "batch-sync path syncs the store inline after a quiesced "
+            "catch-up episode",
+            "Node.start->open": "startup-only: the resume path opens/"
+            "locks/replays the store before the node serves a single "
+            "frame — no session exists to stall; stays on-loop by "
+            "design",
+            "Node.stop->open": "shutdown-only: the final store flush "
+            "runs after serving stopped; a worker would just add a "
+            "join",
+            "Node._store_recovery_loop->open": "STORE stage: degraded-"
+            "mode disk retries flush pending records on the loop; the "
+            "split gives the store worker the retry queue",
+            "Node._store_recovery_loop->os.fsync": "STORE stage: the "
+            "recovery probe's explicit sync — same store worker as the "
+            "flush",
+            "Node._adopt_snapshot->open": "snapshot adoption writes "
+            "the .snapshot sidecar inline — rare (once per IBD), but "
+            "the split's store worker should own sidecar IO too",
+            "Node._snapshot_flip->open": "snapshot flip rewrites the "
+            "store genesis-first on the loop — the heaviest single "
+            "blocking window in the node (~seconds at 100k); a "
+            "flagship ROADMAP-2 offload",
+            "Node._snapshot_diverged->open": "divergence quarantines "
+            "the sidecar and rewrites the store on the loop — same "
+            "store-worker offload as the flip path",
+        },
+    },
+    # -- escaped-state (round 16): await-state folded one call level.
+    "escaped-state": {
+        "node/node.py": {
+            "chain": "_handle_snapshot: the flagged pre-await read of "
+            "self.chain sits in early-returning branches "
+            "(_request_blocks fallbacks), and the post-await writer "
+            "(_adopt_snapshot) RE-VALIDATES after the scheduling "
+            "point — validation_state, _bg_chain, and snapshot-vs-"
+            "height are all re-read before the install, the safe "
+            "shape the rule's docstring names",
+        },
+    },
+    "wire-contract": {
+        # EMPTY and should stay so: a grant here would bless a frame
+        # type with a hole in its encoder/decoder/dispatch/admission/
+        # shed/version contract.  The only legitimate tenant is a
+        # frame mid-introduction across a stacked PR, removed when the
+        # second half lands.
+    },
 }

@@ -32,7 +32,15 @@ def register(cls: type["Rule"]) -> type["Rule"]:
 class Rule:
     """One structural invariant.  Subclasses set the class attributes
     and implement ``check``; the engine handles scoping, allowlists,
-    and stale-grant accounting uniformly."""
+    and stale-grant accounting uniformly.
+
+    Two kinds of rule share this protocol (round 16): a *per-file*
+    rule implements ``check`` and sees one parsed module at a time; a
+    *package* rule sets ``package_rule = True``, implements
+    ``check_package``, and sees the whole-package index (every tree
+    plus the call graph) ONCE per run — the interprocedural rules
+    (transitive-blocking, escaped-state, wire-contract) live there.
+    Findings from both settle against the allowlist identically."""
 
     #: Registry/allowlist/CLI name, kebab-case ("wall-clock").
     name: str = ""
@@ -41,11 +49,18 @@ class Rule:
     #: Path prefixes (POSIX, relative to p1_tpu/) the rule covers.
     #: Empty tuple = the whole package.
     scope: tuple[str, ...] = ()
+    #: True = the rule runs once over the PackageIndex, not per file.
+    package_rule: bool = False
 
     def applies_to(self, rel: str) -> bool:
         return not self.scope or rel.startswith(self.scope)
 
     def check(self, tree: ast.Module, rel: str) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def check_package(self, pkg) -> Iterator[Finding]:
+        """Package rules override this; ``pkg`` is the engine's
+        PackageIndex (``.trees``, ``.graph``)."""
         raise NotImplementedError
 
     def finding(self, rel: str, node: ast.AST, detail: str, key: str) -> Finding:
@@ -133,3 +148,46 @@ def walk_no_nested_defs(node: ast.AST) -> Iterator[ast.AST]:
 
 def sort_key(node: ast.AST) -> tuple[int, int]:
     return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+_SET_METHODS = frozenset(
+    {"difference", "union", "intersection", "symmetric_difference"}
+)
+_SET_OPS = (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)
+
+
+def is_set_expr(node: ast.AST) -> bool:
+    """True when ``node`` is structurally a set: a literal/comprehension,
+    a ``set()``/``frozenset()`` call, a set-method call, or a set
+    operator over such operands (or ``.keys()`` views — the "dict-keys
+    difference" shape).  Shared by the set-iteration rule and the call
+    graph's local-binding summaries so the direct and one-hop layers
+    agree on what a set is."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        dotted = dotted_name(node.func)
+        if dotted in ("set", "frozenset"):
+            return True
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SET_METHODS
+        ):
+            return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, _SET_OPS):
+        return (
+            is_set_expr(node.left)
+            or is_set_expr(node.right)
+            or is_keys_view(node.left)
+            or is_keys_view(node.right)
+        )
+    return False
+
+
+def is_keys_view(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "keys"
+        and not node.args
+    )

@@ -2,7 +2,11 @@
 
 One parse per file, every in-scope rule over the shared tree (the
 "multi-pass" is rule passes, not re-parses — the whole-package run
-stays well under the ~5 s tier-1 budget on a 1-vCPU host).
+stays well under the ~5 s tier-1 budget on a 1-vCPU host).  Since
+round 16 the parsed trees are kept for the run as a ``PackageIndex``
+and a whole-package ``CallGraph`` is built over them once: *package
+rules* (``Rule.package_rule``) see that index and check
+interprocedural invariants no single tree can express.
 
 Settlement semantics (both directions enforced, both inherited from the
 original wall-clock lint):
@@ -13,16 +17,26 @@ original wall-clock lint):
 - a grant no finding consumed is *stale* and reported as a violation in
   its own right: an allowlist entry that outlives its construct is a
   blanket permission waiting for the next regression to hide under.
+
+Scoped runs (``p1 lint --path``): ``paths`` narrows which files'
+findings are REPORTED, but the analysis itself always covers the
+whole package — the call graph is interprocedural, so a partial parse
+would silently weaken every package rule — and settlement stays
+global: grant consumption is computed from ALL findings and stale
+grants anywhere still fail, so a scoped run can narrow what you look
+at without hiding a rotting grant.
 """
 
 from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
+from functools import cached_property
 from pathlib import Path
 from typing import Iterable, Iterator
 
 from p1_tpu.analysis.base import RULES, Rule
+from p1_tpu.analysis.callgraph import CallGraph
 from p1_tpu.analysis.findings import Finding
 
 #: The analyzed package root (p1_tpu/).
@@ -39,6 +53,19 @@ def package_files(root: Path = PKG_ROOT) -> Iterator[tuple[str, Path]]:
 
 
 @dataclass
+class PackageIndex:
+    """The parsed package a run shares across rules: rel -> tree, plus
+    the call graph built lazily on first package-rule access (a
+    per-file-only run never pays for it)."""
+
+    trees: dict[str, ast.Module]
+
+    @cached_property
+    def graph(self) -> CallGraph:
+        return CallGraph(self.trees)
+
+
+@dataclass
 class Report:
     """One analysis run.  ``clean`` is the tier-1 gate: no unallowlisted
     findings AND no stale grants."""
@@ -50,6 +77,12 @@ class Report:
     parse_errors: list[str] = field(default_factory=list)
     files: int = 0
     rules: list[str] = field(default_factory=list)
+    #: call-graph size when a package rule ran (bench.py emits these so
+    #: analysis-cost creep is visible round over round); 0 = not built.
+    callgraph_nodes: int = 0
+    callgraph_edges: int = 0
+    #: the --path scope of this run, empty = whole package.
+    scoped_to: list[str] = field(default_factory=list)
 
     @property
     def clean(self) -> bool:
@@ -64,42 +97,78 @@ class Report:
             "granted": [vars(f) for f in self.granted],
             "stale": self.stale,
             "parse_errors": self.parse_errors,
+            "callgraph_nodes": self.callgraph_nodes,
+            "callgraph_edges": self.callgraph_edges,
+            "scoped_to": self.scoped_to,
         }
+
+
+def _in_scope(rel: str, paths: list[str] | None) -> bool:
+    if not paths:
+        return True
+    return any(
+        rel == p or (p.endswith("/") and rel.startswith(p)) for p in paths
+    )
 
 
 def run_analysis(
     root: Path = PKG_ROOT,
     rules: Iterable[Rule] | None = None,
     grants: dict[str, dict[str, dict[str, str]]] | None = None,
+    paths: list[str] | None = None,
 ) -> Report:
     """Run ``rules`` (default: the full registry) over every module
     under ``root`` and settle against ``grants`` (default: the audited
-    allowlist in p1_tpu/analysis/allowlist.py)."""
+    allowlist in p1_tpu/analysis/allowlist.py).  ``paths`` (package-
+    relative files like "node/node.py" or dir prefixes like "node/")
+    scopes which files' findings are reported — see the module
+    docstring for what stays global."""
     if grants is None:
         from p1_tpu.analysis.allowlist import GRANTS
 
         grants = GRANTS
     active = list(RULES.values()) if rules is None else list(rules)
-    report = Report(rules=[r.name for r in active])
+    report = Report(
+        rules=[r.name for r in active], scoped_to=sorted(paths or [])
+    )
     used: set[tuple[str, str, str]] = set()
 
+    trees: dict[str, ast.Module] = {}
     for rel, path in package_files(root):
         report.files += 1
         try:
-            tree = ast.parse(path.read_bytes(), filename=rel)
+            trees[rel] = ast.parse(path.read_bytes(), filename=rel)
         except SyntaxError as e:  # a file ast can't read is a finding, not a skip
             report.parse_errors.append(f"{rel}: {e.msg} (line {e.lineno})")
+    pkg = PackageIndex(trees=trees)
+
+    def settle(f: Finding) -> None:
+        # Grant consumption is GLOBAL: every finding — in scope or not —
+        # marks its grant used, so a scoped run settles the stale-grant
+        # direction exactly like a full run.  Only the REPORTED lists
+        # (findings/violations/granted) honor the scope.
+        granted = f.key in grants.get(f.rule, {}).get(f.file, {})
+        if granted:
+            used.add((f.rule, f.file, f.key))
+        if not _in_scope(f.file, paths):
+            return
+        report.findings.append(f)
+        (report.granted if granted else report.violations).append(f)
+
+    for rule in active:
+        if rule.package_rule:
+            for f in rule.check_package(pkg):
+                settle(f)
             continue
-        for rule in active:
+        for rel, tree in trees.items():
             if not rule.applies_to(rel):
                 continue
             for f in rule.check(tree, rel):
-                report.findings.append(f)
-                if f.key in grants.get(f.rule, {}).get(f.file, {}):
-                    used.add((f.rule, f.file, f.key))
-                    report.granted.append(f)
-                else:
-                    report.violations.append(f)
+                settle(f)
+
+    if any(r.package_rule for r in active):
+        report.callgraph_nodes = len(pkg.graph.nodes)
+        report.callgraph_edges = pkg.graph.edges
 
     active_names = {r.name for r in active}
     known = {rel for rel, _ in package_files(root)}

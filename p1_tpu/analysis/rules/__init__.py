@@ -6,8 +6,11 @@ how to grant an exception."""
 from p1_tpu.analysis.rules import (  # noqa: F401  (registration side effect)
     awaitstate,
     blocking,
+    escstate,
     losttask,
     rng,
     setiter,
+    transblock,
     wallclock,
+    wirecontract,
 )

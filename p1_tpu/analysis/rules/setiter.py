@@ -16,13 +16,22 @@ over an expression that is structurally a set:
 - a binary set operation (``-``/``|``/``&``/``^``) with such an
   operand, or with a ``.keys()`` view operand (the "dict-keys
   difference" shape: ``d.keys() - seen``);
-- a ``.difference/.union/.intersection/.symmetric_difference`` call.
+- a ``.difference/.union/.intersection/.symmetric_difference`` call;
+- since round 16, ONE dataflow hop: a bare local name every binding
+  of which in the enclosing function is structurally a set
+  (``pending = set(); ... for p in pending``) — the "through a
+  variable" residue the round-13 docs conceded, closed with the call
+  graph's local-binding summary (analysis/callgraph.py
+  ``local_set_bindings``).  A single non-set rebinding (``pending =
+  sorted(pending)``) takes the name out of the set class, so the
+  normalize-then-iterate idiom stays clean.
 
 Not flagged: ``sorted(set(...))`` (the sort normalizes the order —
 and structurally the loop iterates the ``sorted`` call, not the set);
 membership tests; iteration over a plain ``dict``/``.keys()`` view
 (insertion-ordered by language guarantee); sets reaching the loop
-through a variable (type inference is out of scope — the fixture
+through parameters, attributes, or across function boundaries (type
+inference beyond one local hop stays out of scope — the fixture
 corpus and review carry that residue).
 """
 
@@ -31,41 +40,14 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from p1_tpu.analysis.base import Rule, dotted_name, register
-from p1_tpu.analysis.findings import Finding
-
-_SET_METHODS = frozenset(
-    {"difference", "union", "intersection", "symmetric_difference"}
+from p1_tpu.analysis.base import (
+    Rule,
+    is_set_expr,
+    register,
+    walk_no_nested_defs,
 )
-_SET_OPS = (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)
-
-
-def _is_set_expr(node: ast.AST) -> bool:
-    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
-        return True
-    if isinstance(node, ast.Call):
-        dotted = dotted_name(node.func)
-        if dotted in ("set", "frozenset"):
-            return True
-        if (
-            isinstance(node.func, ast.Attribute)
-            and node.func.attr in _SET_METHODS
-        ):
-            return True
-    if isinstance(node, ast.BinOp) and isinstance(node.op, _SET_OPS):
-        return _is_set_expr(node.left) or _is_set_expr(node.right) or (
-            _is_keys_view(node.left) or _is_keys_view(node.right)
-        )
-    return False
-
-
-def _is_keys_view(node: ast.AST) -> bool:
-    return (
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Attribute)
-        and node.func.attr == "keys"
-        and not node.args
-    )
+from p1_tpu.analysis.callgraph import local_set_bindings
+from p1_tpu.analysis.findings import Finding
 
 
 @register
@@ -76,21 +58,46 @@ class SetIterationRule(Rule):
     scope = ("node/", "chain/", "mempool/")
 
     def check(self, tree: ast.Module, rel: str) -> Iterator[Finding]:
-        for node in ast.walk(tree):
-            iters: list[ast.AST] = []
-            if isinstance(node, (ast.For, ast.AsyncFor)):
-                iters.append(node.iter)
-            elif isinstance(
-                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
-            ):
-                iters.extend(gen.iter for gen in node.generators)
-            for it in iters:
-                if _is_set_expr(it):
-                    yield self.finding(
-                        rel,
-                        it,
-                        "iterating an unordered set expression — sort it, "
-                        "or keep insertion order with dict[key, None] "
-                        "(the round-7 trace-determinism fix)",
-                        "set-expr",
-                    )
+        # module scope + every function scope, each with its own
+        # local-binding summary (names are function-local facts).
+        scopes: list[ast.AST] = [tree]
+        scopes.extend(
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        for scope in scopes:
+            set_locals = local_set_bindings(scope)
+            for node in walk_no_nested_defs(scope):
+                iters: list[ast.AST] = []
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append(node.iter)
+                elif isinstance(
+                    node,
+                    (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+                ):
+                    iters.extend(gen.iter for gen in node.generators)
+                for it in iters:
+                    if is_set_expr(it):
+                        yield self.finding(
+                            rel,
+                            it,
+                            "iterating an unordered set expression — sort "
+                            "it, or keep insertion order with "
+                            "dict[key, None] (the round-7 "
+                            "trace-determinism fix)",
+                            "set-expr",
+                        )
+                    elif (
+                        isinstance(it, ast.Name) and it.id in set_locals
+                    ):
+                        yield self.finding(
+                            rel,
+                            it,
+                            f"iterating {it.id!r}, a local bound only to "
+                            "set expressions in this scope — sort it "
+                            "(or normalize with sorted() before the "
+                            "loop); unordered iteration is the round-7 "
+                            "trace-divergence class one variable away",
+                            "set-local",
+                        )

@@ -766,6 +766,17 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="also list allowlisted findings with their grant reasons",
     )
+    p.add_argument(
+        "--path",
+        action="append",
+        default=None,
+        metavar="FILE_OR_DIR",
+        help="report only findings under this file/directory (repeatable; "
+        "package-relative like node/node.py, or a real path).  The "
+        "analysis still runs whole-package and settlement stays global, "
+        "so a scoped run can't hide a stale grant — this narrows what "
+        "you LOOK at for fast pre-commit loops",
+    )
     return parser
 
 
@@ -1878,9 +1889,12 @@ def cmd_lint(args) -> int:
 
     Exit-code contract (tests/test_cli.py pins it): 0 = every rule
     clean (no unallowlisted findings, no stale grants), 1 = violations,
-    2 = usage (argparse errors and unknown --rule names)."""
+    2 = usage (argparse errors, unknown --rule names, bad --path)."""
+    from pathlib import Path
+
     from p1_tpu.analysis import RULES, run_analysis
     from p1_tpu.analysis.allowlist import GRANTS
+    from p1_tpu.analysis.engine import PKG_ROOT
 
     if args.rule:
         unknown = [r for r in args.rule if r not in RULES]
@@ -1895,7 +1909,34 @@ def cmd_lint(args) -> int:
     else:
         rules = None
 
-    report = run_analysis(rules=rules)
+    paths = None
+    if args.path:
+        paths = []
+        for raw in args.path:
+            # package-relative spellings first (the common pre-commit
+            # case: `p1 lint --path node/node.py` from anywhere), then
+            # real filesystem paths.
+            p = (PKG_ROOT / raw).resolve()
+            if not p.exists():
+                p = Path(raw).resolve()
+            if not p.exists():
+                print(f"p1 lint: no such path: {raw}", file=sys.stderr)
+                return 2
+            try:
+                rel = p.relative_to(PKG_ROOT).as_posix()
+            except ValueError:
+                print(
+                    f"p1 lint: {raw} is outside the analyzed package "
+                    f"({PKG_ROOT})",
+                    file=sys.stderr,
+                )
+                return 2
+            if rel == ".":
+                continue  # the whole package: no constraint
+            paths.append(rel + "/" if p.is_dir() else rel)
+        paths = paths or None
+
+    report = run_analysis(rules=rules, paths=paths)
     if args.as_json:
         print(json.dumps(report.to_json()))
     else:
@@ -1909,11 +1950,16 @@ def cmd_lint(args) -> int:
             for f in report.granted:
                 reason = GRANTS[f.rule][f.file][f.key]
                 print(f"granted: {f}  [{reason}]")
+        scoped = (
+            f", scoped to {', '.join(report.scoped_to)}"
+            if report.scoped_to
+            else ""
+        )
         print(
             f"p1 lint: {report.files} files, {len(report.rules)} rules, "
             f"{len(report.violations)} violation(s), "
             f"{len(report.granted)} granted, {len(report.stale)} stale "
-            f"grant(s)"
+            f"grant(s){scoped}"
         )
     return 0 if report.clean else 1
 

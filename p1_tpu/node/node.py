@@ -175,6 +175,41 @@ _MSG_CLASS = {
     MsgType.GETMETRICS: CLASS_QUERIES,
 }
 
+#: The OTHER half of the admission contract, spelled out: frames the
+#: governor deliberately never charges.  Reply frames (we asked; a
+#: budget here would let a slow disk starve our own IBD), the
+#: handshake, liveness probes (never rationed), and ADDR, which keeps
+#: its dedicated per-host address-book budget instead of a token
+#: class.  Every MsgType must appear in exactly one of _MSG_CLASS /
+#: _ADMISSION_EXEMPT — the import-time assert below and the
+#: wire-contract lint rule both fail a frame type that rides free
+#: because somebody FORGOT to classify it (the historical shape:
+#: rounds 9–12 each added frames, and an unclassified frame is
+#: invisibly maximally permissive).
+_ADMISSION_EXEMPT = frozenset(
+    {
+        MsgType.HELLO,
+        MsgType.BLOCKS,
+        MsgType.MEMPOOL,
+        MsgType.ACCOUNT,
+        MsgType.PROOF,
+        MsgType.BLOCKTXN,
+        MsgType.HEADERS,
+        MsgType.FEES,
+        MsgType.ADDR,
+        MsgType.PING,
+        MsgType.PONG,
+        MsgType.STATUS,
+        MsgType.METRICS,
+        MsgType.FILTERS,
+        MsgType.SNAPSHOT,
+    }
+)
+assert (
+    set(_MSG_CLASS) | _ADMISSION_EXEMPT == set(MsgType)
+    and not set(_MSG_CLASS) & _ADMISSION_EXEMPT
+), "every frame type needs exactly one admission classification"
+
 #: Frames dropped while the node is in the SHED overload state.
 #: Consensus-critical service — block ingest, headers/blocks/proof
 #: serving, liveness, the status probe — stays up; the pool and the
@@ -199,6 +234,44 @@ _SHED_DROPS = frozenset(
         MsgType.GETMETRICS,
     }
 )
+
+#: The keep side, spelled out frame by frame: consensus-critical
+#: service (block ingest and the sync/relay frames), solicited replies
+#: (dropping a reply we asked for would wedge our own supervisors),
+#: liveness, and the GETSTATUS health probe — overload must stay
+#: observable while it is happening.  Every MsgType must appear in
+#: exactly one of _SHED_DROPS / _SHED_KEEPS; the assert and the
+#: wire-contract lint rule close the "new frame forgot its SHED
+#: classification" hole structurally.
+_SHED_KEEPS = frozenset(
+    {
+        MsgType.HELLO,
+        MsgType.BLOCK,
+        MsgType.CBLOCK,
+        MsgType.GETBLOCKS,
+        MsgType.BLOCKS,
+        MsgType.GETBLOCKTXN,
+        MsgType.BLOCKTXN,
+        MsgType.GETHEADERS,
+        MsgType.HEADERS,
+        MsgType.GETPROOF,
+        MsgType.PROOF,
+        MsgType.GETFILTERS,
+        MsgType.FILTERS,
+        MsgType.ACCOUNT,
+        MsgType.FEES,
+        MsgType.SNAPSHOT,
+        MsgType.PING,
+        MsgType.PONG,
+        MsgType.GETSTATUS,
+        MsgType.STATUS,
+        MsgType.METRICS,
+    }
+)
+assert (
+    _SHED_DROPS | _SHED_KEEPS == set(MsgType)
+    and not _SHED_DROPS & _SHED_KEEPS
+), "every frame type needs exactly one SHED classification"
 
 
 #: NodeMetrics counter fields, in their historical (dataclass) order.

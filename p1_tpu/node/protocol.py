@@ -206,6 +206,14 @@ _HELLO = struct.Struct(">B32sIHQ")
 
 
 class MsgType(enum.IntEnum):
+    """One byte after the length prefix.  Every member must thread the
+    whole wire contract — encoder, ``_decode`` arm, ``_dispatch`` arm,
+    admission class (node.py ``_MSG_CLASS``/``_ADMISSION_EXEMPT``),
+    SHED classification (``_SHED_DROPS``/``_SHED_KEEPS``), and a
+    ``MSG_SINCE`` version row — enforced structurally by the
+    ``wire-contract`` lint rule and at import by the asserts beside
+    each table."""
+
     HELLO = 1
     BLOCK = 2
     TX = 3
@@ -236,6 +244,52 @@ class MsgType(enum.IntEnum):
     SNAPSHOT = 28
     GETMETRICS = 29
     METRICS = 30
+
+
+#: The wire version that introduced each frame type — the version-gate
+#: half of the wire contract.  HELLO enforces strict version equality,
+#: so this table is not a negotiation surface; it is the AUDITABLE
+#: history the module docstring used to carry only in prose, and the
+#: ``wire-contract`` lint rule fails any member without a row (or any
+#: row claiming a version newer than ``PROTOCOL_VERSION`` — a frame
+#: cannot ship ahead of its version bump).
+MSG_SINCE: dict[MsgType, int] = {
+    # the v1/v2 baseline surface (round 3's unversioned protocol,
+    # retroactively v1; BLOCK's telemetry stamp and the tx field
+    # extensions were the v2 layout change)
+    MsgType.HELLO: 1,
+    MsgType.BLOCK: 1,
+    MsgType.TX: 1,
+    MsgType.GETBLOCKS: 1,
+    MsgType.BLOCKS: 1,
+    MsgType.GETMEMPOOL: 1,
+    MsgType.MEMPOOL: 1,
+    MsgType.GETACCOUNT: 1,
+    MsgType.ACCOUNT: 1,
+    MsgType.GETPROOF: 3,
+    MsgType.PROOF: 3,
+    MsgType.CBLOCK: 4,
+    MsgType.GETBLOCKTXN: 4,
+    MsgType.BLOCKTXN: 4,
+    MsgType.GETHEADERS: 5,
+    MsgType.HEADERS: 5,
+    MsgType.GETADDR: 6,
+    MsgType.ADDR: 6,
+    MsgType.GETFEES: 7,
+    MsgType.FEES: 7,
+    MsgType.PING: 8,
+    MsgType.PONG: 8,
+    MsgType.GETSTATUS: 9,
+    MsgType.STATUS: 9,
+    MsgType.GETFILTERS: 10,
+    MsgType.FILTERS: 10,
+    MsgType.GETSNAPSHOT: 11,
+    MsgType.SNAPSHOT: 11,
+    MsgType.GETMETRICS: 12,
+    MsgType.METRICS: 12,
+}
+assert set(MSG_SINCE) == set(MsgType), "every frame type needs a version row"
+assert all(1 <= v <= PROTOCOL_VERSION for v in MSG_SINCE.values())
 
 
 @dataclasses.dataclass(frozen=True)

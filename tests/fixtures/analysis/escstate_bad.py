@@ -1,0 +1,30 @@
+"""BAD fixture: consensus read/write routed through helpers across an
+await.
+
+The shape ``await-state`` concedes in docs/LINT.md: hide either
+endpoint one method call down and the lexical rule goes blind, though
+the interleaving hazard is identical — the helper just holds the
+stale value one frame lower.  Reproduces the helper-routed chain
+write the snapshot-adoption path made real in round 12.
+"""
+
+
+class Node:
+    def _read_tip(self):
+        return self.chain
+
+    def _install(self, chain):
+        self.chain = chain
+
+    def _pool_rows(self):
+        return self.mempool.snapshot()
+
+    async def resume(self):
+        tip = self._read_tip()
+        blocks = await self.load(tip)
+        self._install(blocks)  # LINT
+
+    async def swap_pool(self):
+        rows = self._pool_rows()
+        packed = await self.encode(rows)
+        self.mempool = self.unpack(packed)  # LINT

@@ -1,0 +1,26 @@
+"""GOOD fixture: helpers touch consensus state, but never with an
+await inside the read→write window.
+
+Same helpers as the bad fixture — the rule flags the INTERLEAVING,
+not the helpers: reads re-taken after the scheduling point and
+write-before-await windows are the safe shapes the grant text points
+fixes at.
+"""
+
+
+class Node:
+    def _read_tip(self):
+        return self.chain
+
+    def _install(self, chain):
+        self.chain = chain
+
+    async def resume(self):
+        blocks = await self.load()
+        tip = self._read_tip()  # re-read AFTER the await: fresh world
+        self._install(self.merge(tip, blocks))
+
+    async def rebuild(self):
+        tip = self._read_tip()
+        self._install(tip)  # same tick as the read — no window
+        await self.announce()

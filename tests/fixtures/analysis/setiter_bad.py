@@ -30,3 +30,22 @@ def union_scan(a, b):
 def trimmed(peers, banned):
     for p in frozenset(peers).difference(banned):  # LINT
         yield p
+
+
+def gather(peers, extra):
+    # the round-16 one-hop upgrade: a LOCAL bound only to set
+    # expressions and then iterated — the "through a variable" residue
+    # the round-13 docs conceded
+    pending = set(peers)
+    pending = pending | set(extra)
+    for p in pending:  # LINT
+        yield p
+
+
+def spray(book):
+    hot = {k for k in book if book[k]}
+    return [send(p) for p in hot]  # LINT
+
+
+def send(p):
+    return p

@@ -21,3 +21,18 @@ def membership(want, have):
     # sets for MEMBERSHIP are fine — only iteration leaks the order
     have_set = set(have)
     return [h for h in want if h not in have_set]
+
+
+def normalized(peers):
+    # a non-set rebinding takes the local out of the set class: the
+    # normalize-then-iterate idiom stays clean under the one-hop rule
+    pending = set(peers)
+    pending = sorted(pending)
+    for p in pending:
+        yield p
+
+
+def parameter(pending):
+    # parameters are never classified (no structural evidence)
+    for p in pending:
+        yield p

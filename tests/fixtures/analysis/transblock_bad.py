@@ -1,0 +1,42 @@
+"""BAD fixture: the loop stalled through sync helpers.
+
+The incident shape the lexical ``blocking-in-async`` rule cannot see:
+the fsync lives one (or three) sync helpers below a spotless-looking
+``async def`` — the store-append chain every round-3/round-8 outage
+postmortem walked by hand.  The call graph resolves ``self.store``
+through the class's constructor binding and follows the chain to the
+primitive.
+"""
+
+import os
+import time
+
+
+def _write_record(fh, data):
+    fh.write(data)
+    os.fsync(fh.fileno())
+
+
+def _persist(path, data):
+    fh = open(path, "wb")
+    _write_record(fh, data)
+
+
+class Store:
+    def append(self, data):
+        _persist("chain.dat", data)
+
+
+def _sleep_helper():
+    time.sleep(1.0)
+
+
+class Node:
+    def __init__(self):
+        self.store = Store()
+
+    async def handle_block(self, block):
+        self.store.append(block)  # LINT
+
+    async def pause(self):
+        _sleep_helper()  # LINT

@@ -1,0 +1,37 @@
+"""GOOD fixture: blocking chains exist but never run on the loop.
+
+The house off-load pattern: the blocking helper is PASSED to
+``asyncio.to_thread`` (no call edge — its body runs off-loop), and
+purely-async chains cross as many helpers as they like.  A DIRECT
+blocking call inside an ``async def`` is deliberately absent from
+this rule's findings too — that is ``blocking-in-async``'s domain
+(zero hops); this rule owns the ≥1-hop chains.
+"""
+
+import asyncio
+import os
+import time
+
+
+def _write_record(fh, data):
+    fh.write(data)
+    os.fsync(fh.fileno())
+
+
+def _persist(path, data):
+    with open(path, "wb") as fh:
+        _write_record(fh, data)
+
+
+class Node:
+    async def checkpoint(self, data):
+        await asyncio.to_thread(_persist, "chain.dat", data)
+
+    async def nap(self):
+        time.sleep(0.0)  # blocking-in-async's finding, not this rule's
+
+    async def relay(self, frame):
+        await self._send(frame)
+
+    async def _send(self, frame):
+        await asyncio.sleep(0)

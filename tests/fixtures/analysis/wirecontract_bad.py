@@ -1,0 +1,82 @@
+"""BAD fixture: a wire surface with classification holes.
+
+The incident shape: a frame type lands with its encoder, decoder, and
+dispatch arm (it WORKS, so review moves on) but misses one registry —
+and an unclassified frame silently rides the most permissive default
+(uncharged by admission, never shed, no version row).  Three holes,
+one per member, each anchored at the member's enum line:
+
+- BLOCK is in neither ``_SHED_DROPS`` nor ``_SHED_KEEPS`` — the
+  negative control the acceptance criteria name (key ``BLOCK:shed``);
+- TX has no ``_dispatch`` arm (key ``TX:dispatch``);
+- STATUS has no ``MSG_SINCE`` version row (key ``STATUS:version``).
+"""
+
+import enum
+
+PROTOCOL_VERSION = 9
+
+
+class MsgType(enum.IntEnum):
+    HELLO = 1
+    BLOCK = 2  # LINT
+    TX = 3  # LINT
+    STATUS = 4  # LINT
+
+
+def encode_hello(h):
+    return bytes([MsgType.HELLO]) + h
+
+
+def encode_block(b):
+    return bytes([MsgType.BLOCK]) + b
+
+
+def encode_tx(t):
+    return bytes([MsgType.TX]) + t
+
+
+def encode_status(s):
+    return bytes([MsgType.STATUS]) + s
+
+
+def _decode(payload):
+    mtype = MsgType(payload[0])
+    if mtype is MsgType.HELLO:
+        return mtype, payload[1:]
+    if mtype is MsgType.BLOCK:
+        return mtype, payload[1:]
+    if mtype is MsgType.TX:
+        return mtype, payload[1:]
+    if mtype is MsgType.STATUS:
+        return mtype, payload[1:]
+    raise ValueError("unknown message type")
+
+
+_MSG_CLASS = {
+    MsgType.BLOCK: "blocks",
+    MsgType.TX: "txs",
+}
+
+_ADMISSION_EXEMPT = frozenset({MsgType.HELLO, MsgType.STATUS})
+
+_SHED_DROPS = frozenset({MsgType.TX})
+
+_SHED_KEEPS = frozenset({MsgType.HELLO, MsgType.STATUS})
+
+MSG_SINCE = {
+    MsgType.HELLO: 1,
+    MsgType.BLOCK: 1,
+    MsgType.TX: 2,
+}
+
+
+class Node:
+    async def _dispatch(self, peer, payload):
+        mtype, body = _decode(payload)
+        if mtype is MsgType.BLOCK:
+            await self.handle_block(body)
+        elif mtype is MsgType.STATUS:
+            await self.handle_status(body)
+        elif mtype is MsgType.HELLO:
+            raise ValueError("unexpected HELLO")

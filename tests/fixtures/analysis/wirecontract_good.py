@@ -1,0 +1,79 @@
+"""GOOD fixture: the same mini wire surface, exhaustively classified.
+
+Every member threads all six registries — encoder, ``_decode`` arm,
+``_dispatch`` arm, exactly one admission classification, exactly one
+SHED classification, and a ``MSG_SINCE`` row at or below
+``PROTOCOL_VERSION``.
+"""
+
+import enum
+
+PROTOCOL_VERSION = 9
+
+
+class MsgType(enum.IntEnum):
+    HELLO = 1
+    BLOCK = 2
+    TX = 3
+    STATUS = 4
+
+
+def encode_hello(h):
+    return bytes([MsgType.HELLO]) + h
+
+
+def encode_block(b):
+    return bytes([MsgType.BLOCK]) + b
+
+
+def encode_tx(t):
+    return bytes([MsgType.TX]) + t
+
+
+def encode_status(s):
+    return bytes([MsgType.STATUS]) + s
+
+
+def _decode(payload):
+    mtype = MsgType(payload[0])
+    if mtype is MsgType.HELLO:
+        return mtype, payload[1:]
+    if mtype is MsgType.BLOCK:
+        return mtype, payload[1:]
+    if mtype is MsgType.TX:
+        return mtype, payload[1:]
+    if mtype is MsgType.STATUS:
+        return mtype, payload[1:]
+    raise ValueError("unknown message type")
+
+
+_MSG_CLASS = {
+    MsgType.BLOCK: "blocks",
+    MsgType.TX: "txs",
+}
+
+_ADMISSION_EXEMPT = frozenset({MsgType.HELLO, MsgType.STATUS})
+
+_SHED_DROPS = frozenset({MsgType.TX})
+
+_SHED_KEEPS = frozenset({MsgType.HELLO, MsgType.BLOCK, MsgType.STATUS})
+
+MSG_SINCE = {
+    MsgType.HELLO: 1,
+    MsgType.BLOCK: 1,
+    MsgType.TX: 2,
+    MsgType.STATUS: 9,
+}
+
+
+class Node:
+    async def _dispatch(self, peer, payload):
+        mtype, body = _decode(payload)
+        if mtype is MsgType.BLOCK:
+            await self.handle_block(body)
+        elif mtype is MsgType.TX:
+            await self.handle_tx(body)
+        elif mtype is MsgType.STATUS:
+            await self.handle_status(body)
+        elif mtype is MsgType.HELLO:
+            raise ValueError("unexpected HELLO")

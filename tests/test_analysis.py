@@ -27,7 +27,7 @@ from pathlib import Path
 import pytest
 
 from p1_tpu.analysis import RULES, run_analysis
-from p1_tpu.analysis.engine import PKG_ROOT
+from p1_tpu.analysis.engine import PKG_ROOT, PackageIndex
 
 FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
 
@@ -39,14 +39,23 @@ _RULE_FIXTURES = {
     "set-iteration": "setiter",
     "blocking-in-async": "blocking",
     "await-state": "awaitstate",
+    "transitive-blocking": "transblock",
+    "escaped-state": "escstate",
+    "wire-contract": "wirecontract",
 }
 
 
 def _rule_findings(rule_name: str, path: Path):
     """Run ONE rule over a fixture, under a rel path inside every
-    rule's scope (the fixture corpus tests rule logic, not scoping)."""
+    rule's scope (the fixture corpus tests rule logic, not scoping).
+    Package rules see the fixture as a one-file package index — the
+    same interface the engine hands them, so corpus assertions cover
+    the real entry point."""
     tree = ast.parse(path.read_bytes(), filename=path.name)
-    return list(RULES[rule_name].check(tree, f"node/{path.name}"))
+    rule = RULES[rule_name]
+    if rule.package_rule:
+        return list(rule.check_package(PackageIndex({f"node/{path.name}": tree})))
+    return list(rule.check(tree, f"node/{path.name}"))
 
 
 def _marked_lines(path: Path) -> set[int]:
@@ -59,10 +68,10 @@ def _marked_lines(path: Path) -> set[int]:
 
 class TestTier1Gate:
     def test_whole_package_settles_clean(self):
-        """THE gate: ≥6 rules over every module in p1_tpu, everything
+        """THE gate: ≥9 rules over every module in p1_tpu, everything
         either fixed or granted with a reason, no grant unused."""
         report = run_analysis()
-        assert len(report.rules) >= 6, report.rules
+        assert len(report.rules) >= 9, report.rules
         assert report.files >= 60, report.files  # the walk found the tree
         assert not report.parse_errors, report.parse_errors
         assert not report.violations, "unallowlisted findings:\n  " + "\n  ".join(
@@ -143,6 +152,42 @@ class TestHistoricalReproductions:
         findings = _rule_findings("set-iteration", FIXTURES / "setiter_bad.py")
         assert len(findings) >= 2
 
+    def test_set_through_a_variable_is_caught(self):
+        # The round-13 docs conceded the "through a variable" residue;
+        # round 16's one-dataflow-hop upgrade closes it.
+        findings = _rule_findings("set-iteration", FIXTURES / "setiter_bad.py")
+        assert any(f.key == "set-local" for f in findings)
+
+    def test_helper_hidden_fsync_is_caught(self):
+        # The transitive-blocking incident shape: the fsync lives in a
+        # sync helper chain below a clean-looking async def — invisible
+        # to the lexical blocking-in-async rule by construction.
+        findings = _rule_findings(
+            "transitive-blocking", FIXTURES / "transblock_bad.py"
+        )
+        keys = {f.key for f in findings}
+        assert "Node.handle_block->open" in keys, keys
+        # the full call path is in the detail — the ROADMAP-2 audit trail
+        f = next(f for f in findings if f.key == "Node.handle_block->open")
+        assert "Store.append" in f.detail and "_persist" in f.detail
+
+    def test_helper_routed_state_write_across_await_is_caught(self):
+        # The escaped-state incident shape: the chain write rides a
+        # helper call on the far side of a scheduling point.
+        findings = _rule_findings("escaped-state", FIXTURES / "escstate_bad.py")
+        assert {f.key for f in findings} == {"chain", "mempool"}
+
+    def test_frame_missing_shed_classification_fails_at_exact_key(self):
+        # THE negative control the acceptance criteria name: one frame
+        # type (BLOCK) in neither _SHED_DROPS nor _SHED_KEEPS must fail
+        # at exactly "BLOCK:shed".
+        findings = _rule_findings(
+            "wire-contract", FIXTURES / "wirecontract_bad.py"
+        )
+        keys = {f.key for f in findings}
+        assert "BLOCK:shed" in keys, keys
+        assert keys == {"BLOCK:shed", "TX:dispatch", "STATUS:version"}
+
 
 class TestSettlement:
     """The allowlist machinery itself, on a tiny synthetic tree."""
@@ -217,6 +262,166 @@ class TestSettlement:
         assert "node/node.py" in rels
         assert "analysis/engine.py" in rels  # the analyzer analyzes itself
         assert not any("__pycache__" in r for r in rels)
+
+
+class TestInterprocedural:
+    """The round-16 call-graph plane: graph construction facts the
+    three package rules depend on, and the wire-contract rule proven
+    load-bearing against the REAL registries (not just fixtures)."""
+
+    def _package_index(self):
+        from p1_tpu.analysis.engine import package_files
+
+        trees = {
+            rel: ast.parse(p.read_bytes(), filename=rel)
+            for rel, p in package_files(PKG_ROOT)
+        }
+        return PackageIndex(trees)
+
+    def test_graph_resolves_the_node_consensus_attributes(self):
+        """The one-level attribute-type binding that makes the graph
+        worth having: self.store/chain/mempool resolve to their real
+        classes, so the fsync/validate chains are followable."""
+        g = self._package_index().graph
+        types = g._attr_types["node/node.py"]["Node"]
+        assert types["store"] == ("chain/store.py", "ChainStore")
+        assert types["chain"] == ("chain/chain.py", "Chain")
+        assert types["mempool"] == ("mempool/mempool.py", "Mempool")
+
+    def test_graph_sees_the_store_append_fsync_chain(self):
+        """The headline residue closed: an async def reaching os.fsync
+        through ChainStore is in the blocking fixed point."""
+        g = self._package_index().graph
+        witness = g.blocking_paths()
+        assert "chain/store.py::ChainStore.append" in witness
+        # and the chain walks down to a real primitive
+        chain = g.witness_chain("chain/store.py::ChainStore.append", witness)
+        assert chain[-1] in ("open", "os.fsync"), chain
+
+    def test_to_thread_offload_is_not_an_edge(self):
+        """The house pattern must stay clean: _checkpoint_mempool
+        passes its blocking helper to asyncio.to_thread — no call
+        edge, so no transitive-blocking finding against it."""
+        g = self._package_index().graph
+        witness = g.blocking_paths()
+        node = g.nodes.get("node/node.py::Node._checkpoint_mempool")
+        assert node is not None and node.is_async
+        assert not any(
+            c.target in witness
+            and not g.nodes[c.target].is_async
+            for c in node.calls
+            if c.target
+        ), [c.dotted for c in node.calls]
+
+    def test_report_carries_callgraph_stats(self):
+        report = run_analysis(rules=[RULES["transitive-blocking"]])
+        assert report.callgraph_nodes > 500
+        assert report.callgraph_edges > 500
+        assert report.to_json()["callgraph_nodes"] == report.callgraph_nodes
+
+    def test_wire_contract_is_load_bearing_on_the_real_tree(self):
+        """Registry-mutation negative control: drop GETMETRICS from
+        node.py's _SHED_DROPS in the PARSED tree and the gate must
+        fail at exactly GETMETRICS:shed — proving the rule reads the
+        real registries, not a fixture-shaped convention."""
+        idx = self._package_index()
+        src = (PKG_ROOT / "node" / "node.py").read_text()
+        mutated = src.replace("MsgType.GETMETRICS,", "", 1)
+        assert mutated != src  # _SHED_DROPS names it exactly once first
+        idx.trees["node/node.py"] = ast.parse(mutated, filename="node/node.py")
+        findings = list(RULES["wire-contract"].check_package(idx))
+        assert [f.key for f in findings] == ["GETMETRICS:shed"], findings
+
+    def test_transitive_blocking_grants_read_as_the_roadmap2_work_list(self):
+        """Acceptance: every transitive-blocking grant names a concrete
+        offload decision (a stage or an explicit on/off-loop verdict) —
+        the table IS the multi-core split's audited inventory."""
+        from p1_tpu.analysis.allowlist import GRANTS
+
+        grants = GRANTS["transitive-blocking"]
+        assert grants, "the work list exists"
+        for rel, keys in grants.items():
+            for key, reason in keys.items():
+                assert "->" in key, key  # coroutine->primitive keying
+                assert any(
+                    tag in reason
+                    for tag in ("stage", "startup-only", "shutdown-only",
+                                "worker", "offload")
+                ), f"{key}: reason names no offload decision: {reason}"
+
+
+class TestScopedRuns:
+    """run_analysis(paths=...) — the `p1 lint --path` engine contract:
+    findings narrow to the scope, settlement stays global."""
+
+    def _two_file_pkg(self, tmp_path: Path) -> Path:
+        root = tmp_path / "pkg"
+        root.mkdir()
+        (root / "a.py").write_text(
+            "import random\n\n\ndef f():\n    return random.random()\n"
+        )
+        (root / "b.py").write_text(
+            "import random\n\n\ndef g():\n    return random.choice([1])\n"
+        )
+        return root
+
+    def test_scope_filters_reported_violations(self, tmp_path):
+        report = run_analysis(
+            root=self._two_file_pkg(tmp_path),
+            rules=[RULES["unseeded-rng"]],
+            grants={},
+            paths=["a.py"],
+        )
+        assert [f.file for f in report.violations] == ["a.py"]
+        assert report.scoped_to == ["a.py"]
+
+    def test_directory_scope_matches_prefix(self, tmp_path):
+        root = tmp_path / "pkg"
+        (root / "sub").mkdir(parents=True)
+        (root / "sub" / "mod.py").write_text(
+            "import random\nx = random.random()\n"
+        )
+        (root / "top.py").write_text("import random\ny = random.random()\n")
+        report = run_analysis(
+            root=root, rules=[RULES["unseeded-rng"]], grants={}, paths=["sub/"]
+        )
+        assert [f.file for f in report.violations] == ["sub/mod.py"]
+
+    def test_out_of_scope_grant_is_consumed_not_stale(self, tmp_path):
+        """Settlement is global: the finding in the out-of-scope file
+        still consumes its grant, so the scoped run reports neither a
+        violation nor a stale grant for it."""
+        report = run_analysis(
+            root=self._two_file_pkg(tmp_path),
+            rules=[RULES["unseeded-rng"]],
+            grants={
+                "unseeded-rng": {
+                    "a.py": {"random.random": "granted in scope"},
+                    "b.py": {"random.choice": "granted out of scope"},
+                }
+            },
+            paths=["a.py"],
+        )
+        assert not report.violations and not report.stale
+        assert [f.file for f in report.granted] == ["a.py"]  # reported in scope
+
+    def test_scoped_run_cannot_hide_a_stale_grant(self, tmp_path):
+        """The satellite's headline: a grant NOTHING uses — wherever
+        its file lives — still fails a run scoped elsewhere."""
+        report = run_analysis(
+            root=self._two_file_pkg(tmp_path),
+            rules=[RULES["unseeded-rng"]],
+            grants={
+                "unseeded-rng": {
+                    "b.py": {"random.shuffle": "nothing emits this"},
+                }
+            },
+            paths=["a.py"],
+        )
+        assert "unseeded-rng: b.py: grant 'random.shuffle' never used" in (
+            report.stale
+        )
+        assert not report.clean
 
 
 class TestGrantHygiene:

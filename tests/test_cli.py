@@ -1158,6 +1158,33 @@ class TestLint:
         assert proc.returncode == 2
         assert "unknown rule" in proc.stderr
 
+    def test_scoped_path_run_exit_0_and_labelled(self):
+        """`p1 lint --path` (round 16): scoped pre-commit runs — same
+        exit contract, summary names the scope, settlement still
+        global (the engine-level guarantees live in
+        tests/test_analysis.py::TestScopedRuns)."""
+        proc = self._lint("--path", "node/protocol.py", "--path", "analysis")
+        assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+        assert "scoped to analysis/, node/protocol.py" in proc.stdout
+
+    def test_scoped_json_report_carries_scope_and_callgraph(self):
+        proc = self._lint("--path", "node", "--json")
+        assert proc.returncode == 0
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["scoped_to"] == ["node/"]
+        assert out["clean"] is True
+        assert out["callgraph_nodes"] > 0 and out["callgraph_edges"] > 0
+
+    def test_unknown_path_is_usage_error_exit_2(self):
+        proc = self._lint("--path", "no/such/file.py")
+        assert proc.returncode == 2
+        assert "no such path" in proc.stderr
+
+    def test_path_outside_package_is_usage_error_exit_2(self):
+        proc = self._lint("--path", "/tmp")
+        assert proc.returncode == 2
+        assert "outside the analyzed package" in proc.stderr
+
     def test_bad_flag_is_usage_error_exit_2(self):
         proc = self._lint("--no-such-flag")
         assert proc.returncode == 2

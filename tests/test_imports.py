@@ -45,8 +45,12 @@ def test_walk_found_the_tree():
     assert len(names) > 30, names
     for expected in (
         "p1_tpu.analysis.engine",
+        "p1_tpu.analysis.callgraph",
         "p1_tpu.analysis.rules.wallclock",
         "p1_tpu.analysis.rules.awaitstate",
+        "p1_tpu.analysis.rules.transblock",
+        "p1_tpu.analysis.rules.escstate",
+        "p1_tpu.analysis.rules.wirecontract",
         "p1_tpu.core.keys",
         "p1_tpu.core._ed25519",
         "p1_tpu.core._ed25519_native",
