@@ -335,6 +335,35 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Sharded far field (round 17): node-seconds per wall second on a
+    # quick 2,000-node far-field run at 2 process shards
+    # (benchmarks/netsim_scale.py bench_far_field; the full 10k ladder
+    # is the --far table).  Header-only node-seconds — read the figure
+    # against RECORDED_SIM_SHARDED_RATE, never against the full-node
+    # sim rate above (docs/PERF.md spells out what the far-field model
+    # omits).
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_SIM_SHARDED_RATE,
+        SIM_SHARDED_DEGRADED_FRACTION,
+    )
+
+    try:
+        from benchmarks.netsim_scale import bench_far_field
+
+        far = bench_far_field(nodes=2000, shards=2, seed=0)
+        extra["sim_sharded_nodes_per_sec"] = far["sim_sharded_nodes_per_sec"]
+        extra["sim_sharded_ok"] = far["ok"]
+        extra["sim_sharded_vs_recorded"] = round(
+            far["sim_sharded_nodes_per_sec"] / RECORDED_SIM_SHARDED_RATE, 2
+        )
+        if (
+            far["sim_sharded_nodes_per_sec"]
+            < SIM_SHARDED_DEGRADED_FRACTION * RECORDED_SIM_SHARDED_RATE
+        ):
+            extra["sim_sharded_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Chaos plane (round 11): combined-fault schedules per wall second
     # (benchmarks/chaos_rate.py) against the ONE recorded constant
     # (perf_record.py RECORDED_CHAOS_RATE), same convention as above.

@@ -41,6 +41,36 @@ def bench_sim(nodes: int = 200, seed: int = 0) -> dict:
     }
 
 
+def bench_far_field(
+    nodes: int = 10_000, shards: int = 1, seed: int = 0
+) -> dict:
+    """One far-field scenario run (full-node core + header-only far
+    field, node/farfield.py) at ``shards`` — the round-17 per-shard
+    scaling row.  Rate metric: node-seconds of simulated mesh per wall
+    second over the whole composed run, same definition as
+    ``bench_sim`` so the two tables read against each other.  Honesty:
+    far-field node-seconds are HEADER-ONLY node-seconds (no mempool,
+    ledger, stores, supervision — docs/PERF.md spells out the model),
+    and on a 1-vCPU host process shards ADD overhead; the sharding is
+    for multi-core hosts."""
+    from p1_tpu.node.scenarios import far_field
+
+    report = far_field(nodes=nodes, seed=seed, shards=shards)
+    rate = nodes * report["virtual_s"] / max(report["wall_s"], 1e-9)
+    return {
+        "nodes": nodes,
+        "shards": shards,
+        "shard_processes": report["shard_processes"],
+        "ok": report["ok"],
+        "virtual_s": report["virtual_s"],
+        "wall_s": report["wall_s"],
+        "far_deliveries": report["far_deliveries"],
+        "far_barrier_rounds": report["far_barrier_rounds"],
+        "trace_digest": report["trace_digest"],
+        "sim_sharded_nodes_per_sec": round(rate, 1),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=200)
@@ -51,8 +81,22 @@ def main() -> None:
         help="run the docs/PERF.md scale ladder (50/200/1000) instead "
         "of one size",
     )
+    parser.add_argument(
+        "--far",
+        action="store_true",
+        help="run the 10k-node far-field per-shard ladder (1/2/4 "
+        "shards; >1 = one OS process per shard) — the round-17 "
+        "docs/PERF.md row; digests must agree across the ladder",
+    )
     args = parser.parse_args()
-    if args.table:
+    if args.far:
+        digests = set()
+        for shards in (1, 2, 4):
+            row = bench_far_field(shards=shards, seed=args.seed)
+            digests.add(row["trace_digest"])
+            print(json.dumps(row))
+        assert len(digests) == 1, "shard split moved the merged digest!"
+    elif args.table:
         for n in (50, 200, 1000):
             print(json.dumps(bench_sim(n, args.seed)))
     else:

@@ -2,4 +2,10 @@ import sys
 
 from p1_tpu.cli import main
 
-sys.exit(main())
+# The guard matters beyond hygiene: the far-field shard workers
+# (node/farfield.py) use the multiprocessing spawn context, whose
+# children re-import the parent's __main__ module — without it, a
+# `p1 sim --shards N` run would recursively re-enter the CLI in every
+# worker.
+if __name__ == "__main__":
+    sys.exit(main())

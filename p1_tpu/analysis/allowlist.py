@@ -68,6 +68,13 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
             "report wall_s (same split as scenarios.py)",
             "asyncio.sleep": "chaos schedules sleep on the virtual loop",
         },
+        "node/farfield.py": {
+            "time.monotonic": "the shard coordinator's real-wall "
+            "budget guard and report wall_s — deliberate host-clock "
+            "reads ABOUT the far-field run, never inside its "
+            "integer-microsecond event time (same split as netsim.py); "
+            "the engine itself is synchronous and clock-free",
+        },
         # -- harness/tooling that drives REAL processes and sockets on
         #    the host clock by design (subprocess meshes, soak drivers,
         #    operator runners) — not part of the simulated node.

@@ -684,6 +684,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--region-nodes", type=int, default=None, help="wan nodes per region"
     )
     p.add_argument(
+        "--shards",
+        type=int,
+        default=None,
+        help="far-field shard count (>1 = one OS process per shard over "
+        "the pipe seam; the merged trace digest must not move with it)",
+    )
+    p.add_argument(
+        "--days",
+        type=float,
+        default=None,
+        help="soak scenario: virtual days to run (default 7)",
+    )
+    p.add_argument(
         "--no-telemetry",
         action="store_true",
         help="run the scenario's nodes with telemetry recording off — "
@@ -1760,6 +1773,8 @@ def cmd_sim(args) -> int:
         "cycles": args.cycles,
         "attackers": args.attackers,
         "region_nodes": args.region_nodes,
+        "shards": args.shards,
+        "days": args.days,
         # Only passed when disabling: scenarios default telemetry on.
         "telemetry": False if args.no_telemetry else None,
     }

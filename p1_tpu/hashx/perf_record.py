@@ -133,6 +133,25 @@ RECORDED_SIM_RATE = 1_900.0
 #: co-tenant-sensitive measurement in the file.
 SIM_DEGRADED_FRACTION = 0.4
 
+#: Sharded far-field plane (round 17, node/farfield.py): node-seconds
+#: of simulated mesh per wall second on the bench probe shape (2,000
+#: total nodes — a 16-full-node core + header-only far field — at 2
+#: PROCESS shards over the pipe seam; benchmarks/netsim_scale.py
+#: ``bench_far_field``).  Measured 2026-08-05 on the 1-vCPU bench host
+#: at 1-minute loadavg 0.75.  Read it for what it is: header-only
+#: node-seconds, ~50x the full-node sim rate because a far-field node
+#: is ~50x less node (no mempool/ledger/store/supervision —
+#: docs/PERF.md "Sharded far field" has the model's omissions and the
+#: 10k ladder, where 1 shard beats 2 and 4 on this host: one vCPU has
+#: no parallelism to sell, so process shards only add pipe+spawn cost;
+#: the split exists for multi-core hosts and the determinism proof).
+#: ``bench.py`` emits ``sim_sharded_vs_recorded`` against this figure.
+RECORDED_SIM_SHARDED_RATE = 52_000.0
+
+#: Same-session degraded threshold; same substrate sensitivity as the
+#: full-node sim figure.
+SIM_SHARDED_DEGRADED_FRACTION = 0.4
+
 #: Chaos plane (round 11): combined-fault schedules per wall second on
 #: the default 5-node/10-event configuration (benchmarks/chaos_rate.py;
 #: node/chaos.py) — each schedule a full mesh life cycle: formation,

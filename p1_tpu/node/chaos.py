@@ -61,6 +61,8 @@ __all__ = [
     "CHAOS_BUGS",
     "fsck_verdict",
     "generate_schedule",
+    "generate_soak_schedule",
+    "longevity_soak",
     "run_chaos",
     "run_repro",
     "shrink_schedule",
@@ -252,6 +254,230 @@ def generate_schedule(
     return events
 
 
+# -- longevity soak --------------------------------------------------------
+
+#: One virtual day, seconds.
+DAY_VS = 86_400.0
+
+
+def generate_soak_schedule(
+    seed: int,
+    n_nodes: int,
+    horizon_vs: float,
+    fault_clusters: int,
+    blocks: int,
+    txs_per_cluster: int = 2,
+    fault_window_vs: float = 240.0,
+) -> list[dict]:
+    """A LONG-horizon schedule shaped for longevity, not density: the
+    same event vocabulary as ``generate_schedule``, but every
+    disruptive fault is paired with its clearing event inside a bounded
+    ``fault_window_vs`` envelope (crash→recover, partition→heal,
+    disk_fail→disk_heal, slow_link→restore_link, hostile/flood→calm).
+    A week-long open partition is the partition-heal scenario's
+    question; the longevity question is whether a week of RECURRING
+    fault/heal cycles, steady mining, and wallet traffic leaves any
+    monotone growth behind — so faults here recur and clear, block
+    production ticks through the whole horizon, and two ``probe``
+    events (midpoint and end) snapshot the per-node leak gauges the
+    quiesce invariants compare.
+
+    The envelope also keeps the event count proportional to the fault
+    count rather than the horizon: an unclosed crash would have every
+    surviving peer redialing the corpse twice a second for six virtual
+    days (RECONNECT_DELAY_S), drowning the run in events the scenario
+    never meant to test."""
+    rng = random.Random((seed << 4) ^ 0x50AC7)
+    events: list[dict] = []
+    for b in range(blocks):
+        at = (b + 1) * horizon_vs / (blocks + 1)
+        events.append(
+            {"at": round(at, 3), "op": "mine", "node": b % n_nodes}
+        )
+    slot_vs = horizon_vs / max(1, fault_clusters)
+    assert slot_vs > fault_window_vs + 2.0, (
+        "fault clusters overlap: lengthen the horizon or reduce clusters"
+    )
+    joiners = 0
+    for c in range(fault_clusters):
+        at = round(c * slot_vs + rng.uniform(1.0, slot_vs - fault_window_vs - 1.0), 3)
+        end = round(at + rng.uniform(30.0, fault_window_vs), 3)
+        kind = rng.choice(
+            (
+                "crash",
+                "crash",
+                "partition",
+                "disk_fail",
+                "slow_link",
+                "hostile",
+                "flood",
+                "snap_join",
+            )
+        )
+        if kind == "crash":
+            victim = rng.randrange(n_nodes)
+            events.append(
+                {
+                    "at": at,
+                    "op": "crash",
+                    "node": victim,
+                    "torn": rng.choice((0, rng.randrange(1, 1 << 16))),
+                }
+            )
+            events.append({"at": end, "op": "recover", "node": victim})
+            if rng.random() < 0.5:
+                events.append(
+                    {
+                        "at": round((at + end) / 2, 3),
+                        "op": "corrupt",
+                        "node": victim,
+                        "offset": rng.randrange(1 << 20),
+                    }
+                )
+        elif kind == "partition":
+            events.append(
+                {
+                    "at": at,
+                    "op": "partition",
+                    "frac": rng.choice((0.3, 0.5, 0.7)),
+                }
+            )
+            events.append({"at": end, "op": "heal"})
+        elif kind == "disk_fail":
+            import errno
+
+            victim = rng.randrange(n_nodes)
+            events.append(
+                {
+                    "at": at,
+                    "op": "disk_fail",
+                    "node": victim,
+                    "errno": rng.choice((errno.ENOSPC, errno.EIO)),
+                }
+            )
+            events.append({"at": end, "op": "disk_heal", "node": victim})
+        elif kind == "slow_link":
+            victim = rng.randrange(n_nodes)
+            events.append(
+                {
+                    "at": at,
+                    "op": "slow_link",
+                    "node": victim,
+                    "latency_ms": rng.choice((50, 150, 400)),
+                    "loss": rng.choice((0.0, 0.2)),
+                }
+            )
+            events.append(
+                {"at": end, "op": "restore_link", "node": victim}
+            )
+        elif kind == "hostile":
+            events.append(
+                {
+                    "at": at,
+                    "op": "hostile",
+                    "node": rng.randrange(n_nodes),
+                    "fault": rng.choice(("stale", "swallow")),
+                    "height": rng.randrange(3, 9),
+                }
+            )
+            events.append({"at": end, "op": "calm"})
+        elif kind == "flood":
+            events.append(
+                {
+                    "at": at,
+                    "op": "flood",
+                    "node": rng.randrange(n_nodes),
+                    "kind": rng.choice(("queries", "blocks")),
+                }
+            )
+            events.append({"at": end, "op": "calm"})
+        elif kind == "snap_join" and joiners < MAX_JOINERS:
+            slot = n_nodes + joiners
+            joiners += 1
+            events.append(
+                {
+                    "at": at,
+                    "op": "snap_join",
+                    "node": slot,
+                    "peers": sorted(
+                        rng.sample(range(n_nodes), min(2, n_nodes))
+                    ),
+                }
+            )
+        for _ in range(txs_per_cluster):
+            events.append(
+                {
+                    "at": round(rng.uniform(at, end), 3),
+                    "op": "tx",
+                    "amount": rng.randrange(1, 5),
+                    "fee": rng.randrange(0, 3),
+                }
+            )
+    events.append({"at": round(horizon_vs / 2, 3), "op": "probe"})
+    events.append({"at": round(horizon_vs, 3), "op": "probe"})
+    return sorted(events, key=lambda e: e["at"])
+
+
+def longevity_soak(
+    seed: int = 0,
+    nodes: int = 5,
+    days: float = 7.0,
+    clusters_per_day: float = 4.0,
+    blocks_per_day: float = 48.0,
+    difficulty: int = 8,
+    settle_vs: float = 240.0,
+    rss_bound_mb: float = 2048.0,
+    wall_limit_s: float | None = 600.0,
+) -> dict:
+    """The ≥1-virtual-week longevity soak (ROADMAP item 4): ``days`` of
+    virtual mesh life — steady block production, recurring
+    fault/heal cycles across every injector family, wallet traffic —
+    compressed through the chaos plane's virtual clock, then held to
+    the full quiesce invariant suite PLUS the leak invariants the probe
+    events feed: bounded RSS, ban/violation tables, address books,
+    signature/proof/filter caches, per-node task counts, and
+    supervision/store retry counters whose second-half growth must stay
+    proportional to the first half (a runaway retry loop shows up as a
+    hockey stick even when every individual table is capped).
+
+    Returns a scenario-shaped report (``p1 sim soak`` runs it): chaos
+    report fields + ``scenario``/``repro`` stamps, ``ok`` iff zero
+    violations."""
+    horizon_vs = days * DAY_VS
+    events = generate_soak_schedule(
+        seed,
+        nodes,
+        horizon_vs,
+        fault_clusters=max(1, round(days * clusters_per_day)),
+        blocks=max(1, round(days * blocks_per_day)),
+    )
+    report = run_chaos(
+        seed,
+        nodes=nodes,
+        events=events,
+        difficulty=difficulty,
+        settle_vs=settle_vs,
+        wall_limit_s=wall_limit_s,
+        rss_bound_mb=rss_bound_mb,
+    )
+    report["scenario"] = "soak"
+    report["days_virtual"] = round(report["virtual_s"] / DAY_VS, 3)
+    report["repro"] = f"p1 sim soak --seed {seed}"
+    return report
+
+
+def _vm_rss_mb() -> float | None:
+    """Current process RSS in MB via /proc (None off-Linux)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
 # -- store verdicts --------------------------------------------------------
 
 
@@ -297,6 +523,7 @@ def run_chaos(
     inject_bug: str | None = None,
     txs: bool = True,
     keep_trace: bool = False,
+    rss_bound_mb: float | None = None,
 ) -> dict:
     """Run one chaos schedule end to end and return the report.
 
@@ -331,6 +558,7 @@ def run_chaos(
                 inject_bug=inject_bug,
                 txs=txs,
                 keep_trace=keep_trace,
+                rss_bound_mb=rss_bound_mb,
             )
     t0 = time.monotonic()
     net = SimNet(
@@ -340,11 +568,30 @@ def run_chaos(
         keep_trace=keep_trace,
     )
     runner = _ChaosRunner(
-        net, nodes, difficulty, inject_bug, settle_vs, wall_limit_s
+        net, nodes, difficulty, inject_bug, settle_vs, wall_limit_s,
+        rss_bound_mb=rss_bound_mb,
     )
     report = net.run(runner.main(events))
     report["seed"] = seed
     report["nodes"] = nodes
+    report["repro"] = f"p1 chaos --seed {seed} --nodes {nodes}"
+    if rss_bound_mb is not None:
+        # RSS at quiesce vs the soak bound — read here, OUTSIDE the
+        # event loop (the probe path stays pure reads, and /proc IO
+        # never lands on the loop the transitive-blocking lint guards).
+        # VmRSS, not peak: CPython's allocator rarely returns freed
+        # arenas, but a bounded-table mesh at quiesce must still fit.
+        rss_mb = _vm_rss_mb()
+        report["rss_mb"] = rss_mb
+        report["rss_bound_mb"] = rss_bound_mb
+        if rss_mb is not None and rss_mb > rss_bound_mb:
+            report["violations"].append(
+                {
+                    "invariant": "rss",
+                    "detail": f"process RSS {rss_mb:.0f} MB over the "
+                    f"{rss_bound_mb:.0f} MB soak bound at quiesce",
+                }
+            )
     report["wall_s"] = round(time.monotonic() - t0, 3)
     report["ok"] = not report["violations"]
     return report
@@ -353,7 +600,8 @@ def run_chaos(
 class _ChaosRunner:
     """One schedule's execution state (hosts, wallets, live actors)."""
 
-    def __init__(self, net, n_nodes, difficulty, inject_bug, settle_vs, wall_limit_s):
+    def __init__(self, net, n_nodes, difficulty, inject_bug, settle_vs,
+                 wall_limit_s, rss_bound_mb=None):
         from p1_tpu.core.keys import Keypair
 
         self.net = net
@@ -380,6 +628,11 @@ class _ChaosRunner:
         self.actors: list = []  # hostile/greedy peers, stopped at epilogue
         self.slowed: set[str] = set()
         self.partitioned = False
+        self.rss_bound_mb = rss_bound_mb
+        #: Leak-gauge snapshots taken by ``probe`` events (the soak
+        #: schedule places one at the midpoint and one at the horizon);
+        #: the quiesce leak invariants compare the last two.
+        self.probes: list[dict] = []
         self.recover_verdicts: list[int] = []
         self.counts = {"applied": 0, "crashes": 0, "recoveries": 0, "txs": 0}
 
@@ -528,6 +781,19 @@ class _ChaosRunner:
             await self._snap_join(ev)
         elif op == "snap_liar":
             await self._snap_join(ev, fault=ev["fault"])
+        elif op == "probe":
+            # Leak-gauge snapshot (the longevity soak's midpoint/end
+            # markers): recorded in the trace — a probe that silently
+            # vanished would void the leak comparison.
+            self._record("probe", len(self.probes))
+            self.probes.append(self._gauge_snapshot())
+        elif op == "calm":
+            # Stop every live adversary (the soak's bounded-envelope
+            # closer for hostile/flood clusters).
+            self._record("calm", len(self.actors))
+            for actor in self.actors:
+                await actor.stop()
+            self.actors.clear()
         elif op == "flood":
             from p1_tpu.node.testing import FloodPlan, GreedyPeer, make_blocks
 
@@ -773,6 +1039,7 @@ class _ChaosRunner:
         violations.extend(self._check_pools())
         violations.extend(self._check_caches())
         violations.extend(self._check_assumed_samples())
+        violations.extend(self._check_leaks())
 
         from p1_tpu.node.telemetry import propagation_summary_ms
 
@@ -784,6 +1051,14 @@ class _ChaosRunner:
             "recover_verdicts": self.recover_verdicts,
             "virtual_s": round(net.clock.now, 3),
             "net_events": net.net.events,
+            "probes": len(self.probes),
+            # The raw leak-gauge snapshots (midpoint vs end): the
+            # numbers behind any "leak" violation, kept in the report
+            # so a failing soak is diagnosable from its JSON alone.
+            "leak_gauges": {
+                "mid": self.probes[-2] if len(self.probes) >= 2 else None,
+                "end": self.probes[-1] if self.probes else None,
+            },
             "settle_virtual_s": round(settle_vs, 3),
             "heights": {"min": min(heights), "max": max(heights)},
             "reorgs_total": sum(
@@ -817,6 +1092,124 @@ class _ChaosRunner:
                 )
         report["trace_digest"] = net.trace_digest()
         return report
+
+    def _gauge_snapshot(self) -> dict:
+        """Per-node leak gauges: everything that must NOT grow
+        monotonically over a long, stationary fault mix.  Pure reads —
+        a probe must never perturb what it measures."""
+        out: dict[str, dict] = {}
+        for host in self.hosts:
+            node = self.net.nodes.get(host)
+            if node is None:
+                continue
+            out[host] = {
+                "tasks": len(node._tasks) + len(node._sessions),
+                "banned": len(node._banned_until),
+                "violations": len(node._violations),
+                "known_addrs": len(node._known_addrs),
+                "tried_addrs": len(node._tried_addrs),
+                "mempool": len(node.mempool),
+                "sig_cache": len(node.sig_cache),
+                "gauge_bytes": node._memory_gauge(),
+                # Supervision/store retry counters: monotone by design —
+                # the leak check bounds their second-half GROWTH, not
+                # their value (a runaway retry loop is a hockey stick
+                # even when every table above stays capped).  Liveness
+                # pings are deliberately NOT in here: their rate rides
+                # topology and gossip idleness, not retry health.
+                "retry_counters": int(
+                    node.metrics.sync_stalls
+                    + node.metrics.sync_failovers
+                    + node.metrics.sync_exhausted
+                    + node.metrics.store_retries
+                    + node.metrics.mempool_sync_stalls
+                    + node.metrics.cblock_fetch_stalls
+                ),
+            }
+        return out
+
+    def _check_leaks(self) -> list[dict]:
+        """The longevity invariants: hard caps on every bounded table
+        at quiesce, plus mid-vs-end growth comparisons from the probe
+        snapshots.  Active only when a schedule carried probes (the
+        soak always does); a plain chaos schedule skips it."""
+        from p1_tpu.node.node import (
+            MAX_KNOWN_ADDRS,
+            MAX_PEERS,
+            MAX_TRACKED_HOSTS,
+            MAX_TRIED_ADDRS,
+        )
+
+        out: list[dict] = []
+        if len(self.probes) < 2:
+            return out
+        mid, end = self.probes[-2], self.probes[-1]
+        for host in self.hosts:
+            node = self.net.nodes.get(host)
+            if node is None:
+                continue
+            caps = [
+                ("banned", len(node._banned_until), MAX_TRACKED_HOSTS),
+                ("violations", len(node._violations), MAX_TRACKED_HOSTS),
+                ("known_addrs", len(node._known_addrs), MAX_KNOWN_ADDRS),
+                ("tried_addrs", len(node._tried_addrs), MAX_TRIED_ADDRS),
+                ("sig_cache", len(node.sig_cache), node.sig_cache.max_entries),
+                (
+                    "proof_cache_bytes",
+                    node.chain.proof_cache.bytes_used,
+                    node.chain.proof_cache.max_bytes,
+                ),
+                (
+                    "filter_index_bytes",
+                    node.chain.filter_index.bytes_used,
+                    node.chain.filter_index.max_bytes,
+                ),
+                ("tasks", len(node._tasks) + len(node._sessions),
+                 MAX_PEERS + 16),
+            ]
+            for name, value, cap in caps:
+                if value > cap:
+                    out.append(
+                        {
+                            "invariant": "leak",
+                            "detail": f"{host} {name} = {value} over its "
+                            f"bound {cap} at quiesce",
+                        }
+                    )
+            m, e = mid.get(host), end.get(host)
+            if m is None or e is None:
+                continue  # crashed across a probe: growth unreadable
+            if e["tasks"] > m["tasks"] + 8:
+                out.append(
+                    {
+                        "invariant": "leak",
+                        "detail": f"{host} task count grew {m['tasks']} -> "
+                        f"{e['tasks']} over the second half",
+                    }
+                )
+            if e["mempool"] > m["mempool"] + 64:
+                out.append(
+                    {
+                        "invariant": "leak",
+                        "detail": f"{host} mempool grew {m['mempool']} -> "
+                        f"{e['mempool']} over the second half",
+                    }
+                )
+            growth = e["retry_counters"] - m["retry_counters"]
+            # A crash between the probes resets the node's counters
+            # (recover builds a fresh Node): negative growth means a
+            # restart, not a recovery of leaked memory — skip.
+            if growth > 3 * m["retry_counters"] + 100:
+                out.append(
+                    {
+                        "invariant": "leak",
+                        "detail": f"{host} supervision/retry counters grew "
+                        f"{growth} in the second half vs "
+                        f"{m['retry_counters']} in the first — a runaway "
+                        "retry loop",
+                    }
+                )
+        return out
 
     def _sample_assumed(self) -> None:
         """Record every ASSUMED joiner's answer to "what is the wallet's

@@ -46,11 +46,16 @@ tests/test_netsim.py.  (The contract is per-interpreter: set
 What the sim does NOT model, honestly (docs/ARCHITECTURE.md): real TCP
 backpressure (writes are accepted instantly; ``drain()`` never blocks —
 the write-buffer gauge the governor reads is bytes in flight on the
-link), kernel buffers and Nagle, OS scheduling and the GIL, and packet
+link), kernel buffers and Nagle, OS scheduling and the GIL, packet
 loss as actual byte loss (the stream is reliable by construction; the
 ``loss`` knob models retransmission DELAY spikes instead, which is what
-loss does to a TCP stream that survives it).  Real-socket behavior
-stays covered by the original suites through ``SocketTransport``.
+loss does to a TCP stream that survives it), and worker-thread latency
+(``run_in_executor`` jobs — the mempool checkpoint's ``to_thread``
+write — complete synchronously at the submission instant: a real
+thread's completion time is wall-clock state the virtual clock cannot
+deterministically place, which the round-17 week-long soak proved by
+diverging on it).  Real-socket behavior stays covered by the original
+suites through ``SocketTransport``.
 """
 
 from __future__ import annotations
@@ -127,6 +132,27 @@ class SimLoop(asyncio.SelectorEventLoop):
 
     def time(self) -> float:
         return self._sim_clock.now
+
+    def run_in_executor(self, executor, func, *args):
+        """Worker jobs complete SYNCHRONOUSLY, at the current virtual
+        instant.  A real executor's completion lands via
+        ``call_soon_threadsafe`` at whatever virtual time the loop has
+        jumped to by then — racing REAL thread latency against virtual
+        time, so two identical runs resume the awaiting coroutine at
+        different virtual instants and every timer downstream shifts.
+        The round-17 longevity soak caught exactly that: a virtual week
+        of 30 s-cadence mempool checkpoints (``asyncio.to_thread`` →
+        here) made same-seed traces diverge where 30-virtual-second
+        chaos schedules had been too short to trip it.  Running the job
+        inline is the only timing a virtual clock can assign it
+        deterministically; what the sim gives up — modeling worker
+        LATENCY — is recorded in the module docstring's honesty list."""
+        fut = self.create_future()
+        try:
+            fut.set_result(func(*args))
+        except BaseException as e:  # delivered to the awaiter, not lost
+            fut.set_exception(e)
+        return fut
 
     def _run_once(self):
         if not self._ready and self._scheduled:

@@ -58,12 +58,19 @@ def _topology_peers(rng: random.Random, i: int, degree: int) -> list[int]:
     return [i - 1, *extra]
 
 
-def _report(net: SimNet, scenario: str, t0: float, **extra) -> dict:
+def _report(
+    net: SimNet, scenario: str, t0: float, repro_flags: str = "", **extra
+) -> dict:
     from p1_tpu.node.telemetry import propagation_summary_ms
 
     report = {
         "scenario": scenario,
         "seed": net.seed,
+        # One-flag deterministic repro: every report names the exact
+        # command whose re-run must reproduce ``trace_digest`` byte for
+        # byte (tests/test_cli.py asserts exactly that).
+        "repro": f"p1 sim {scenario} --seed {net.seed}"
+        + (f" {repro_flags}" if repro_flags else ""),
         "nodes": len(net.nodes),
         "virtual_s": round(net.clock.now, 3),
         "wall_s": round(time.monotonic() - t0, 3),
@@ -516,6 +523,11 @@ _WAN_LATENCY = {
     ("au", "asia"): 0.070,
 }
 
+#: The wan scenario's default propagation SLO (virtual ms): a few
+#: gossip hops across the worst configured path.  Applied when the
+#: caller passes no explicit bound AND telemetry makes it measurable.
+WAN_DEFAULT_P95_BOUND_MS = 1500.0
+
 
 def wan(
     region_nodes: int = 10,
@@ -525,7 +537,7 @@ def wan(
     inter_bandwidth_bps: float = 100e6,
     wall_limit_s: float | None = 240.0,
     telemetry: bool = True,
-    propagation_p95_bound_ms: float = 1500.0,
+    propagation_p95_bound_ms: float | None = None,
 ) -> dict:
     """Four regions (us/eu/asia/au) with asymmetric inter-region
     latency and shaped bandwidth; blocks are mined round-robin across
@@ -535,7 +547,20 @@ def wan(
     the round-14 telemetry histograms — the mesh-wide virtual-time
     propagation p95 stays under ``propagation_p95_bound_ms``: a few
     gossip hops across the worst configured path, an actual latency SLO
-    instead of bare convergence."""
+    instead of bare convergence.
+
+    ``propagation_p95_bound_ms``: None applies the default SLO
+    (``WAN_DEFAULT_P95_BOUND_MS``) when the histograms exist and marks
+    the SLO ``"unevaluated"`` — excluded from ``ok``, never silently
+    passed — when telemetry is off; an EXPLICIT bound with telemetry
+    disabled raises ``ValueError`` up front (a bound that cannot be
+    measured must fail loudly, not fall back vacuously — the round-17
+    fix; tests/test_scenarios.py carries the negative control)."""
+    if not telemetry and propagation_p95_bound_ms is not None:
+        raise ValueError(
+            "a propagation p95 bound was requested but telemetry is "
+            "disabled: the SLO is unmeasurable, not vacuously true"
+        )
     regions = ("us", "eu", "asia", "au")
     net = SimNet(
         seed=seed,
@@ -614,22 +639,41 @@ def wan(
             geography_visible=max_p95_ms >= min_inter_ms,
         )
         # The telemetry-histogram SLO: mesh-wide p95 propagation (in
-        # virtual ms, merged across every node) under the bound.  With
-        # telemetry disabled there is no histogram to assert on — the
-        # SLO is vacuously out of scope and `ok` falls back to the
-        # pre-round-14 criteria.
+        # virtual ms, merged across every node) under the bound.  Three
+        # explicit states, none vacuous (the round-17 fix — the old
+        # code read "no histogram" as "bounded"):
+        #   evaluated    — histograms exist, the bound was checked;
+        #   unevaluated  — telemetry off AND no bound requested: the
+        #                  SLO is out of scope, marked so, and excluded
+        #                  from ``ok`` (never counted as a pass);
+        #   unmeasurable — a bound applies but the histograms are
+        #                  missing (telemetry on, nothing recorded):
+        #                  that is a FAILURE, not a pass.
         prop = report["telemetry"]["propagation"]
-        report["propagation_p95_bound_ms"] = propagation_p95_bound_ms
-        report["propagation_bounded"] = (
-            prop is None or prop["p95_ms"] <= propagation_p95_bound_ms
+        bound = (
+            WAN_DEFAULT_P95_BOUND_MS
+            if propagation_p95_bound_ms is None
+            else propagation_p95_bound_ms
         )
+        report["propagation_p95_bound_ms"] = bound if telemetry else None
+        if not telemetry:
+            report["propagation_slo"] = "unevaluated"
+            report["propagation_bounded"] = None
+            slo_ok = True  # out of scope by request, and SAYS so
+        elif prop is None:
+            report["propagation_slo"] = "unmeasurable"
+            report["propagation_bounded"] = False
+            slo_ok = False
+        else:
+            report["propagation_slo"] = "evaluated"
+            report["propagation_bounded"] = prop["p95_ms"] <= bound
+            slo_ok = report["propagation_bounded"]
         report["ok"] = bool(
             done
             and report["converged"]
             and report["ledger_conserved"]
             and report["geography_visible"]
-            and report["propagation_bounded"]
-            and (not telemetry or prop is not None)
+            and slo_ok
         )
         await net.stop_all()
         return report
@@ -823,7 +867,879 @@ def snapshot_join(
     return net.run(main())
 
 
+# -- far field: the sharded 10k-node scenario ----------------------------
+
+
+def far_field(
+    nodes: int = 10_000,
+    full_nodes: int = 16,
+    blocks: int = 8,
+    seed: int = 0,
+    difficulty: int = 8,
+    degree: int = 4,
+    shards: int = 1,
+    processes: bool | None = None,
+    spacing_s: float = 4.0,
+    far_settle_bound_ms: float = 60_000.0,
+    wall_limit_s: float | None = 420.0,
+) -> dict:
+    """An order of magnitude past the full simulator: a ``full_nodes``
+    core mesh of REAL nodes mines and converges as usual, and every
+    announcement then propagates through a ``nodes - full_nodes``
+    header-only far field (node/farfield.py) — sharded ``shards`` ways,
+    across processes when ``shards > 1`` (``processes=False`` keeps the
+    same sharded exchange in one process for determinism pairs).
+
+    ok = the core converges with the ledger conserved, EVERY far-field
+    node ends on the core's final tip, and the far field's last header
+    arrival lands within ``far_settle_bound_ms`` virtual ms of its
+    injection (the convergence-lag SLO; an impossible bound must fail —
+    the control test).  The report's ``trace_digest`` is the MERGED
+    digest — core event trace + far-field delivery trace — and must be
+    byte-identical for the same seed at 1 shard and at N shards, in
+    process and across processes (the round-17 acceptance pair)."""
+    import hashlib
+
+    from p1_tpu.node.farfield import run_far_field
+
+    assert full_nodes >= 2 and nodes > full_nodes
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    feed: list[tuple[float, int, str, str]] = []
+
+    async def main():
+        rng = random.Random(seed ^ 0xFA2F)
+        for i in range(full_nodes):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)]
+            )
+        hosts = list(net.nodes)
+        miner = net.nodes[hosts[0]]
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "core mesh never formed"
+        for _ in range(blocks):
+            t_inject = net.clock.now
+            block = await net.mine_on(miner, spacing_s=spacing_s)
+            parent = feed[-1][2] if feed else ""
+            feed.append(
+                (
+                    t_inject,
+                    miner.chain.height,
+                    block.block_hash().hex()[:16],
+                    parent,
+                )
+            )
+        done = await net.run_until(
+            lambda: net.converged() and min(net.heights()) == blocks,
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        report = _report(
+            net, "far-field", t0,
+            repro_flags=f"--shards {shards}",
+            core_done=done,
+        )
+        await net.stop_all()
+        return report
+
+    report = net.run(main())
+    far = run_far_field(
+        nodes - full_nodes,
+        seed,
+        feed,
+        degree=degree,
+        shards=shards,
+        processes=processes,
+        wall_limit_s=wall_limit_s,
+    )
+    core_digest = report["trace_digest"]
+    report.update(
+        nodes=nodes,
+        full_nodes=full_nodes,
+        far_nodes=far.nodes,
+        shards=far.shards,
+        shard_processes=far.processes,
+        far_deliveries=far.deliveries,
+        far_barrier_rounds=far.rounds,
+        far_converged_nodes=far.converged_nodes,
+        far_converged=far.converged,
+        far_settle_ms=far.settle_ms,
+        far_settle_bound_ms=far_settle_bound_ms,
+        far_propagation_p50_ms=far.propagation_p50_ms,
+        far_propagation_p95_ms=far.propagation_p95_ms,
+        core_trace_digest=core_digest,
+        far_trace_digest=far.trace_digest,
+        # THE merged digest: the shard-count-invariance witness.
+        trace_digest=hashlib.sha256(
+            (core_digest + far.trace_digest).encode()
+        ).hexdigest(),
+        wall_s=round(time.monotonic() - t0, 3),
+    )
+    report["ok"] = bool(
+        report["core_done"]
+        and report["converged"]
+        and report["ledger_conserved"]
+        and far.converged
+        and far.settle_ms <= far_settle_bound_ms
+    )
+    return report
+
+
+# -- selfish mining / block withholding ----------------------------------
+
+
+def selfish_mining(
+    honest: int = 20,
+    alpha: float = 0.3,
+    finds: int = 120,
+    seed: int = 0,
+    difficulty: int = 8,
+    find_spacing_s: float = 2.0,
+    amplification_bound: float = 1.10,
+    margin: float = 0.05,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """Eyal–Sirer selfish mining against the real mesh: an attacker
+    with hashrate fraction ``alpha`` mines PRIVATELY (an isolated full
+    node — nobody dials it, it dials nobody) and releases strategically
+    through an honest entry node: withhold while ahead, reveal the
+    matching prefix when far ahead, release everything when the honest
+    chain draws within one (the override), race at a tie.
+
+    The containment bound under test: this mesh gives the attacker
+    γ ≈ 0 — honest nodes NEVER mine on the attacker's block in a tie,
+    because fork choice keeps the first-seen tip at equal weight and
+    the mesh heard its own block first — and below the γ=0 profit
+    threshold (α < ~1/3) selfish mining must then UNDER-perform honest
+    mining.  ok asserts the attacker's realized share of the final
+    chain's coinbases ≤ ``alpha * amplification_bound + margin`` (plus
+    the structural bits: the attack really ran — blocks were withheld,
+    at least one override reorged the mesh — and the mesh still
+    converged with the ledger conserved).  ``margin=-1`` is the
+    impossible-bound control."""
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    ATTACKER = "selfish"
+
+    async def main():
+        rng = random.Random(seed ^ 0x5E1F)
+        for i in range(honest):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)],
+                miner_id=f"honest-{i}",
+            )
+        hosts = list(net.nodes)
+        rep = net.nodes[hosts[0]]  # honest representative / miner
+        entry = net.nodes[hosts[1]]  # where attacker blocks enter
+        attacker = await net.add_node(
+            name="10.66.6.6", peers=[], miner_id=ATTACKER
+        )
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        # Warmup: two public blocks (the attacker sees them too — it
+        # tracks the public chain even while mining its own).
+        for _ in range(2):
+            b = await net.mine_on(rep, spacing_s=1.0)
+            await attacker._handle_block(b)
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == 2,
+            60, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged pre-attack"
+        warmup_height = rep.chain.height
+
+        withheld: list = []  # unpublished suffix of the private branch
+        published: set[bytes] = {rep.chain.tip_hash}
+        stats = {
+            "withheld_blocks": 0,
+            "reveals": 0,
+            "overrides": 0,
+            "races": 0,
+            "attacker_finds": 0,
+            "honest_finds": 0,
+        }
+
+        async def publish(upto_height: int | None = None) -> None:
+            """Release withheld blocks (all, or the prefix at or below
+            ``upto_height``) into the mesh through the entry node."""
+            while withheld and (
+                upto_height is None or withheld[0][0] <= upto_height
+            ):
+                _h, blk = withheld.pop(0)
+                published.add(blk.block_hash())
+                await entry._handle_block(blk)
+            stats["reveals"] += 1
+
+        for _find in range(finds):
+            if rng.random() < alpha:
+                stats["attacker_finds"] += 1
+                parent_hash = attacker.chain.tip_hash
+                blk = await net.mine_on(attacker)  # no peers: stays private
+                withheld.append((attacker.chain.height, blk))
+                stats["withheld_blocks"] += 1
+                if (
+                    parent_hash in published
+                    and rep.chain.tip_hash != parent_hash
+                ):
+                    # We were racing at a tie and just pulled ahead:
+                    # release immediately — the override that wins both.
+                    stats["overrides"] += 1
+                    await publish()
+            else:
+                stats["honest_finds"] += 1
+                blk = await net.mine_on(rep)
+                await attacker._handle_block(blk)
+                if not withheld:
+                    pass  # nothing private: honest block just extends
+                elif attacker.chain.tip_hash == rep.chain.tip_hash:
+                    # The public chain outweighed us: adopt — whatever
+                    # was still withheld died on the abandoned branch.
+                    withheld.clear()
+                else:
+                    lead = attacker.chain.height - rep.chain.height
+                    if lead <= 0:
+                        stats["races"] += 1
+                        await publish()  # tie: race the honest block
+                    elif lead == 1:
+                        stats["overrides"] += 1
+                        await publish()  # one ahead: override outright
+                    else:
+                        await publish(upto_height=rep.chain.height)
+            await asyncio.sleep(find_spacing_s)
+
+        # Finale: release anything still private, settle, and let one
+        # fresh honest block break any residual tie mesh-wide.
+        if withheld:
+            await publish()
+        await asyncio.sleep(5.0)
+        b = await net.mine_on(rep, spacing_s=2.0)
+        await attacker._handle_block(b)
+        settled = await net.run_until(
+            net.converged, 120, step=0.25, wall_limit_s=wall_limit_s
+        )
+
+        chain = rep.chain
+        revenue = {"attacker": 0, "honest": 0}
+        for h in range(warmup_height + 1, chain.height + 1):
+            block = chain._block_at(chain.main_hash_at(h))
+            who = block.txs[0].recipient
+            revenue["attacker" if who == ATTACKER else "honest"] += 1
+        total = revenue["attacker"] + revenue["honest"]
+        share = revenue["attacker"] / max(1, total)
+        actual_alpha = stats["attacker_finds"] / max(1, finds)
+        # Bound against the REALIZED hashrate fraction (the seeded
+        # draw), not the nominal alpha: the claim is about strategy
+        # amplification, not sampling noise.
+        bound = actual_alpha * amplification_bound + margin
+        mesh_reorgs = sum(
+            net.nodes[h].metrics.reorgs for h in hosts
+        )
+        report = _report(
+            net, "selfish-mining", t0,
+            alpha=alpha,
+            actual_alpha=round(actual_alpha, 4),
+            finds=finds,
+            **stats,
+            attacker_blocks_on_chain=revenue["attacker"],
+            honest_blocks_on_chain=revenue["honest"],
+            attacker_revenue_share=round(share, 4),
+            honest_revenue_share=round(1 - share, 4),
+            revenue_share_bound=round(bound, 4),
+            containment_held=share <= bound,
+            honest_mesh_reorgs=mesh_reorgs,
+            settled=settled,
+        )
+        report["ok"] = bool(
+            settled
+            and report["converged"]
+            and report["ledger_conserved"]
+            and report["containment_held"]
+            # The attack must actually have run, or the containment
+            # claim is vacuous: private blocks were withheld, and at
+            # least one override forced honest nodes through a reorg.
+            and stats["withheld_blocks"] > 0
+            and stats["overrides"] >= 1
+            and mesh_reorgs >= 1
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
+# -- fee-spam economics vs the governor ----------------------------------
+
+
+def fee_spam(
+    nodes: int = 10,
+    spammers: int = 3,
+    honest_txs: int = 18,
+    seed: int = 0,
+    difficulty: int = 8,
+    spam_fee: int = 0,
+    honest_fee: int = 2,
+    spam_rate_per_s: float = 120.0,
+    storm_vs: float = 45.0,
+    block_every_vs: float = 5.0,
+    max_block_txs: int = 8,
+    confirm_bound_blocks: int = 4,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """Fee-market spam against the PR-4 governor: ``spammers`` hosts
+    each fund ONE wallet with a single coinbase (the spend limit — spam
+    must be protocol-valid, and validity costs balance), then stream TX
+    frames at ``spam_rate_per_s`` — real signed zero/low-fee transfers,
+    replayed and over-extended past the balance — at honest nodes,
+    while an honest wallet submits ``honest_txs`` normal-fee transfers
+    and miners keep producing small blocks (``max_block_txs`` squeezes
+    capacity so ordering matters).
+
+    The layered defense under test, measured separately: the
+    governor's per-peer tx budget drops the firehose at the dispatch
+    door (and escalates to a ban), pool admission's balance/debit
+    accounting caps what one funded wallet can ever occupy, and
+    fee-ordered block selection seats honest transactions first.
+
+    ok = the never-starved invariant — EVERY honest transaction
+    confirms within ``confirm_bound_blocks`` blocks of submission —
+    plus: the spam genuinely pressured the door (admission drops > 0),
+    the spend limit held (mined spam ≤ what the spam balance affords),
+    and the mesh converged with the ledger conserved.
+    ``confirm_bound_blocks=0`` is the impossible-bound control."""
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import BLOCK_REWARD, Transaction
+    from p1_tpu.node import protocol
+    from p1_tpu.node.governor import CLASS_TXS
+
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    spam_wallets = [
+        Keypair.from_seed_text(f"p1-spam-{seed}-{k}") for k in range(spammers)
+    ]
+    honest_wallet = Keypair.from_seed_text(f"p1-honest-{seed}")
+    payee = Keypair.from_seed_text(f"p1-payee-{seed}")
+
+    async def main():
+        rng = random.Random(seed ^ 0xFEE5)
+        for i in range(nodes):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)],
+                max_block_txs=max_block_txs,
+                miner_id="pool",
+            )
+        hosts = list(net.nodes)
+        miner = net.nodes[hosts[0]]
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        # Funding: one coinbase per spam wallet (THE spend limit), two
+        # for the honest wallet — by mining blocks whose coinbase pays
+        # each wallet directly.
+        for w in (*spam_wallets, honest_wallet, honest_wallet):
+            miner.miner_id = w.account
+            await net.mine_on(miner, spacing_s=1.0)
+        miner.miner_id = "pool"
+        fund_height = miner.chain.height
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == fund_height,
+            60, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged post-funding"
+
+        genesis = genesis_hash(difficulty)
+        spam_budget = spammers * BLOCK_REWARD
+
+        async def spam(k: int) -> dict:
+            """One spammer host: HELLO, then a TX firehose — its funded
+            set first, then replays and beyond-balance extensions."""
+            srng = random.Random(seed * 91 + k)
+            wallet = spam_wallets[k]
+            victim = hosts[(k + 1) % len(hosts)]
+            src = f"66.7.0.{k}"
+            # Twice the affordable set: the second half is guaranteed
+            # over-balance (amount 1 + fee over a BLOCK_REWARD budget).
+            txs = [
+                Transaction.transfer(
+                    wallet, payee.account, 1, spam_fee, s, chain=genesis
+                )
+                for s in range(2 * BLOCK_REWARD)
+            ]
+            frames = [protocol.encode_tx(tx) for tx in txs]
+            sent = dropped = 0
+            deadline = net.clock.now + storm_vs
+            try:
+                reader, writer = await net.net.host(src).connect(
+                    victim, NODE_PORT
+                )
+                await protocol.write_frame(
+                    writer,
+                    protocol.encode_hello(
+                        protocol.Hello(
+                            genesis, 0, 1, srng.getrandbits(64) | 1
+                        )
+                    ),
+                )
+                await protocol.read_frame(reader)
+                i = 0
+                while net.clock.now < deadline:
+                    if writer.is_closing():
+                        dropped = 1  # the ban layer severed the session
+                        break
+                    await protocol.write_frame(writer, frames[i % len(frames)])
+                    sent += 1
+                    i += 1
+                    await asyncio.sleep(1.0 / spam_rate_per_s)
+                writer.close()
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                dropped = 1  # governor escalation severed / refused us
+            return {"sent": sent, "severed": dropped}
+
+        async def honest_traffic() -> list[dict]:
+            """The honest wallet: normal-fee transfers via its node's
+            submit API, spread over the storm."""
+            rows = []
+            gap = storm_vs / (honest_txs + 1)
+            for _ in range(honest_txs):
+                await asyncio.sleep(gap)
+                node = net.nodes[hosts[2]]
+                acct = honest_wallet.account
+                seqno = node.mempool.pending_next_seq(
+                    acct, node.chain.nonce(acct)
+                )
+                tx = Transaction.transfer(
+                    honest_wallet, payee.account, 1, honest_fee, seqno,
+                    chain=genesis,
+                )
+                await node.submit_tx(tx)
+                rows.append(
+                    {
+                        "txid": tx.txid(),
+                        "submitted_vs": net.clock.now,
+                        "submitted_height": miner.chain.height,
+                    }
+                )
+            return rows
+
+        async def mining() -> int:
+            blocks = 0
+            while net.clock.now < t_storm0 + storm_vs + 2 * block_every_vs:
+                await asyncio.sleep(block_every_vs)
+                await net.mine_on(miner)
+                blocks += 1
+            return blocks
+
+        t_storm0 = net.clock.now
+        spam_results, honest_rows, blocks_mined = (
+            await asyncio.gather(
+                asyncio.gather(*(spam(k) for k in range(spammers))),
+                honest_traffic(),
+                mining(),
+            )
+        )
+        # Post-storm: drain any honest stragglers with a few clean
+        # blocks, then settle.
+        for _ in range(confirm_bound_blocks or 1):
+            await net.mine_on(miner, spacing_s=1.0)
+        settled = await net.run_until(
+            net.converged, 120, step=0.25, wall_limit_s=wall_limit_s
+        )
+
+        chain = miner.chain
+        confirmed = []
+        for row in honest_rows:
+            bhash = chain._tx_index.get(row["txid"])
+            if bhash is not None:
+                confirmed.append(
+                    chain.height_of(bhash) - row["submitted_height"]
+                )
+        spam_mined = 0
+        spam_accounts = {w.account for w in spam_wallets}
+        for h in range(fund_height + 1, chain.height + 1):
+            for tx in chain._block_at(chain.main_hash_at(h)).txs[1:]:
+                if tx.sender in spam_accounts:
+                    spam_mined += 1
+        door_drops = sum(
+            net.nodes[h].governor.admission_drops[CLASS_TXS] for h in hosts
+        )
+        spam_sent = sum(r["sent"] for r in spam_results)
+        # Escalation reached the misbehavior layer: spam hosts scored
+        # (and, transiently, banned — the 30 s ban itself expires).
+        spam_scored = sum(
+            1
+            for k in range(spammers)
+            if any(
+                f"66.7.0.{k}" in net.nodes[h]._violations for h in hosts
+            )
+        )
+        report = _report(
+            net, "fee-spam", t0,
+            spammers=spammers,
+            spam_frames_sent=spam_sent,
+            spammers_scored=spam_scored,
+            admission_tx_drops=door_drops,
+            spam_txs_mined=spam_mined,
+            spam_budget_txs=spam_budget,
+            blocks_mined_in_storm=blocks_mined,
+            honest_submitted=len(honest_rows),
+            honest_confirmed=len(confirmed),
+            honest_confirm_blocks_max=max(confirmed, default=0),
+            confirm_bound_blocks=confirm_bound_blocks,
+            settled=settled,
+        )
+        report["ok"] = bool(
+            settled
+            and report["converged"]
+            and report["ledger_conserved"]
+            # Never starved: every honest tx confirmed, within bound.
+            and len(confirmed) == len(honest_rows)
+            and (max(confirmed, default=0) <= confirm_bound_blocks)
+            # The flood was real (the door dropped frames) and the
+            # spend limit held (mined spam within the funded budget).
+            and door_drops > 0
+            and spam_mined <= spam_budget
+            and spam_sent > spam_budget
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
+# -- difficulty-retarget oscillation under hashrate shocks ---------------
+
+
+def retarget_shock(
+    nodes: int = 8,
+    seed: int = 0,
+    difficulty: int = 8,
+    window: int = 8,
+    spacing: int = 8,
+    warm_windows: int = 2,
+    shock_factor: int = 8,
+    shock_windows: int = 4,
+    recovery_windows: int = 10,
+    overshoot_bound_bits: int | None = None,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """A hashrate step against the retarget rule, at mesh level: the
+    chain runs an opt-in ``RetargetRule(window, spacing)``; the
+    scenario drives block finds at the interval the CURRENT difficulty
+    and a stepped hashrate imply (``spacing * 2^(d - d0) / h`` — a
+    ``shock_factor`` x hashrate jump finds blocks that much faster
+    until difficulty catches up), holds the shock for
+    ``shock_windows``, then drops the hashrate back.
+
+    The oscillation question is whether the clamp
+    (core/retarget.py ``adjusted``: at most ``max_adjust`` bits per
+    retarget) bounds the overshoot.  ok asserts, from the sealed
+    headers every node converged on: (a) every retarget moved at most
+    ``max_adjust`` bits — the clamp held THROUGH assembly and
+    validation, not just in the unit rule; (b) peak difficulty never
+    exceeded the shock equilibrium ``d0 + log2(shock_factor)`` by more
+    than ``overshoot_bound_bits`` (default: ``max_adjust``); (c) the
+    DOWNWARD swing is clamp-bounded too — a shock deep enough to hit
+    the ``max_step`` timestamp cap leaves the chain clock lagging the
+    wall, and the catch-up reads as inflated spans that drag
+    difficulty BELOW base on the way back (the oscillation this
+    scenario exists to measure): the undershoot must stay within
+    ``max_adjust`` bits of base; (d) the rule actually responded
+    (peak ≥ 2 bits over base — the load-bearing control;
+    ``overshoot_bound_bits=-3`` is the impossible-bound control test);
+    (e) after recovery the difficulty returns to within one bit of
+    base and holds for the final window.  tests/test_retarget.py pins
+    the same clamp at the unit level (the satellite)."""
+    import math
+
+    from p1_tpu.core.retarget import RetargetRule
+
+    rule = RetargetRule(window, spacing)
+    if overshoot_bound_bits is None:
+        overshoot_bound_bits = rule.max_adjust
+    shock_bits = round(math.log2(shock_factor))
+    base_difficulty = difficulty
+    net = SimNet(seed=seed, difficulty=base_difficulty)
+    t0 = time.monotonic()
+
+    async def main():
+        rng = random.Random(seed ^ 0x4E7A)
+        for i in range(nodes):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)],
+                retarget_window=window,
+                target_spacing=spacing,
+            )
+        hosts = list(net.nodes)
+        miner = net.nodes[hosts[0]]
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+
+        phases = (
+            [1] * (warm_windows * window)
+            + [shock_factor] * (shock_windows * window)
+            + [1] * (recovery_windows * window)
+        )
+        for h_rate in phases:
+            d = miner.chain.required_difficulty(miner.chain.tip_hash)
+            dt = spacing * (2.0 ** (d - base_difficulty)) / h_rate
+            await net.mine_on(miner, spacing_s=dt)
+        final_height = len(phases)
+        settled = await net.run_until(
+            lambda: net.converged() and min(net.heights()) == final_height,
+            180, step=0.25, wall_limit_s=wall_limit_s,
+        )
+
+        chain = miner.chain
+        series = [
+            chain._block_at(chain.main_hash_at(h)).header.difficulty
+            for h in range(1, chain.height + 1)
+        ]
+        deltas = [
+            series[i] - series[i - 1] for i in range(1, len(series))
+        ]
+        clamp_held = all(abs(d) <= rule.max_adjust for d in deltas)
+        peak = max(series)
+        trough = min(series[warm_windows * window :])
+        eq_shock = base_difficulty + shock_bits
+        tail = series[-window:]
+        report = _report(
+            net, "retarget-shock", t0,
+            window=window,
+            spacing=spacing,
+            max_adjust=rule.max_adjust,
+            shock_factor=shock_factor,
+            difficulty_series=series,
+            base_difficulty=base_difficulty,
+            peak_difficulty=peak,
+            trough_difficulty=trough,
+            shock_equilibrium=eq_shock,
+            overshoot_bits=peak - eq_shock,
+            undershoot_bits=base_difficulty - trough,
+            overshoot_bound_bits=overshoot_bound_bits,
+            retarget_clamp_held=clamp_held,
+            responded=peak >= base_difficulty + 2,
+            recovered=max(tail) <= base_difficulty + 1
+            and min(tail) >= max(1, base_difficulty - 1),
+            settled=settled,
+        )
+        report["ok"] = bool(
+            settled
+            and report["converged"]
+            and report["ledger_conserved"]
+            and clamp_held
+            and report["responded"]
+            and peak - eq_shock <= overshoot_bound_bits
+            and base_difficulty - trough <= rule.max_adjust
+            and report["recovered"]
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
+# -- snapshot cartel ------------------------------------------------------
+
+
+def snapshot_cartel(
+    nodes: int = 12,
+    cartel: int = 3,
+    joiners: int = 2,
+    chain_blocks: int = 10,
+    liar_height: int = 8,
+    interval: int = 4,
+    seed: int = 0,
+    difficulty: int = 8,
+    honest_extra_blocks: int = 4,
+    verdict_timeout_vs: float = 300.0,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """Coordinated lying-snapshot servers vs the PR-9 divergence
+    machinery: ``cartel`` hostile peers serve the SAME internally
+    consistent lying snapshot (one shared fork with forged balances,
+    its HELLO advertising a far-ahead tip), and every joiner's peer
+    list puts
+    the whole cartel ahead of its one honest contact — so snapshot
+    failover lands on another liar telling the same story.
+
+    The containment path under test: each joiner adopts a cartel
+    snapshot (ASSUMED — the cartel's HELLO advertises a far-ahead tip
+    so its snapshot out-bids the honest mesh's), background
+    revalidation replays the cartel's own history, the state root
+    refuses to reproduce → divergence → quarantine + server demotion →
+    genesis IBD onto the honest chain.
+    The cartel's fork is a VALID chain but carries LESS work than the
+    honest one (``liar_height < chain_blocks``) — deliberately: a
+    "cartel" whose fork outweighs the honest chain is a majority-work
+    attacker, and no snapshot machinery can (or should) overrule the
+    heaviest-chain rule against majority work.  What the snapshot
+    plane owes is exactly this: lying STATE never survives, no matter
+    how many coordinated servers repeat it.
+
+    ok = every joiner saw ≥1 divergence and 0 flips, ended
+    fully-validated on the honest tip (fooled == 0), the honest mesh
+    RETAINED ITS OWN HISTORY (the pre-join block at ``chain_blocks``
+    is still every node's main chain — the capture detector), and the
+    mesh converged with the ledger conserved.  The control test hands
+    the cartel a heavier fork (``liar_height > chain_blocks`` with
+    ``honest_extra_blocks=0``): the mesh is captured, the history
+    anchor breaks, and ok goes false — proving the assertion detects
+    exactly the takeover it exists to catch."""
+    from p1_tpu.node.testing import FaultPlan, HostilePeer, make_blocks
+
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+
+    async def main():
+        rng = random.Random(seed ^ 0xCA47)
+        for i in range(nodes):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)],
+                snapshot_interval=interval,
+            )
+        hosts = list(net.nodes)
+        miner = net.nodes[hosts[0]]
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        for _ in range(chain_blocks):
+            await net.mine_on(miner, spacing_s=1.0)
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == chain_blocks,
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged pre-join"
+        honest_anchor = miner.chain.main_hash_at(chain_blocks)
+
+        # ONE shared lying chain: the cartel's consistency is the
+        # attack — a joiner that fails over cross-checks nothing.
+        lying_chain = make_blocks(
+            liar_height, difficulty, miner_id="cartel"
+        )
+        servers = []
+        for k in range(cartel):
+            src = f"66.9.9.{k}"
+            hp = HostilePeer(
+                lying_chain,
+                # Lying is free: the cartel advertises a far-ahead tip
+                # (so joiners prefer its snapshot over the honest
+                # mesh's) while serving its short fork and the forged
+                # state — the snapshot plane must catch the STATE lie
+                # regardless of what the HELLO claimed.
+                plan=FaultPlan(
+                    snapshot_lie="balance",
+                    hello_height=chain_blocks + 16,
+                ),
+                transport=net.net.host(src),
+                host=src,
+                rng=random.Random(seed * 37 + k),
+            )
+            await hp.start()
+            servers.append(hp)
+
+        joined = []
+        for j in range(joiners):
+            peers = [
+                f"{hp.host}:{hp.port}" for hp in servers
+            ] + [hosts[j % len(hosts)]]
+            node = await net.add_node(
+                name=f"10.99.8.{j}",
+                peers=peers,
+                snapshot_sync=True,
+                snapshot_min_lead=2,
+                snapshot_interval=interval,
+            )
+            joined.append(node)
+            await asyncio.sleep(1.0)
+
+        verdicts = await net.run_until(
+            lambda: all(
+                n.validation_state == "validated" and n._bg_chain is None
+                for n in joined
+            ),
+            verdict_timeout_vs, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        # Honest hashrate outruns the cartel's static fork.
+        for _ in range(honest_extra_blocks):
+            await net.mine_on(miner, spacing_s=1.0)
+        settled = await net.run_until(
+            net.converged, 180, step=0.25, wall_limit_s=wall_limit_s
+        )
+
+        honest_tip = miner.chain.tip_hash
+        history_kept = all(
+            net.nodes[h].chain.main_hash_at(chain_blocks) == honest_anchor
+            for h in hosts
+        )
+        fooled = sum(
+            1
+            for n in joined
+            if n.chain.tip_hash != honest_tip
+            or n.validation_state != "validated"
+        )
+        divergences = sum(
+            n.metrics.snapshot_divergences for n in joined
+        )
+        flips = sum(n.metrics.snapshot_flips for n in joined)
+        cartel_hosts = {hp.host for hp in servers}
+        cartel_scored = sum(
+            1
+            for n in joined
+            for h in sorted(cartel_hosts)
+            if h in n._violations
+        )
+        report = _report(
+            net, "snapshot-cartel", t0,
+            cartel=cartel,
+            joiners=joiners,
+            liar_height=liar_height,
+            verdicts=verdicts,
+            divergences=divergences,
+            flips=flips,
+            fooled=fooled,
+            cartel_servers_scored=cartel_scored,
+            honest_history_kept=history_kept,
+            honest_extra_blocks=honest_extra_blocks,
+            settled=settled,
+        )
+        report["ok"] = bool(
+            verdicts
+            and settled
+            and report["converged"]
+            and report["ledger_conserved"]
+            and divergences >= joiners
+            and flips == 0
+            and fooled == 0
+            and history_kept
+        )
+        for hp in servers:
+            await hp.stop()
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
 # -- registry / CLI entry ------------------------------------------------
+
+def soak(
+    seed: int = 0,
+    difficulty: int = 8,
+    days: float = 7.0,
+    nodes: int = 5,
+    **kwargs,
+) -> dict:
+    """Longevity soak: ≥1 virtual WEEK of mesh life (node/chaos.py
+    ``longevity_soak``) — steady mining, recurring fault/heal cycles
+    across every injector, wallet traffic — with the leak invariants
+    (RSS, ban tables, caches, task counts, retry counters) asserted at
+    quiesce.  Registered here so `p1 sim soak --seed N` is the one-flag
+    repro like every other scenario."""
+    from p1_tpu.node.chaos import longevity_soak
+
+    return longevity_soak(
+        seed=seed, difficulty=difficulty, days=days, nodes=nodes, **kwargs
+    )
+
 
 SCENARIOS = {
     "partition-heal": partition_heal,
@@ -832,6 +1748,12 @@ SCENARIOS = {
     "eclipse": eclipse,
     "wan": wan,
     "snapshot-join": snapshot_join,
+    "far-field": far_field,
+    "selfish-mining": selfish_mining,
+    "fee-spam": fee_spam,
+    "retarget-shock": retarget_shock,
+    "snapshot-cartel": snapshot_cartel,
+    "soak": soak,
 }
 
 

@@ -538,3 +538,73 @@ class TestRegressions:
             await net.stop_all()
 
         net.run(main())
+
+
+class TestLongevitySoak:
+    """Round-17 tentpole (c): multi-day virtual-time soaks with leak
+    invariants at quiesce.  Tier-1 carries a quarter-day soak (the
+    whole machinery: soak schedule shape, probes, leak checks, RSS
+    gauge); the slow set carries the ≥1-virtual-week acceptance run."""
+
+    def test_quarter_day_soak_green_with_probes(self):
+        r = chaos.longevity_soak(seed=0, nodes=4, days=0.25)
+        assert r["ok"], r["violations"]
+        assert r["scenario"] == "soak"
+        assert r["days_virtual"] == pytest.approx(0.25, abs=0.01)
+        # Both leak probes fired and their gauges rode into the report.
+        assert r["probes"] == 2
+        assert r["leak_gauges"]["mid"] and r["leak_gauges"]["end"]
+        for gauges in r["leak_gauges"]["end"].values():
+            assert {"tasks", "banned", "sig_cache", "retry_counters"} <= set(
+                gauges
+            )
+        # The RSS gauge measured something real.
+        assert r["rss_mb"] is not None and r["rss_mb"] > 0
+        assert r["repro"] == "p1 sim soak --seed 0"
+
+    def test_soak_is_deterministic(self):
+        a = chaos.longevity_soak(seed=2, nodes=4, days=0.2)
+        b = chaos.longevity_soak(seed=2, nodes=4, days=0.2)
+        assert a["trace_digest"] == b["trace_digest"]
+        # rss/wall are the host-side fields; everything else replays.
+        for k in a:
+            if k not in ("wall_s", "rss_mb", "leak_gauges"):
+                assert a[k] == b[k], k
+
+    def test_rss_bound_is_load_bearing(self):
+        r = chaos.longevity_soak(
+            seed=0, nodes=4, days=0.2, rss_bound_mb=0.001
+        )
+        assert not r["ok"]
+        assert any(v["invariant"] == "rss" for v in r["violations"])
+
+    def test_soak_schedule_pairs_every_fault_with_a_clearer(self):
+        events = chaos.generate_soak_schedule(
+            seed=5, n_nodes=5, horizon_vs=7 * chaos.DAY_VS,
+            fault_clusters=28, blocks=336,
+        )
+        assert [e["at"] for e in events] == sorted(e["at"] for e in events)
+        ops = [e["op"] for e in events]
+        # Pairing: nothing disruptive outlives its envelope.
+        assert ops.count("crash") == ops.count("recover")
+        assert ops.count("partition") == ops.count("heal")
+        assert ops.count("disk_fail") == ops.count("disk_heal")
+        assert ops.count("slow_link") == ops.count("restore_link")
+        assert ops.count("hostile") + ops.count("flood") == ops.count("calm")
+        assert ops.count("probe") == 2
+        # And the horizon really is the week asked for.
+        assert events[-1]["at"] >= 7 * chaos.DAY_VS - 1.0
+
+    @pytest.mark.slow
+    def test_one_virtual_week_acceptance_run(self):
+        """ISSUE 14 acceptance: ≥1 virtual week green, leak invariants
+        (RSS gauge, ban tables, retry counters, cache bounds) asserted
+        at quiesce."""
+        r = chaos.longevity_soak(seed=0)
+        assert r["ok"], r["violations"]
+        assert r["days_virtual"] >= 7.0
+        assert r["probes"] == 2
+        assert r["crashes"] >= 1 and r["recoveries"] == r["crashes"]
+        # ~12,000x time compression makes the week a sub-two-minute
+        # test; the wall guard is the regression tripwire.
+        assert r["wall_s"] < 300.0

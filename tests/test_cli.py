@@ -837,6 +837,49 @@ class TestSim:
         assert a["nodes"] == 16
         assert a["trace_digest"] == b["trace_digest"]
 
+    @staticmethod
+    def _sim(*argv, timeout=180):
+        return subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "sim", *argv],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd="/root/repo",
+            env={**os.environ, "PYTHONHASHSEED": "0"},
+        )
+
+    def test_report_repro_stamp_round_trips(self):
+        """Round-17 satellite: every report names its one-flag repro
+        (`p1 sim <name> --seed N`) and re-running exactly that command
+        reproduces the trace digest byte-for-byte, across processes."""
+        first = self._sim("retarget-shock", "--nodes", "5", "--seed", "7")
+        assert first.returncode == 0, first.stderr[-2000:]
+        a = json.loads(first.stdout.strip().splitlines()[-1])
+        assert a["seed"] == 7
+        assert a["repro"] == "p1 sim retarget-shock --seed 7"
+        again = self._sim("retarget-shock", "--nodes", "5", "--seed", "7")
+        b = json.loads(again.stdout.strip().splitlines()[-1])
+        assert b["trace_digest"] == a["trace_digest"]
+
+    def test_far_field_shard_split_is_digest_stable_cross_process(self):
+        """Round-17 acceptance: the far-field merged trace digest does
+        not move across the 1→N shard split, with the N shards as REAL
+        OS processes over the pipe seam, PYTHONHASHSEED pinned."""
+        one = self._sim(
+            "far-field", "--nodes", "400", "--seed", "4", "--shards", "1"
+        )
+        assert one.returncode == 0, one.stderr[-2000:]
+        a = json.loads(one.stdout.strip().splitlines()[-1])
+        sharded = self._sim(
+            "far-field", "--nodes", "400", "--seed", "4", "--shards", "2"
+        )
+        assert sharded.returncode == 0, sharded.stderr[-2000:]
+        b = json.loads(sharded.stdout.strip().splitlines()[-1])
+        assert a["ok"] and b["ok"]
+        assert b["shard_processes"] and not a["shard_processes"]
+        assert a["trace_digest"] == b["trace_digest"]
+        assert a["far_trace_digest"] == b["far_trace_digest"]
+
 
 class TestChaos:
     """`p1 chaos` (round 11): combined-fault schedules over the

@@ -87,6 +87,97 @@ class TestRuleMath:
             RetargetRule(window=4, spacing=10, max_adjust=0)
 
 
+class TestClampUnderStepShocks:
+    """Round-17 satellite: the unit-level pin the retarget-shock
+    scenario's mesh assertion rests on — ``adjusted`` at exact clamp
+    boundaries, and the clamp holding through sustained step-shock
+    span sequences (every per-window move ≤ max_adjust, convergence to
+    the new equilibrium, no runaway in either direction)."""
+
+    def test_exact_upward_boundaries(self):
+        # expected span = 300; the rule moves a bit at span*2^k <= 300.
+        assert RULE.adjusted(10, 151) == 10  # one over the 1-bit edge
+        assert RULE.adjusted(10, 150) == 11  # exactly ON the edge
+        assert RULE.adjusted(10, 76) == 11  # one over the 2-bit edge
+        assert RULE.adjusted(10, 75) == 12  # exactly ON it
+        # Past the max_adjust=2 clamp: 3-bit-deserving spans still get 2.
+        assert RULE.adjusted(10, 37) == 12
+        assert RULE.adjusted(10, 1) == 12
+
+    def test_exact_downward_boundaries(self):
+        assert RULE.adjusted(10, 599) == 10  # one under the 2x edge
+        assert RULE.adjusted(10, 600) == 9  # exactly ON it
+        assert RULE.adjusted(10, 1199) == 9
+        assert RULE.adjusted(10, 1200) == 8  # exactly ON the 4x edge
+        # 8x, 16x, ... still clamp to -2.
+        assert RULE.adjusted(10, 2400) == 8
+        assert RULE.adjusted(10, 1 << 40) == 8
+
+    def test_degenerate_span_floors_at_one_second(self):
+        # span <= 0 must not divide-by-zero or sign-flip the rule.
+        assert RULE.adjusted(10, 0) == 12
+        assert RULE.adjusted(10, -5) == 12
+
+    @staticmethod
+    def _drive(rule, d0, hashrate_by_window):
+        """Pure-function mesh model: each window's observed span is
+        what a steady ``h``-multiple hashrate produces at the window's
+        difficulty (span = expected * 2^(d - d0) / h), fed back
+        through ``adjusted`` — the scenario's dynamics without the
+        mesh."""
+        series = [d0]
+        for h in hashrate_by_window:
+            d = series[-1]
+            span = max(1, round(rule.expected_span * (2.0 ** (d - d0)) / h))
+            series.append(rule.adjusted(d, span))
+        return series
+
+    def test_step_up_shock_converges_within_clamp(self):
+        rule = RetargetRule(window=8, spacing=8)  # max_adjust=2
+        series = self._drive(rule, 10, [8] * 6)
+        # Every per-window move inside the clamp.
+        assert all(
+            abs(b - a) <= rule.max_adjust
+            for a, b in zip(series, series[1:])
+        )
+        # Converged to the +3-bit equilibrium, no overshoot past it.
+        assert series[-1] == 13
+        assert max(series) == 13
+
+    def test_step_down_shock_converges_within_clamp(self):
+        rule = RetargetRule(window=8, spacing=8)
+        series = self._drive(rule, 10, [1 / 8] * 6)
+        assert all(
+            abs(b - a) <= rule.max_adjust
+            for a, b in zip(series, series[1:])
+        )
+        assert series[-1] == 7 and min(series) == 7
+
+    def test_square_wave_never_escapes_the_band(self):
+        # Alternating 8x shocks up and down, many cycles: difficulty
+        # must stay within max_adjust of the two equilibria forever —
+        # bounded oscillation, not resonance.
+        rule = RetargetRule(window=8, spacing=8)
+        wave = ([8] * 4 + [1] * 4) * 6
+        series = self._drive(rule, 10, wave)
+        assert all(
+            abs(b - a) <= rule.max_adjust
+            for a, b in zip(series, series[1:])
+        )
+        assert max(series) <= 13 + rule.max_adjust
+        assert min(series) >= 10 - rule.max_adjust
+
+    def test_clamp_holds_at_the_difficulty_range_edges(self):
+        rule = RetargetRule(window=8, spacing=8)
+        # A sustained crash in hashrate walks down 2 bits per window
+        # and parks at 1 — never 0 (every hash would be valid).
+        series = self._drive(rule, 4, [1 / 1024] * 8)
+        assert series[-1] == 1 and min(series) == 1
+        # And a sustained boom parks at 255.
+        series = self._drive(rule, 252, [1 << 20] * 8)
+        assert series[-1] == 255 and max(series) == 255
+
+
 class TestGenesisCommitment:
     def test_rule_changes_chain_identity(self):
         plain = make_genesis(DIFF)
