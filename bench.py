@@ -419,6 +419,49 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Archive scale (round 18, chain/segstore.py + headerplane.py):
+    # the synthetic segmented-archive probe — whole-archive
+    # packed-header resume rate and the boot-to-serving peak RSS
+    # (benchmarks/archive_scale.py).  The default probe is the 100k
+    # shape (seconds); ``P1_BENCH_ARCHIVE=1`` runs the full 10M
+    # acceptance shape instead (minutes of build + a ~3 GB scratch
+    # store) — the slow ladder docs/PERF.md "Archive scale" records.
+    from p1_tpu.hashx.perf_record import (
+        ARCHIVE_BOOT_RSS_DEGRADED_FACTOR,
+        ARCHIVE_RESUME_DEGRADED_FRACTION,
+        RECORDED_ARCHIVE_BOOT_RSS_MB,
+        RECORDED_ARCHIVE_RESUME_BPS,
+    )
+
+    try:
+        from benchmarks.archive_scale import bench_quick as arch_quick
+
+        ar = arch_quick(
+            blocks=10_000_000
+            if os.environ.get("P1_BENCH_ARCHIVE")
+            else 100_000
+        )
+        extra["archive_blocks"] = ar["blocks"]
+        extra["archive_resume_bps"] = ar["archive_resume_bps"]
+        extra["archive_boot_s"] = ar["archive_boot_s"]
+        extra["archive_boot_rss_mb"] = ar["archive_boot_rss_mb"]
+        extra["archive_query_qps"] = ar["archive_query_qps"]
+        extra["archive_resume_vs_recorded"] = round(
+            ar["archive_resume_bps"] / RECORDED_ARCHIVE_RESUME_BPS, 2
+        )
+        extra["archive_rss_vs_recorded"] = round(
+            ar["archive_boot_rss_mb"] / RECORDED_ARCHIVE_BOOT_RSS_MB, 2
+        )
+        if (
+            ar["archive_resume_bps"]
+            < ARCHIVE_RESUME_DEGRADED_FRACTION * RECORDED_ARCHIVE_RESUME_BPS
+            or ar["archive_boot_rss_mb"]
+            > ARCHIVE_BOOT_RSS_DEGRADED_FACTOR * RECORDED_ARCHIVE_BOOT_RSS_MB
+        ):
+            extra["archive_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Static analysis plane (round 13, p1_tpu/analysis): unsettled
     # finding count (unallowlisted + stale grants — tier-1 holds it at
     # zero, so ANY nonzero here is drift the round record must show)

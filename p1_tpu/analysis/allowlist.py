@@ -144,6 +144,11 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
             "BLOCKS batches preverify signatures (native seam) inline "
             "in the dispatcher — same worker-pool stage as "
             "_handle_block's verify",
+            # Round 18: ``self.store`` is a SegmentedStore or a
+            # ChainStore depending on layout; the binder unifies the
+            # conditional's two constructors to the ChainStore BASE
+            # (callgraph._unify_classes), so every store chain below
+            # stays provable across both layouts.
             "Node._dispatch->os.fsync": "STORE stage: the BLOCKS "
             "batch-sync path syncs the store inline after a quiesced "
             "catch-up episode",
@@ -163,13 +168,24 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
             "Node._adopt_snapshot->open": "snapshot adoption writes "
             "the .snapshot sidecar inline — rare (once per IBD), but "
             "the split's store worker should own sidecar IO too",
-            "Node._snapshot_flip->open": "snapshot flip rewrites the "
-            "store genesis-first on the loop — the heaviest single "
+            "Node._adopt_snapshot->os.fsync": "same sidecar write's "
+            "directory fsync (fsync_dir) — store-worker debt with the "
+            "flip/diverge rewrites below",
+            "Node._snapshot_flip->os.fsync": "snapshot flip rewrites "
+            "the store genesis-first on the loop (save_chain + "
+            "dir-fsync in _rewrite_store) — the heaviest single "
             "blocking window in the node (~seconds at 100k); a "
             "flagship ROADMAP-2 offload",
-            "Node._snapshot_diverged->open": "divergence quarantines "
-            "the sidecar and rewrites the store on the loop — same "
-            "store-worker offload as the flip path",
+            "Node._snapshot_diverged->os.fsync": "divergence "
+            "quarantines the sidecar and rewrites the store on the "
+            "loop — same store-worker offload as the flip path",
+        },
+        "node/queryplane.py": {
+            "serve_replica->open": "replica attach (ReplicaView "
+            "refresh: manifest read + per-segment mmap) runs once at "
+            "worker startup before any session exists; steady-state "
+            "refreshes only stat/remap the tail — stays on-loop by "
+            "design",
         },
     },
     # -- escaped-state (round 16): await-state folded one call level.

@@ -296,8 +296,44 @@ class CallGraph:
                 if typ is None:
                     continue  # neutral: no class information
                 prev = out.get(tgt.attr, typ)
-                out[tgt.attr] = typ if typ == prev else None
+                out[tgt.attr] = self._unify_classes(typ, prev)
         return {k: v for k, v in out.items() if v is not None}
+
+    def _ancestors(self, key: tuple[str, str]) -> list[tuple[str, str]]:
+        """``key`` plus every package-resolvable base, MRO order."""
+        seen: list[tuple[str, str]] = []
+        stack = [key]
+        while stack:
+            cur = stack.pop(0)
+            if cur in seen:
+                continue
+            seen.append(cur)
+            crel, cname = cur
+            for base in self._bases.get(crel, {}).get(cname, ()):
+                bhit = self._class_by_dotted(crel, base)
+                if bhit is not None:
+                    stack.append(bhit)
+        return seen
+
+    def _unify_classes(
+        self,
+        a: tuple[str, str] | None,
+        b: tuple[str, str] | None,
+    ) -> tuple[str, str] | None:
+        """The nearest common ANCESTOR of two bindings, or None when
+        they are unrelated.  Subclass/base pairs unify to the base —
+        the round-18 shape: ``self.store`` is a ``SegmentedStore`` in
+        one branch and a ``ChainStore`` in the other, and every chain
+        the graph can prove goes through the shared base surface."""
+        if a is None or b is None:
+            return None
+        if a == b:
+            return a
+        b_anc = self._ancestors(b)
+        for cand in self._ancestors(a):
+            if cand in b_anc:
+                return cand
+        return None
 
     def _value_class(self, rel: str, value: ast.AST) -> tuple[str, str] | None:
         """(rel, class) when ``value`` is structurally a constructor
@@ -314,7 +350,14 @@ class CallGraph:
                 for h in (self._value_class(rel, v) for v in operands)
                 if h is not None
             }
-            return hits.pop() if len(hits) == 1 else None
+            if not hits:
+                return None
+            merged = hits.pop()
+            for h in hits:
+                merged = self._unify_classes(merged, h)
+                if merged is None:
+                    return None
+            return merged
         if not isinstance(value, ast.Call):
             return None
         dotted = dotted_name(value.func)

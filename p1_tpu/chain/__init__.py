@@ -26,14 +26,28 @@ from p1_tpu.chain.snapshot import (
     state_root,
     write_snapshot,
 )
+from p1_tpu.chain.headerplane import (
+    ArchiveChain,
+    HeaderPlane,
+    SegmentIndex,
+    write_segment_index,
+)
+from p1_tpu.chain.segstore import SegmentedStore, is_segmented, open_store
 from p1_tpu.chain.store import ChainStore, save_chain
 from p1_tpu.chain.validate import ValidationError, check_block
 
 __all__ = [
     "AddResult",
     "AddStatus",
+    "ArchiveChain",
     "Chain",
     "ChainStore",
+    "HeaderPlane",
+    "SegmentIndex",
+    "SegmentedStore",
+    "is_segmented",
+    "open_store",
+    "write_segment_index",
     "FilterIndex",
     "LedgerSnapshot",
     "ProofCache",

@@ -234,6 +234,13 @@ class Chain:
         self._tx_index: dict[bytes, bytes] = {
             tx.txid(): ghash for tx in self.genesis.txs
         }
+        #: Pruned operation (round 18, chain/segstore.py): main-chain
+        #: heights BELOW this have had their on-disk bodies discarded.
+        #: Headers and every index structure stay; body-dependent
+        #: serving (deep proofs, filter rebuilds, block sync into the
+        #: pruned range) gates on ``body_available`` instead of
+        #: assuming a refetch can always succeed.  0 = archive node.
+        self.prune_floor = 0
         #: Serving plane (round 9).  ``proof_cache`` memoizes the
         #: reorg-stable part of inclusion proofs, filled a whole block at
         #: a time (one merkle tree amortized over every tx in the block)
@@ -336,6 +343,22 @@ class Chain:
 
     def height_of(self, block_hash: bytes) -> int:
         return self._index[block_hash].height
+
+    def body_available(self, block_hash: bytes) -> bool:
+        """True when ``_block_at`` can actually produce this block's
+        body — resident in RAM, or durably refetchable from the body
+        source.  The gate body-dependent serving consults on a pruned
+        node: an evicted body whose segment was discarded is headers-
+        only forever, and asking for it must be a clean refusal, not a
+        KeyError out of the span map."""
+        entry = self._index.get(block_hash)
+        if entry is None:
+            return False
+        if entry.block is not None:
+            return True
+        return self.body_source is not None and self.body_source.has_body(
+            block_hash
+        )
 
     def best_block_within(self, ts_bound: int) -> Block:
         """The most-work block (main chain or branch) whose timestamp is
@@ -469,6 +492,11 @@ class Chain:
         cached = self.proof_cache.get(bhash, txid)
         if cached is not None:
             return cached
+        if not self.body_available(bhash):
+            # Pruned range (or a read-failed segment): the body this
+            # proof's merkle tree needs is gone — refuse cleanly, the
+            # same answer an unconfirmed txid gets.
+            return None
         # Miss: build every proof for the containing block at once —
         # requests cluster by block (a wallet checking a payment batch,
         # a reorg re-audit), so the amortized fill is the common win.
@@ -500,6 +528,11 @@ class Chain:
         evicted, store-refetchable) body for deep history."""
         if block_hash not in self._index:
             return None
+        cached = self.filter_index.get(block_hash)
+        if cached is not None:
+            return cached
+        if not self.body_available(block_hash):
+            return None  # pruned body and no cached filter: refuse
         return self.filter_index.get_or_build(block_hash, self._block_at)
 
     def main_hash_at(self, height: int) -> bytes | None:
@@ -589,19 +622,38 @@ class Chain:
         one, then exponentially spaced — the classic sync locator shape."""
         return locator_hashes(self._main_hashes, dense)
 
+    def sync_start_height(self, locator: list[bytes]) -> int:
+        """The height a GETBLOCKS reply would start at for ``locator``
+        — the first hash we recognize on the main chain, plus one.
+        Split out so the node can price a request against its prune
+        floor BEFORE touching any block body."""
+        for h in locator:
+            entry = self._index.get(h)
+            if entry and self._on_main_chain(h):
+                return entry.height + 1
+        return self.base_height
+
+    def headers_after(
+        self, locator: list[bytes], limit: int = 500
+    ) -> list[BlockHeader]:
+        """Main-chain HEADERS after the first recognized locator hash —
+        the body-free sibling of ``blocks_after`` (headers are always
+        resident, so serving a headers-first sync never costs a body
+        refetch and keeps working over pruned ranges)."""
+        start = self.sync_start_height(locator) - self.base_height
+        end = min(start + limit, len(self._main_hashes))
+        return [
+            self._index[self._main_hashes[i]].header
+            for i in range(start, end)
+        ]
+
     def blocks_after(self, locator: list[bytes], limit: int = 500) -> list[Block]:
         """Main-chain blocks after the first locator hash we recognize.
 
         O(limit) per call: served straight from the height index instead of
         materializing the whole main chain (which made a full peer sync
         O(height²/batch))."""
-        start_height = self.base_height
-        for h in locator:
-            entry = self._index.get(h)
-            if entry and self._on_main_chain(h):
-                start_height = entry.height + 1
-                break
-        start = start_height - self.base_height
+        start = self.sync_start_height(locator) - self.base_height
         end = min(start + limit, len(self._main_hashes))
         return [
             self._block_at(self._main_hashes[i]) for i in range(start, end)

@@ -159,18 +159,40 @@ class ChainStore:
         self._read_fd: int | None = None
 
     # -- file-layer seams (FaultStore overrides these) --------------------
+    #
+    # The no-arg seams are the historical single-file surface; each
+    # routes through a ``*_path`` seam taking an explicit path so the
+    # segmented store (chain/segstore.py), whose appends land in
+    # per-segment files, injects through the SAME fault plane — one
+    # FaultStore shim covers both layouts.
 
     def _open_fh(self):
-        return open(self.path, "a+b")  # "a": every write appends
+        return self._open_fh_path(self.path)
+
+    def _open_fh_path(self, path):
+        return open(path, "a+b")  # "a": every write appends
 
     def _fsync_file(self, fh) -> None:
         os.fsync(fh.fileno())
 
     def _fsync_dir(self) -> None:
-        fsync_dir(self.path.parent)
+        self._fsync_dir_path(self.path.parent)
+
+    def _fsync_dir_path(self, path) -> None:
+        fsync_dir(path)
 
     def _read_bytes(self) -> bytes:
-        return self.path.read_bytes()
+        return self._read_bytes_path(self.path)
+
+    def _read_bytes_path(self, path) -> bytes:
+        return Path(path).read_bytes()
+
+    def _pread(self, fd: int, n: int, off: int) -> bytes:
+        """The body-refetch read seam (``read_body``/``iter_blocks``):
+        per-call so the fault harness can model a sector going EIO
+        under a live serve — the segmented store's per-segment
+        degradation case."""
+        return os.pread(fd, n, off)
 
     # -- writer lifecycle -------------------------------------------------
 
@@ -293,7 +315,10 @@ class ChainStore:
     def quarantine_path(self) -> Path:
         return self.path.with_name(self.path.name + ".quarantine")
 
-    def append(self, block: Block) -> None:
+    def append(self, block: Block, height: int | None = None) -> None:
+        """Append one record.  ``height`` is an optional hint for
+        layouts that track height spans (the segmented store's
+        manifest); the single-file log ignores it."""
         self.acquire()
         if self.last_scan is not None and self.last_scan.version == 2:
             # allow_v2 admits readers and rewriters, never appenders: a
@@ -505,7 +530,7 @@ class ChainStore:
         if self._read_fd is None:
             self._read_fd = os.open(self.path, os.O_RDONLY)
         for off, n in spans:
-            raw = os.pread(self._read_fd, n, off)
+            raw = self._pread(self._read_fd, n, off)
             if len(raw) != n:
                 raise OSError(f"{self.path}: short record read at {off}")
             block = Block.deserialize(raw)
@@ -574,7 +599,7 @@ class ChainStore:
         off, n = span >> _SPAN_SHIFT, span & ((1 << _SPAN_SHIFT) - 1)
         if self._read_fd is None:
             self._read_fd = os.open(self.path, os.O_RDONLY)
-        raw = os.pread(self._read_fd, n, off)
+        raw = self._pread(self._read_fd, n, off)
         if len(raw) != n:
             raise OSError(f"{self.path}: short body read at {off}")
         block = Block.deserialize(raw)

@@ -31,8 +31,9 @@ import errno
 import os
 
 from p1_tpu.chain.store import ChainStore
+from p1_tpu.chain.segstore import SegmentedStore
 
-__all__ = ["StoreFaultPlan", "FaultStore", "append_soak"]
+__all__ = ["StoreFaultPlan", "FaultStore", "SegFaultStore", "append_soak"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,6 +59,11 @@ class StoreFaultPlan:
     #: every read — the disk holds good bytes, the process sees bad ones.
     flip_read_at: int | None = None
     flip_mask: int = 0x01
+    #: Persistent: every body-refetch pread from the Nth on raises
+    #: ``pread_errno`` until ``clear_faults()`` — a sector (or a whole
+    #: segment file) going EIO under a live serve.
+    fail_preads_from: int | None = None
+    pread_errno: int = errno.EIO
 
 
 class _FaultFile:
@@ -101,7 +107,71 @@ class _FaultFile:
         return self._fh.closed
 
 
-class FaultStore(ChainStore):
+class _FaultSeams:
+    """The shimmed file layer, shared by the single-file ``FaultStore``
+    and the segmented ``SegFaultStore``: both stores route every file
+    open / fsync / dir-fsync / whole-file read through the ``*_path``
+    seams (chain/store.py), so ONE shim covers both layouts — a plan's
+    write counter ticks across segment boundaries exactly as it ticks
+    across records in one file."""
+
+    def _init_faults(self, plan: StoreFaultPlan | None) -> None:
+        self.plan = plan if plan is not None else StoreFaultPlan()
+        self.writes = 0
+        self.fsyncs = 0
+        self.dir_fsyncs = 0
+        self.reads = 0
+        self.events: list[str] = []
+
+    def clear_faults(self) -> None:
+        """Lift every injected fault (the disk 'recovered')."""
+        self.plan = StoreFaultPlan()
+
+    # -- shimmed file-layer seams -----------------------------------------
+
+    def _open_fh_path(self, path):
+        return _FaultFile(super()._open_fh_path(path), self)
+
+    def _fsync_file(self, fh) -> None:
+        self.fsyncs += 1
+        self.events.append("fsync")
+        if self.plan.fail_fsync_at == self.fsyncs:
+            raise OSError(
+                self.plan.fsync_errno, os.strerror(self.plan.fsync_errno)
+            )
+        os.fsync(fh.fileno())
+
+    def _fsync_dir_path(self, path) -> None:
+        self.dir_fsyncs += 1
+        self.events.append("dir_fsync")
+        if self.plan.fail_dir_fsync_at == self.dir_fsyncs:
+            raise OSError(
+                self.plan.fsync_errno, os.strerror(self.plan.fsync_errno)
+            )
+        super()._fsync_dir_path(path)
+
+    def _read_bytes_path(self, path) -> bytes:
+        self.reads += 1
+        data = super()._read_bytes_path(path)
+        plan = self.plan
+        if plan.flip_read_at is not None and plan.flip_read_at < len(data):
+            buf = bytearray(data)
+            buf[plan.flip_read_at] ^= plan.flip_mask
+            data = bytes(buf)
+        return data
+
+    def _pread(self, fd: int, n: int, off: int) -> bytes:
+        self.preads = getattr(self, "preads", 0) + 1
+        plan = self.plan
+        if (
+            plan.fail_preads_from is not None
+            and self.preads >= plan.fail_preads_from
+        ):
+            raise OSError(plan.pread_errno, os.strerror(plan.pread_errno))
+        return super()._pread(fd, n, off)
+
+
+class FaultStore(_FaultSeams, ChainStore):
     """A ``ChainStore`` with an unreliable disk, per a ``StoreFaultPlan``.
 
     Usage::
@@ -125,53 +195,34 @@ class FaultStore(ChainStore):
         fsync: bool = True,
     ):
         super().__init__(path, fsync=fsync)
-        self.plan = plan if plan is not None else StoreFaultPlan()
-        self.writes = 0
-        self.fsyncs = 0
-        self.dir_fsyncs = 0
-        self.reads = 0
-        self.events: list[str] = []
+        self._init_faults(plan)
 
-    def clear_faults(self) -> None:
-        """Lift every injected fault (the disk 'recovered')."""
-        self.plan = StoreFaultPlan()
 
-    # -- shimmed file-layer seams -----------------------------------------
+class SegFaultStore(_FaultSeams, SegmentedStore):
+    """A ``SegmentedStore`` with the same unreliable disk: faults land
+    on whichever SEGMENT the store touches (appends, rolls, per-segment
+    scans), which is how the round-7 fault families port to segment
+    boundaries — e.g. ``fail_write_at`` aimed one past the roll point
+    tears the FIRST record of a fresh segment.  Manifest writes ride
+    the plain heal plane (atomic tmp+rename), like the base heal."""
 
-    def _open_fh(self):
-        return _FaultFile(super()._open_fh(), self)
-
-    def _fsync_file(self, fh) -> None:
-        self.fsyncs += 1
-        self.events.append("fsync")
-        if self.plan.fail_fsync_at == self.fsyncs:
-            raise OSError(
-                self.plan.fsync_errno, os.strerror(self.plan.fsync_errno)
-            )
-        os.fsync(fh.fileno())
-
-    def _fsync_dir(self) -> None:
-        self.dir_fsyncs += 1
-        self.events.append("dir_fsync")
-        if self.plan.fail_dir_fsync_at == self.dir_fsyncs:
-            raise OSError(
-                self.plan.fsync_errno, os.strerror(self.plan.fsync_errno)
-            )
-        super()._fsync_dir()
-
-    def _read_bytes(self) -> bytes:
-        self.reads += 1
-        data = super()._read_bytes()
-        plan = self.plan
-        if plan.flip_read_at is not None and plan.flip_read_at < len(data):
-            buf = bytearray(data)
-            buf[plan.flip_read_at] ^= plan.flip_mask
-            data = bytes(buf)
-        return data
+    def __init__(
+        self,
+        path,
+        plan: StoreFaultPlan | None = None,
+        fsync: bool = True,
+        segment_bytes: int = 1 << 16,
+    ):
+        super().__init__(path, fsync=fsync, segment_bytes=segment_bytes)
+        self._init_faults(plan)
 
 
 def append_soak(
-    path, n_blocks: int = 24, difficulty: int = 12, delay_s: float = 0.0
+    path,
+    n_blocks: int = 24,
+    difficulty: int = 12,
+    delay_s: float = 0.0,
+    segment_bytes: int = 0,
 ) -> None:
     """Subprocess driver for the kill-9 crash soak: (re)open the store at
     ``path`` and append the DETERMINISTIC ``make_blocks`` chain from
@@ -188,7 +239,12 @@ def append_soak(
     from p1_tpu.node.testing import make_blocks
 
     blocks = make_blocks(n_blocks, difficulty=difficulty)
-    store = ChainStore(path)
+    if segment_bytes > 0:
+        # The segmented variant of the same soak: tiny segments put the
+        # random kill INSIDE roll boundaries, not just appends.
+        store = SegmentedStore(path, segment_bytes=segment_bytes)
+    else:
+        store = ChainStore(path)
     store.acquire()
     try:
         done = len(store.load_blocks())
@@ -208,4 +264,5 @@ if __name__ == "__main__":  # the crash-soak child: append until killed
         int(sys.argv[2]),
         int(sys.argv[3]),
         float(sys.argv[4]) if len(sys.argv) > 4 else 0.0,
+        int(sys.argv[5]) if len(sys.argv) > 5 else 0,
     )

@@ -219,16 +219,30 @@ def run_snapshot(
     return 0
 
 
-def run_compact(store_path: str, out_path: str | None, retarget=None) -> int:
+def run_compact(
+    store_path: str,
+    out_path: str | None,
+    retarget=None,
+    store_cls=None,
+) -> int:
     """Store maintenance: the append-only log keeps every side branch and
     reorged-away block forever (that's what makes restarts deterministic);
     compaction snapshots just the current main branch, shrinking the file
-    while resume behavior for the surviving chain is unchanged."""
+    while resume behavior for the surviving chain is unchanged.
+
+    Segmented stores compact PER SEGMENT: only segments holding records
+    off the current main branch are rewritten (tmp + rename + dir-fsync
+    each), clean segments' bytes are never touched — O(dirty), not
+    O(chain).  ``store_cls`` is the fault-injection seam for the
+    single-file snapshot write (tests drive ENOSPC through it)."""
     from p1_tpu.chain import ChainStore, save_chain
+    from p1_tpu.chain.segstore import is_segmented
 
     if not os.path.exists(store_path):
         print(f"{store_path}: empty or missing chain store", file=sys.stderr)
         return 2
+    if is_segmented(store_path):
+        return _compact_segmented(store_path, out_path, retarget=retarget)
     # Lock FIRST, then load: records appended between an unlocked read and
     # the rewrite would be silently dropped, and replacing the inode under
     # a live node would orphan everything it appends afterwards.
@@ -276,7 +290,25 @@ def run_compact(store_path: str, out_path: str | None, retarget=None) -> int:
             # a crash mid-write can never leave EITHER path deleted or
             # truncated.
             tmp = f"{out}.compact.{os.getpid()}"
-            save_chain(chain, tmp)
+            try:
+                save_chain(
+                    chain,
+                    tmp,
+                    **({"store_cls": store_cls} if store_cls else {}),
+                )
+            except OSError as e:
+                # ENOSPC/EIO mid-rewrite: the ORIGINAL store was never
+                # touched (we only wrote the sibling tmp) — remove the
+                # partial tmp and report, leaving the log byte-identical
+                # and the writer flock released by the finally below.
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                print(
+                    f"compaction write failed ({e}) — original store "
+                    "left untouched",
+                    file=sys.stderr,
+                )
+                return 2
             # Prove the snapshot BEFORE it replaces the original: the
             # main branch is linear, so its packed headers verify (PoW +
             # linkage + difficulty) in one native call straight off the
@@ -323,7 +355,237 @@ def run_compact(store_path: str, out_path: str | None, retarget=None) -> int:
     return 0
 
 
-def run_fsck(store_path: str, out_path: str | None) -> int:
+def _compact_segmented(
+    store_path: str, out_path: str | None, retarget=None
+) -> int:
+    """Per-segment compaction: drop records off the current main branch,
+    rewriting ONLY the segments that hold any (tmp + rename + dir-fsync
+    per segment — a crash at any point leaves every segment either old
+    or new, never half-written).  ``--out`` is refused: a segmented
+    store is a directory of bounded files, compacted in place by
+    design."""
+    from p1_tpu.chain.segstore import SegmentedStore
+    from p1_tpu.chain.store import _CRC, _LEN, MAGIC, ChainStore, fsync_dir
+    from p1_tpu.core.hashutil import sha256d
+
+    if out_path:
+        print(
+            "segmented stores compact in place (bounded per-segment "
+            "rewrites); --out applies to single-file stores only",
+            file=sys.stderr,
+        )
+        return 2
+    store = SegmentedStore(store_path)
+    try:
+        try:
+            store.acquire()
+        except RuntimeError as e:
+            print(f"{e} — stop it before compacting", file=sys.stderr)
+            return 2
+        blocks = store.load_blocks()
+        if not blocks:
+            print(f"{store_path}: empty chain store", file=sys.stderr)
+            return 2
+        try:
+            chain = store.load_chain(
+                blocks[0].header.difficulty,
+                blocks,
+                retarget=retarget,
+                orphans_ok=store.pruned_below > 0,
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        main = set()
+        h = chain.tip_hash
+        while h in chain:
+            main.add(h)
+            hdr = chain.header_of(h)
+            if hdr is None or chain.height_of(h) == chain.base_height:
+                break
+            h = hdr.prev_hash
+        # With a pruned store the surviving records park as orphans off
+        # the missing history: treat every connected record as keepable
+        # (compaction must never widen a prune's loss).
+        if store.pruned_below > 0:
+            main.update(b.block_hash() for b in blocks)
+        before_records = len(blocks)
+        rewritten = kept = 0
+        for seg, scan in store.scan_segments():
+            if scan is None or not scan.spans:
+                continue
+            path = store._seg_path(seg)
+            data = path.read_bytes()
+            frames = []
+            for off, n in scan.spans:
+                if sha256d(data[off : off + 80]) in main:
+                    frames.append(data[off - _LEN.size : off + n + _CRC.size])
+            kept += len(frames)
+            if len(frames) == len(scan.spans):
+                continue  # clean segment: bytes never touched
+            tmp = path.with_name(f"{path.name}.seg.{os.getpid()}")
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(MAGIC)
+                    for frame in frames:
+                        f.write(frame)
+                    f.flush()
+                    os.fsync(f.fileno())
+                # Self-check the rewrite before it replaces anything.
+                vscan = ChainStore.scan(tmp.read_bytes())
+                if not vscan.clean or len(vscan.spans) != len(frames):
+                    raise OSError("segment self-check failed")
+            except OSError as e:
+                if tmp.exists():
+                    tmp.unlink()
+                print(
+                    f"compaction write failed ({e}) — {path} left "
+                    "untouched",
+                    file=sys.stderr,
+                )
+                return 2
+            os.replace(tmp, path)
+            fsync_dir(path.parent)
+            seg.records = len(frames)
+            seg.bytes = os.path.getsize(path)
+            if seg.sealed:
+                # The packed-header sidecar mirrors the new record set.
+                from p1_tpu.chain.headerplane import write_segment_index
+
+                write_segment_index(
+                    path.read_bytes(), store.hdrx_path(seg)
+                )
+            rewritten += 1
+        store._write_manifest()
+        store.reindex_spans()
+    finally:
+        store.close()
+    print(
+        json.dumps(
+            {
+                "config": "compact",
+                "layout": "segmented",
+                "height": chain.height,
+                "records_before": before_records,
+                "records_after": kept,
+                "segments": len(store.segments),
+                "segments_rewritten": rewritten,
+                "out": store_path,
+            }
+        )
+    )
+    return 0
+
+
+def _fsck_segmented(store_path: str, json_out: bool) -> int:
+    """Per-segment fsck: scan/report, then salvage ONLY the segments
+    that need it — mid-log corruption loses at most one segment's bad
+    span, and no other segment's bytes are ever rewritten.  Same exit
+    contract (0 clean / 1 salvaged / 2 unrecoverable); the JSON report
+    carries one row per segment with its own verdict."""
+    from p1_tpu.chain.segstore import SegmentedStore, _torn_magic
+    from p1_tpu.chain.store import ChainStore
+
+    store = SegmentedStore(store_path)
+    lf = None
+    try:
+        import fcntl
+
+        store.path.parent.mkdir(parents=True, exist_ok=True)
+        lf = open(store.lock_path, "a+b")
+        try:
+            # Lock first (a live node's appends must not race a
+            # salvage), scan without healing: fsck reports BEFORE it
+            # mutates, per segment.
+            fcntl.flock(lf, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            print(
+                f"{store.path} is locked by another process (a running "
+                "node?)",
+                file=sys.stderr,
+            )
+            return 2
+        rows = []
+        worst = 0
+        salvaged_any = False
+        segments = store._segments_for_read()
+        if not segments:
+            print(
+                f"{store_path}: unreadable manifest and no segments",
+                file=sys.stderr,
+            )
+            return 2
+        for seg, scan in store.scan_segments():
+            row = {
+                "segment": seg.name,
+                "pruned": seg.pruned,
+                "sealed": seg.sealed,
+            }
+            if scan is None:
+                row["verdict"] = 0  # pruned body: nothing to scan
+                rows.append(row)
+                continue
+            row.update(
+                records_valid=len(scan.spans),
+                bad_spans=len(scan.bad_spans),
+                bytes_quarantined=scan.quarantined_bytes,
+                torn_tail_bytes=(
+                    scan.size - scan.torn_tail
+                    if scan.torn_tail is not None
+                    else 0
+                ),
+            )
+            if scan.clean:
+                row["verdict"] = 0
+                rows.append(row)
+                continue
+            # Salvage this segment only: quarantine + rebuild / truncate.
+            path = store._seg_path(seg)
+            data = store._read_bytes_path(path)
+            if _torn_magic(data):
+                os.truncate(path, 0)
+                row["verdict"] = 1
+                row["records_salvaged"] = 0
+                salvaged_any = True
+                rows.append(row)
+                continue
+            if scan.bad_spans:
+                store._heal_segment(path, data, scan)
+            if scan.torn_tail is not None:
+                os.truncate(path, scan.torn_tail)
+            vscan = ChainStore.scan(store._read_bytes_path(path))
+            if not vscan.clean:
+                row["verdict"] = 2
+                worst = 2
+            else:
+                row["verdict"] = 1
+                row["records_salvaged"] = len(vscan.spans)
+                salvaged_any = True
+            rows.append(row)
+        status = (
+            "unrecoverable"
+            if worst == 2
+            else ("salvaged" if salvaged_any else "clean")
+        )
+        report = {
+            "config": "fsck",
+            "store": store_path,
+            "layout": "segmented",
+            "pruned_below": store.pruned_below,
+            "segments": rows,
+            "status": status,
+        }
+        print(json.dumps(report))
+        return 2 if worst == 2 else (1 if salvaged_any else 0)
+    finally:
+        if lf is not None:
+            lf.close()
+        store.close()
+
+
+def run_fsck(
+    store_path: str, out_path: str | None, json_out: bool = False
+) -> int:
     """Offline store integrity scan + salvage (the disk counterpart of
     Bitcoin's -checkblocks/salvagewallet tooling).  Exit contract:
 
@@ -339,16 +601,64 @@ def run_fsck(store_path: str, out_path: str | None) -> int:
     branches (it salvages the LOG, not the main branch), so the
     self-check is framing-level — every salvaged record re-reads
     checksum-valid and byte-identical — rather than the linear-chain
-    ``replay_packed`` proof compaction can afford."""
+    ``replay_packed`` proof compaction can afford.
+
+    Segmented stores (chain/segstore.py) scan and salvage PER SEGMENT —
+    ``_fsck_segmented``; ``json_out`` (`p1 fsck --json`) emits the
+    machine-readable per-segment report (one row per segment with its
+    own verdict/spans/salvage counts) for both layouts, same exit
+    codes."""
     import struct
 
     from p1_tpu.chain import ChainStore
+    from p1_tpu.chain.segstore import is_segmented
     from p1_tpu.chain.store import fsync_dir
     from p1_tpu.core.block import Block
 
     if not os.path.exists(store_path) or os.path.getsize(store_path) == 0:
         print(f"{store_path}: empty or missing chain store", file=sys.stderr)
         return 2
+    if is_segmented(store_path):
+        if out_path:
+            print(
+                "segmented stores salvage in place (bounded per-segment "
+                "rewrites); --out applies to single-file stores only",
+                file=sys.stderr,
+            )
+            return 2
+        return _fsck_segmented(store_path, json_out)
+
+    def _emit(report: dict, status: str, verdict: int) -> None:
+        """One print, two shapes: the legacy flat report (default), or
+        the --json per-segment shape shared with segmented stores."""
+        if not json_out:
+            print(json.dumps({**report, "status": status}))
+            return
+        row = {
+            "segment": os.path.basename(report["store"]),
+            "pruned": False,
+            "sealed": False,
+            "verdict": verdict,
+            "records_valid": report["records_valid"],
+            "bad_spans": report["bad_spans"],
+            "bytes_quarantined": report["bytes_quarantined"],
+            "torn_tail_bytes": report["torn_tail_bytes"],
+        }
+        if "records_salvaged" in report:
+            row["records_salvaged"] = report["records_salvaged"]
+        print(
+            json.dumps(
+                {
+                    "config": "fsck",
+                    "store": report["store"],
+                    "layout": "single",
+                    "version": report["version"],
+                    "segments": [row],
+                    "status": status,
+                }
+            )
+        )
+
     store = ChainStore(store_path)
     try:
         try:
@@ -373,7 +683,7 @@ def run_fsck(store_path: str, out_path: str | None) -> int:
             ),
         }
         if scan.version == 3 and scan.clean:
-            print(json.dumps({**report, "status": "clean"}))
+            _emit(report, "clean", 0)
             return 0
 
         # Salvage: every checksum-valid record that still parses as a
@@ -386,9 +696,7 @@ def run_fsck(store_path: str, out_path: str | None) -> int:
                 parse_failures += 1
         report["parse_failures"] = parse_failures
         if not blocks:
-            print(
-                json.dumps({**report, "status": "unrecoverable"}),
-            )
+            _emit(report, "unrecoverable", 2)
             print(
                 f"{store_path}: no salvageable records", file=sys.stderr
             )
@@ -445,10 +753,13 @@ def run_fsck(store_path: str, out_path: str | None) -> int:
             {
                 "records_salvaged": len(blocks),
                 "out": out,
-                "status": "upgraded" if lossless else "salvaged",
             }
         )
-        print(json.dumps(report))
+        _emit(
+            report,
+            "upgraded" if lossless else "salvaged",
+            0 if lossless else 1,
+        )
         return 0 if lossless else 1
     finally:
         store.close()

@@ -278,6 +278,27 @@ def build_parser() -> argparse.ArgumentParser:
         "resident (requires --store)",
     )
     p.add_argument(
+        "--store-segment-mb",
+        type=float,
+        default=0.0,
+        help="segmented store layout (chain/segstore.py): shard the "
+        "append-only log into bounded segment files of this many MB "
+        "(per-segment fsck/compaction/pruning; a single-file store "
+        "upgrades losslessly on the first writer acquire); 0 keeps the "
+        "store's existing layout",
+    )
+    p.add_argument(
+        "--prune",
+        type=int,
+        default=0,
+        metavar="KEEP_BLOCKS",
+        help="pruned mode: discard block-body segments below the latest "
+        "snapshot checkpoint, keeping at least KEEP_BLOCKS recent "
+        "bodies — headers/filters/snapshots keep serving, block-sync "
+        "requests into the pruned range are refused without "
+        "disconnecting (0 = archive node; implies a segmented store)",
+    )
+    p.add_argument(
         "--no-admission-control",
         action="store_true",
         help="disable the per-peer blocks/txs/queries admission budgets "
@@ -535,6 +556,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--out",
         default=None,
         help="write the salvaged store here instead of replacing in place",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable per-segment report (one row per segment "
+        "with its own verdict/spans/salvage counts; single-file stores "
+        "report as one segment)",
     )
 
     p = sub.add_parser(
@@ -1650,7 +1678,7 @@ def cmd_compact(args) -> int:
 def cmd_fsck(args) -> int:
     from p1_tpu.chain.tooling import run_fsck
 
-    return run_fsck(args.store, args.out)
+    return run_fsck(args.store, args.out, json_out=args.json)
 
 
 def cmd_snapshot(args) -> int:

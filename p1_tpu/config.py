@@ -99,6 +99,22 @@ class NodeConfig:
     #: (docs/PERF.md "Memory-bounded operation").  0 disables (fully
     #: resident — the historical behavior); requires ``store_path``.
     body_cache_blocks: int = 0
+    #: Segmented store layout (chain/segstore.py): shard the append-only
+    #: log into bounded segment files of this many bytes (per-segment
+    #: fsck/compaction/pruning — the archive-scale layout).  0 keeps
+    #: whatever layout the store already has (an existing segmented
+    #: store reopens segmented; a fresh or single-file store stays
+    #: single-file).  A single-file store upgrades LOSSLESSLY on the
+    #: first writer acquire when this is set.
+    store_segment_bytes: int = 0
+    #: Pruned mode (round 18): keep at least this many recent block
+    #: BODIES on disk and discard whole body segments below the latest
+    #: snapshot checkpoint — the node keeps serving headers, cached
+    #: filters, and snapshots, and REFUSES (without disconnecting)
+    #: block-sync requests into the pruned range; honest joiners fail
+    #: over to an archive peer or snapshot-sync.  0 disables (archive
+    #: node — the default).  Requires (and implies) a segmented store.
+    prune_keep_blocks: int = 0
     #: Validation fast lane (core/keys.py): worker-pool size for batched
     #: Ed25519 verification on the untrusted paths (revalidation,
     #: foreign-store loads, deep-sync batches).  0 = auto (the

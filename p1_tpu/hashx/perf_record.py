@@ -186,3 +186,29 @@ RECORDED_SNAPSHOT_BOOT_S = 0.004
 #: flagged degraded (generous: the figure is milliseconds, so absolute
 #: jitter is a large relative band).
 SNAPSHOT_DEGRADED_FACTOR = 5.0
+
+#: Archive scale (round 18, chain/segstore.py + chain/headerplane.py):
+#: the 100k-block synthetic segmented archive probe
+#: (benchmarks/archive_scale.py ``bench_quick`` — same code path as
+#: the 10M acceptance run behind ``P1_BENCH_ARCHIVE=1``).
+#: ``RECORDED_ARCHIVE_RESUME_BPS`` is the whole-archive packed-header
+#: extraction rate (records/s through the per-segment scan — what a
+#: header-plane rebuild or full PoW replay pays);
+#: ``RECORDED_ARCHIVE_BOOT_RSS_MB`` is the peak RSS (VmHWM, fresh
+#: process) of booting ``ArchiveChain`` and serving
+#: header/balance/proof queries at 100k blocks.  The RSS figure is
+#: dominated by the ACTIVE segment's hdrx rebuild (segment-bounded,
+#: ~95 MB transient regardless of chain length) — the measured 10M
+#: figure on this host was 166 MB, ~6x under the 1 GB acceptance
+#: bar (docs/PERF.md "Archive scale" has the 100k/1M/10M ladder and
+#: the two structures it took: a blocked bloom per segment so txid
+#: negatives cost one 64-byte read, and pread — NOT mmap — probing,
+#: because fault-around residented ~1 GB of neighbor pages at 10M).
+#: Measured 2026-08-05 on the 1-vCPU bench host.
+RECORDED_ARCHIVE_RESUME_BPS = 683_000
+RECORDED_ARCHIVE_BOOT_RSS_MB = 170.0
+
+#: Degraded thresholds: resume is CPU-bound (co-tenant-sensitive);
+#: RSS is an allocator property and should barely move — flag at 2x.
+ARCHIVE_RESUME_DEGRADED_FRACTION = 0.4
+ARCHIVE_BOOT_RSS_DEGRADED_FACTOR = 2.0

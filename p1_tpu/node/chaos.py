@@ -147,6 +147,7 @@ def generate_schedule(
     """
     rng = random.Random((seed << 3) ^ 0xC4A05)
     joiners: set[int] = set()
+    pruned_any = False
     times = sorted(
         round(rng.uniform(0.5, horizon_vs), 3) for _ in range(n_events)
     )
@@ -183,6 +184,19 @@ def generate_schedule(
         if len(joiners) < MAX_JOINERS:
             ops.append(("snap_join", 1.0))
             ops.append(("snap_liar", 0.75))
+        # Segmented-store plane (round 18).  ``seg_roll`` forces a live
+        # node's active segment to seal mid-mesh; ``prune`` discards a
+        # live node's deep body segments while it serves (at most one
+        # pruned host per schedule — someone must keep the archive);
+        # ``compact_crash`` drops the exact tmp-file artifact of a
+        # compaction killed before its atomic replace onto a crashed
+        # node's store.  All three degrade to no-ops on single-file
+        # stores, keeping every subset runnable for the shrinker.
+        ops.append(("seg_roll", 0.75))
+        if not pruned_any:
+            ops.append(("prune", 0.5))
+        if crashed:
+            ops.append(("compact_crash", 0.5))
         op = rng.choices([o for o, _ in ops], [w for _, w in ops])[0]
         ev: dict = {"at": at, "op": op}
         if op == "mine":
@@ -250,6 +264,15 @@ def generate_schedule(
             ev["node"] = rng.randrange(n_nodes)
             ev["kind"] = rng.choice(("queries", "blocks"))
             hostiles += 1
+        elif op == "seg_roll":
+            ev["node"] = rng.randrange(n_nodes)
+        elif op == "prune":
+            ev["node"] = rng.randrange(n_nodes)
+            ev["keep"] = rng.choice((2, 4))
+            pruned_any = True
+        elif op == "compact_crash":
+            ev["node"] = rng.choice(sorted(crashed))
+            ev["junk"] = rng.randrange(1, 1 << 16)
         events.append(ev)
     return events
 
@@ -488,12 +511,43 @@ def fsck_verdict(path) -> int:
     empty-but-valid log survives), 2 = unrecoverable (missing, not a
     chain store, or nothing salvageable).  The chaos invariant: a
     crashed node's store must NEVER reach 2 — whatever the schedule
-    did, recovery has something valid to stand on."""
+    did, recovery has something valid to stand on.
+
+    Segmented stores (chain/segstore.py) verdict per SEGMENT straight
+    off the directory — the manifest is a rebuildable cache, so only an
+    unscannable segment (destroyed magic) is unrecoverable; stray
+    segments from a mid-roll crash are scanned too."""
+    from p1_tpu.chain import segstore
     from p1_tpu.chain.store import ChainStore
 
     path = Path(path)
     if not path.exists():
         return 2
+    if segstore.is_segmented(path):
+        seg_dir = path.with_name(path.name + ".d")
+        files = (
+            sorted(seg_dir.glob("seg*.p1s")) if seg_dir.exists() else []
+        )
+        if not files:
+            # Fully-pruned stores keep their .hdrx plane; anything else
+            # with zero segments lost the archive wholesale.
+            hdrx = (
+                list(seg_dir.glob("seg*.hdrx")) if seg_dir.exists() else []
+            )
+            return 0 if hdrx else 2
+        worst = 0
+        for f in files:
+            data = f.read_bytes()
+            if not data or segstore._torn_magic(data):
+                worst = max(worst, 1)  # torn first write: heals empty
+                continue
+            try:
+                scan = ChainStore.scan(data)
+            except ValueError:
+                return 2
+            if not scan.clean:
+                worst = max(worst, 1)
+        return worst
     data = path.read_bytes()
     try:
         scan = ChainStore.scan(data)
@@ -566,6 +620,11 @@ def run_chaos(
         difficulty=difficulty,
         store_dir=store_dir,
         keep_trace=keep_trace,
+        # Round 18: the whole schedule corpus runs over SEGMENTED
+        # stores (tiny segments, so a few mined blocks cross roll
+        # boundaries) — crashes/torn writes/bit-rot now land on segment
+        # files, and the fsck invariant verdicts per segment.
+        segmented_store=store_dir is not None,
     )
     runner = _ChaosRunner(
         net, nodes, difficulty, inject_bug, settle_vs, wall_limit_s,
@@ -701,10 +760,25 @@ class _ChaosRunner:
             if host in net.crashed:
                 await self._recover(host)
         elif op == "corrupt":
+            from p1_tpu.chain.segstore import is_segmented
+
             host = self.hosts[ev["node"]]
             if host not in net.crashed:
                 return  # only a DOWN node's disk rots unobserved
             path = Path(net.configs[host].store_path)
+            if is_segmented(path):
+                # Segmented layout: the rot lands in a SEGMENT file
+                # (the manifest is a rebuildable cache, and destroying
+                # it would model a different fault than record bit-rot).
+                seg_dir = path.with_name(path.name + ".d")
+                segs = [
+                    f
+                    for f in sorted(seg_dir.glob("seg*.p1s"))
+                    if f.stat().st_size > 9
+                ]
+                if not segs:
+                    return
+                path = segs[ev["offset"] % len(segs)]
             data = bytearray(path.read_bytes())
             if len(data) <= 9:
                 return  # magic only: nothing to rot
@@ -714,6 +788,60 @@ class _ChaosRunner:
             data[off] ^= 0x20
             path.write_bytes(bytes(data))
             self._record("corrupt", host, off)
+        elif op == "seg_roll":
+            host = self.hosts[ev["node"]]
+            store = net.stores.get(host)
+            if (
+                host in net.nodes
+                and store is not None
+                and hasattr(store, "roll_segment")
+            ):
+                try:
+                    store.roll_segment()
+                except OSError:
+                    pass  # an armed disk-fault plan owns this failure
+                else:
+                    self._record("seg_roll", host)
+        elif op == "prune":
+            host = self.hosts[ev["node"]]
+            node = net.nodes.get(host)
+            store = net.stores.get(host)
+            if (
+                node is None
+                or store is None
+                or not hasattr(store, "prune_below")
+            ):
+                return
+            floor = max(0, node.chain.height - ev["keep"])
+            try:
+                n = store.prune_below(floor)
+            except OSError:
+                return  # armed disk fault: the node's paths degrade
+            if n:
+                # Prune-while-serving: the node now refuses block sync
+                # into the pruned range (peers fail over to the archive
+                # holders) and a later crash/recover re-IBDs through
+                # the mesh — both paths the invariants then check.
+                node.chain.prune_floor = store.pruned_below
+                self._record("prune", host, floor, n)
+        elif op == "compact_crash":
+            host = self.hosts[ev["node"]]
+            if host not in net.crashed:
+                return
+            path = Path(net.configs[host].store_path)
+            seg_dir = path.with_name(path.name + ".d")
+            segs = (
+                sorted(seg_dir.glob("seg*.p1s")) if seg_dir.exists() else []
+            )
+            if not segs:
+                return
+            victim = segs[ev["junk"] % len(segs)]
+            # The exact artifact of a per-segment compaction killed
+            # before its atomic os.replace: a partial sibling tmp.
+            # Recovery must ignore it (verdict <= 1, records intact).
+            tmp = victim.with_name(f"{victim.name}.seg.{ev['junk']}")
+            tmp.write_bytes(b"P1TPUCH3" + bytes([ev["junk"] & 0xFF]) * 64)
+            self._record("compact_crash", host)
         elif op == "partition":
             k = max(1, min(self.n - 1, int(self.n * ev["frac"])))
             self.partitioned = True

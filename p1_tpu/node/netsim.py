@@ -584,6 +584,8 @@ class SimNet:
         keep_trace: bool = False,
         store_dir=None,
         telemetry: bool = True,
+        segmented_store: bool = False,
+        segment_bytes: int = 1 << 14,
     ):
         from pathlib import Path
 
@@ -612,6 +614,13 @@ class SimNet:
         #: ``FaultStore``) — the substrate crash/recovery scenarios
         #: need: a crashed node's surviving state IS its files.
         self.store_dir = Path(store_dir) if store_dir is not None else None
+        #: ``segmented_store`` gives every node the SEGMENTED layout
+        #: (chain/segstore.py) behind the same FaultStore seam — tiny
+        #: ``segment_bytes`` so a few mined blocks cross roll
+        #: boundaries.  The chaos plane (node/chaos.py) runs its whole
+        #: schedule corpus over segmented stores this way.
+        self.segmented_store = segmented_store
+        self.segment_bytes = segment_bytes
         #: host -> live FaultStore (chaos events re-arm plans on these).
         self.stores: dict[str, object] = {}
         #: Hosts currently dead from ``crash_node`` (host -> the dead
@@ -634,9 +643,16 @@ class SimNet:
         if not config.store_path:
             self.stores.pop(host, None)
             return None
-        from p1_tpu.chain.testing import FaultStore
+        from p1_tpu.chain.testing import FaultStore, SegFaultStore
 
-        store = FaultStore(config.store_path, plan=plan)
+        if self.segmented_store:
+            store = SegFaultStore(
+                config.store_path,
+                plan=plan,
+                segment_bytes=self.segment_bytes,
+            )
+        else:
+            store = FaultStore(config.store_path, plan=plan)
         self.stores[host] = store
         return store
 

@@ -29,6 +29,7 @@ import random
 from pathlib import Path
 
 from p1_tpu.chain import AddResult, AddStatus, Chain, ChainStore
+from p1_tpu.chain.store import fsync_dir
 from p1_tpu.chain import snapshot as chain_snapshot
 from p1_tpu.chain.snapshot import SnapshotError
 from p1_tpu.chain.validate import ValidationError, preverify_signatures
@@ -309,6 +310,8 @@ _METRIC_COUNTERS = (
     "store_retries",
     "store_recoveries",
     "store_blocks_deferred",
+    "store_segments_pruned",
+    "pruned_refusals",
     "proofs_served",
     "filters_served",
     "filter_bytes_served",
@@ -652,10 +655,34 @@ class Node:
         #: decides persistence.
         if store is not None:
             self.store = store
-        else:
-            self.store = (
-                ChainStore(config.store_path) if config.store_path else None
+        elif config.store_path:
+            # Layout sniffing (chain/segstore.py): an existing
+            # segmented store reopens segmented regardless of flags;
+            # --store-segment-mb / --prune opt a fresh or single-file
+            # store into the segmented layout (single-file upgrades
+            # losslessly on acquire).  Spelled as a conditional over
+            # the two constructors — the analysis plane's attribute
+            # binder unifies them to the ChainStore base, keeping the
+            # store-blocking call chains provable.
+            from p1_tpu.chain.segstore import (
+                DEFAULT_SEGMENT_BYTES,
+                SegmentedStore,
+                is_segmented,
             )
+
+            seg_bytes = config.store_segment_bytes
+            if config.prune_keep_blocks > 0 and seg_bytes == 0:
+                seg_bytes = DEFAULT_SEGMENT_BYTES
+            self.store = (
+                SegmentedStore(
+                    config.store_path,
+                    segment_bytes=seg_bytes or DEFAULT_SEGMENT_BYTES,
+                )
+                if seg_bytes > 0 or is_segmented(config.store_path)
+                else ChainStore(config.store_path)
+            )
+        else:
+            self.store = None
         #: Storage degradation state (the disk analog of sync-stall
         #: failover): a failed append/fsync flips the node into a
         #: degraded SERVE-ONLY mode — it stops accepting/persisting new
@@ -1053,6 +1080,70 @@ class Node:
         )
         return True
 
+    def _prunebase_path(self):
+        if self.config.store_path is None:
+            return None
+        return Path(f"{self.config.store_path}.prunebase")
+
+    def _try_prunebase_resume(self) -> bool:
+        """Resume a PRUNED node: history below the prune floor is gone
+        from disk by policy, so the genesis resume cannot reconnect the
+        surviving records — the ``.prunebase`` sidecar (this node's OWN
+        snapshot of its validated state, written before each prune)
+        anchors the chain at the prune base instead and the surviving
+        segments replay on top.  Unlike a peer-served snapshot this
+        boots VALIDATED: the state is ours, persisted under the writer
+        lock, the same trust the trusted resume extends to the log.  A
+        missing/corrupt sidecar degrades to ordinary IBD with
+        ``orphans_ok`` (safe, just slower) — never a refused boot."""
+        if getattr(self.store, "pruned_below", 0) <= 0:
+            return False
+        base_path = self._prunebase_path()
+        if base_path is None or not base_path.exists():
+            self._orphans_ok_boot = True
+            return False
+        try:
+            snap = chain_snapshot.load_snapshot(base_path)
+        except (OSError, SnapshotError) as e:
+            self.log.error(
+                "prune-base sidecar unreadable (%s) — quarantining; "
+                "booting via ordinary IBD",
+                e,
+            )
+            try:
+                os.replace(
+                    base_path,
+                    base_path.with_name(base_path.name + ".quarantine"),
+                )
+            except OSError:
+                pass
+            self._orphans_ok_boot = True
+            return False
+        chain = Chain.from_snapshot(
+            self.config.difficulty, snap, retarget=self.config.retarget_rule()
+        )
+        chain.assumed = False  # our own validated state, not a peer claim
+        chain.sig_cache = self.sig_cache
+        if self.config.snapshot_interval > 0:
+            chain.checkpoint_interval = self.config.snapshot_interval
+        anchor = snap.block_hash
+        for block in self.store.iter_blocks():
+            if block.block_hash() == anchor:
+                continue
+            chain.add_block(block, trusted=True)
+        chain.prune_floor = self.store.pruned_below
+        self.chain = chain
+        if self.config.body_cache_blocks > 0:
+            chain.body_source = self.store
+        self.log.info(
+            "resumed pruned chain base=%d tip=%d (bodies below %d "
+            "discarded; headers in the segment plane)",
+            snap.height,
+            chain.height,
+            self.store.pruned_below,
+        )
+        return True
+
     async def start(self) -> None:
         self._load_addr_book()
         self._orphans_ok_boot = False
@@ -1062,6 +1153,9 @@ class Node:
             # store, or a compaction while we run, must fail loudly.
             self.store.acquire()
             if self._try_snapshot_resume():
+                self._load_mempool()
+                return await self._start_services()
+            if self._try_prunebase_resume():
                 self._load_mempool()
                 return await self._start_services()
             body_cache = self.config.body_cache_blocks
@@ -1280,18 +1374,77 @@ class Node:
             return
         self._store_pending.extend(blocks)
         if not self._store_degraded:
-            self._store_flush()
+            if self._store_flush():
+                self._maybe_prune()
 
     def _store_flush(self) -> bool:
         """Write every pending record in order; True when caught up."""
         while self._store_pending:
+            block = self._store_pending[0]
             try:
-                self.store.append(self._store_pending[0])
+                # The height hint feeds the segmented store's manifest
+                # (height spans -> segments, what pruning consults); a
+                # record the chain no longer indexes appends heightless.
+                entry = self.chain._index.get(block.block_hash())
+                self.store.append(
+                    block, height=entry.height if entry else None
+                )
             except OSError as e:
                 self._store_fail(e)
                 return False
             self._store_pending.pop(0)
         return True
+
+    def _maybe_prune(self) -> None:
+        """Pruned mode (round 18): discard body segments wholly below
+        the prune floor — the older of (tip - prune_keep_blocks) and
+        the latest snapshot-checkpoint height, so a pruned node can
+        always still serve its newest snapshot's rollback window.
+        Cheap when there is nothing to do (one pass over the manifest
+        rows); actual pruning is an unlink + manifest rewrite per
+        discarded segment."""
+        keep = self.config.prune_keep_blocks
+        if keep <= 0 or self.store is None:
+            return
+        prune_below = getattr(self.store, "prune_below", None)
+        if prune_below is None:
+            return  # single-file layout: nothing to discard per segment
+        interval = self.chain.checkpoint_interval
+        checkpoint = (self.chain.height // interval) * interval
+        floor = min(self.chain.height - keep, checkpoint)
+        if floor <= self.chain.prune_floor:
+            return
+        if not self.store.prunable_segments(floor):
+            return
+        try:
+            # The prune-base sidecar FIRST, durably: our own validated
+            # state at the latest checkpoint is what the next boot
+            # anchors on once the history below it stops existing.
+            state = self.chain.snapshot_state()
+            if state is None:
+                return
+            s_height, s_block, balances, nonces, _root = state
+            manifest, chunks = chain_snapshot.build_records(
+                s_height, s_block, balances, nonces
+            )
+            base_path = self._prunebase_path()
+            tmp = base_path.with_name(f"{base_path.name}.{os.getpid()}")
+            chain_snapshot.write_snapshot(tmp, manifest, chunks)
+            os.replace(tmp, base_path)
+            fsync_dir(base_path.parent)
+            n = prune_below(floor)
+        except OSError as e:
+            self._store_fail(e)
+            return
+        if n:
+            self.metrics.store_segments_pruned += n
+            self.chain.prune_floor = self.store.pruned_below
+            self.log.info(
+                "pruned %d body segment(s) below height %d "
+                "(headers retained)",
+                n,
+                self.store.pruned_below,
+            )
 
     def _store_sync(self) -> None:
         """Guarded batch-close fsync (the BLOCKS resync path)."""
@@ -2724,17 +2877,40 @@ class Node:
         elif mtype is MsgType.TX:
             await self._handle_tx(body, origin=peer)
         elif mtype is MsgType.GETBLOCKS:
-            blocks = self.chain.blocks_after(body, limit=SYNC_BATCH)
-            # Cap the reply by encoded bytes too: with ~half-KB txs a
-            # 500-block batch can exceed the receiver's frame cap, which
-            # would wedge sync in a reconnect loop.
-            capped, total = [], 0
-            for blk in blocks:
-                total += len(blk.serialize()) + 4
-                if capped and total > SYNC_BYTES:
-                    break
-                capped.append(blk)
-            await self._send_guarded(peer, protocol.encode_blocks(capped))
+            if (
+                self.chain.prune_floor
+                and self.chain.sync_start_height(body) < self.chain.prune_floor
+            ):
+                # Pruned-range refusal (round 18): the bodies below the
+                # prune floor were discarded by policy.  Answer with an
+                # EMPTY batch instead of disconnecting — an honest
+                # syncing peer reads it as a stall and fails over to an
+                # archive peer (node/supervision.py); our ``pruned``
+                # status field lets it avoid us up front.
+                self.metrics.pruned_refusals += 1
+                await self._send_guarded(peer, protocol.encode_blocks([]))
+            else:
+                try:
+                    blocks = self.chain.blocks_after(body, limit=SYNC_BATCH)
+                except OSError as e:
+                    # A segment went EIO under a body refetch: degrade
+                    # to serve-only (the PR 3 recovery loop re-probes
+                    # the disk) but keep THIS session — the fault is
+                    # the disk's, not the peer's.
+                    self._store_fail(e)
+                    blocks = []
+                # Cap the reply by encoded bytes too: with ~half-KB txs
+                # a 500-block batch can exceed the receiver's frame
+                # cap, which would wedge sync in a reconnect loop.
+                capped, total = [], 0
+                for blk in blocks:
+                    total += len(blk.serialize()) + 4
+                    if capped and total > SYNC_BYTES:
+                        break
+                    capped.append(blk)
+                await self._send_guarded(
+                    peer, protocol.encode_blocks(capped)
+                )
         elif mtype is MsgType.BLOCKS:
             # Batch the store's durability: per-append fsync (~2 ms) is
             # right for the one-block gossip cadence but would stall this
@@ -2970,10 +3146,13 @@ class Node:
                 self._learn_addr(addr)
         elif mtype is MsgType.GETHEADERS:
             # Headers-first sync for light clients: same locator
-            # semantics as GETBLOCKS, 80 B/block on the wire.
-            blocks = self.chain.blocks_after(body, limit=HEADERS_BATCH)
+            # semantics as GETBLOCKS, 80 B/block on the wire.  Served
+            # from the always-resident header index (``headers_after``)
+            # — never a body refetch, so header sync keeps working over
+            # pruned and evicted ranges.
+            headers = self.chain.headers_after(body, limit=HEADERS_BATCH)
             await self._send_guarded(
-                peer, protocol.encode_headers([b.header for b in blocks])
+                peer, protocol.encode_headers(headers)
             )
         elif mtype is MsgType.HEADERS:
             pass  # reply frame: meaningful to light clients only
@@ -3623,6 +3802,19 @@ class Node:
                 "healed": dict(self.store.healed)
                 if self.store is not None
                 else None,
+                # Segmented layout + pruned mode (round 18): the
+                # wire-visible ``pruned`` posture — a syncing peer
+                # reading this knows not to ask us for deep history.
+                "segmented": getattr(self.store, "segments", None)
+                is not None
+                and len(getattr(self.store, "segments", ())) > 0,
+                "pruned": {
+                    "enabled": self.config.prune_keep_blocks > 0,
+                    "keep_blocks": self.config.prune_keep_blocks,
+                    "floor": self.chain.prune_floor,
+                    "segments_pruned": self.metrics.store_segments_pruned,
+                    "refusals": self.metrics.pruned_refusals,
+                },
             },
             # Overload resilience (node/governor.py): SHED state +
             # hysteresis over the accounted memory gauge, per-peer

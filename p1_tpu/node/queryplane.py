@@ -98,8 +98,43 @@ class _Entry:
         self.height = height
         self.work = work
         self.prev = prev
-        self.off = off  # payload offset in the store file (0 = genesis, no record)
+        #: Packed payload location: ``(source index << _SRC_SHIFT) |
+        #: byte offset`` into that source's mmap — source 0 is the
+        #: whole file for a single-file store, one source per segment
+        #: for a segmented one.  0 = genesis (no record anywhere).
+        self.off = off
         self.length = length
+
+
+#: Packed-offset split for ``_Entry.off``: low bits are the byte offset
+#: inside one mapped source (44 bits ≫ any segment bound), high bits the
+#: source index.
+_SRC_SHIFT = 44
+_SRC_MASK = (1 << _SRC_SHIFT) - 1
+
+
+class _SegSrc:
+    """One memory-mapped record source (the single file, or one
+    segment): its scan cursor plus the inode pin that detects
+    heal/compaction rewrites underneath us."""
+
+    __slots__ = ("name", "fd", "mm", "mapped", "ino", "off")
+
+    def __init__(self, name: str, fd: int):
+        self.name = name
+        self.fd = fd
+        self.mm: mmap.mmap | None = None
+        self.mapped = 0
+        self.ino = os.fstat(fd).st_ino
+        self.off = 0  # next unscanned byte
+
+    def close(self) -> None:
+        if self.mm is not None:
+            self.mm.close()
+            self.mm = None
+        if self.fd is not None:
+            os.close(self.fd)
+            self.fd = None
 
 
 class ReplicaView:
@@ -121,11 +156,14 @@ class ReplicaView:
         self.genesis = make_genesis(difficulty, retarget)
         self.proof_cache = ProofCache()
         self.filter_index = FilterIndex()
-        self._fd: int | None = None
-        self._mm: mmap.mmap | None = None
-        self._ino: int | None = None
-        self._mapped = 0  # bytes currently mapped
-        self._off = 0  # next unscanned byte offset
+        #: Mapped record sources, in record order: [whole file] for the
+        #: single-file layout, one per segment (manifest order) for a
+        #: segmented store — ``_Entry.off`` packs the source index.
+        self._srcs: list[_SegSrc] = []
+        self._by_name: dict[str, _SegSrc] = {}
+        self._segmented = False
+        self._manifest_key: tuple | None = None
+        self._manifest_rows: list = []
         self.records = 0
         self.rescans = 0  # full rescans (inode change / truncation)
         self.refreshes = 0
@@ -150,56 +188,111 @@ class ReplicaView:
         }
         self._main = [ghash]
         self._tip = ghash
-        self._off = 0
         self.records = 0
 
     def close(self) -> None:
-        if self._mm is not None:
-            self._mm.close()
-            self._mm = None
-        if self._fd is not None:
-            os.close(self._fd)
-            self._fd = None
-        self._ino = None
-        self._mapped = 0
+        for src in self._srcs:
+            src.close()
+        self._srcs = []
+        self._by_name = {}
+        self._manifest_key = None
+        self._manifest_rows = []
+
+    def _full_reset(self) -> None:
+        """Void every cached offset and start over (inode replaced,
+        file truncated, layout changed).  Caches keyed by block hash
+        (proofs, filters) stay valid: a hash names the same bytes in
+        any inode."""
+        self.close()
+        self._reset_index()
+        self.rescans += 1
+
+    def _slice(self, packed_off: int, length: int) -> bytes:
+        src = self._srcs[packed_off >> _SRC_SHIFT]
+        off = packed_off & _SRC_MASK
+        return bytes(src.mm[off : off + length])
 
     def refresh(self) -> int:
-        """Bring the view up to date with the file; returns how many new
-        records were indexed.  NEVER takes any lock — reading races the
-        writer only at the torn tail, which the per-record CRC resolves
-        (an incomplete record fails its checksum and is retried on the
-        next refresh, after the writer's flush completes)."""
+        """Bring the view up to date with the store; returns how many
+        new records were indexed.  NEVER takes any lock — reading races
+        the writer only at the torn tail, which the per-record CRC
+        resolves (an incomplete record fails its checksum and is
+        retried on the next refresh, after the writer's flush
+        completes).  Segmented stores re-read the manifest when its
+        inode moves (every roll rewrites it) and keep per-segment scan
+        cursors: sealed history is scanned once, only the ACTIVE
+        segment is re-walked — and a single-file store upgrading to
+        segments under a live attach is detected as a layout change
+        and triggers one clean rescan."""
         try:
-            st = os.stat(self.path)
+            head = b""
+            with open(self.path, "rb") as f:
+                head = f.read(len(MAGIC))
         except FileNotFoundError:
             # Store not created yet (node about to boot): empty view.
             self.close()
             self._reset_index()
             return 0
-        if self._ino is not None and (
-            st.st_ino != self._ino or st.st_size < self._mapped
-        ):
-            # The inode was replaced (heal rebuild, `p1 compact`) or the
-            # file shrank (torn-tail truncation at writer acquire):
-            # every cached offset is void — rescan from scratch.  Caches
-            # keyed by block hash (proofs, filters) stay valid: a hash
-            # names the same bytes in any inode.
-            self.close()
-            self._reset_index()
-            self.rescans += 1
-        if self._fd is None:
-            self._fd = os.open(self.path, os.O_RDONLY)
-            self._ino = os.fstat(self._fd).st_ino
-        size = os.fstat(self._fd).st_size
+        from p1_tpu.chain.segstore import SEG_MAGIC
+
+        segmented = head == SEG_MAGIC
+        if self._srcs and segmented != self._segmented:
+            self._full_reset()  # live upgrade: single file became a manifest
+        self._segmented = segmented
+        old_tip = self._tip
+        new = (
+            self._refresh_segmented()
+            if segmented
+            else self._refresh_single(head)
+        )
+        if new is None:  # a source was replaced underneath us: rescan
+            self._full_reset()
+            new = (
+                self._refresh_segmented()
+                if segmented
+                else self._refresh_single(head)
+            )
+            new = new or 0
+        if new:
+            self.records += new
+            if (
+                self._tip != old_tip
+                or len(self._main) - 1 != self._entries[self._tip].height
+            ):
+                self._rebuild_main()
+        self.refreshes += 1
+        return new
+
+    def _open_src(self, name: str, path) -> _SegSrc | None:
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except FileNotFoundError:
+            return None
+        src = _SegSrc(name, fd)
+        self._srcs.append(src)
+        self._by_name[name] = src
+        return src
+
+    def _scan_src(self, src: _SegSrc, path) -> int | None:
+        """Advance one source's scan cursor; returns records indexed,
+        or None when the file was replaced/truncated underneath us
+        (caller does a full rescan)."""
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
+            return None
+        if st.st_ino != src.ino or st.st_size < src.mapped:
+            return None
+        size = os.fstat(src.fd).st_size
         if size < len(MAGIC):
             return 0
-        if size > self._mapped:
-            if self._mm is not None:
-                self._mm.close()
-            self._mm = mmap.mmap(self._fd, size, prot=mmap.PROT_READ)
-            self._mapped = size
-        mm = self._mm
-        if self._off == 0:
+        if size > src.mapped:
+            if src.mm is not None:
+                src.mm.close()
+            src.mm = mmap.mmap(src.fd, size, prot=mmap.PROT_READ)
+            src.mapped = size
+        mm = src.mm
+        if src.off == 0:
             head = bytes(mm[: len(MAGIC)])
             if head == V2_MAGIC:
                 raise ValueError(
@@ -207,32 +300,75 @@ class ReplicaView:
                     " or `p1 compact` before serving replicas"
                 )
             if head != MAGIC:
-                raise ValueError(f"{self.path}: not a chain store")
-            self._off = len(MAGIC)
+                return 0  # torn first write mid-roll: retry next refresh
+            src.off = len(MAGIC)
+        src_idx = self._srcs.index(src)
         new = 0
-        old_tip = self._tip
-        while self._off < self._mapped:
-            end = ChainStore._v3_record_at(mm, self._off)
+        while src.off < src.mapped:
+            end = ChainStore._v3_record_at(mm, src.off)
             if end is None:
                 # Torn tail (writer mid-append) or trailing damage the
                 # writer will heal: stop here, retry next refresh.
                 break
-            p_off = self._off + _LEN.size
+            p_off = src.off + _LEN.size
             p_len = end - p_off - _CRC_SIZE
-            self._index_record(p_off, p_len)
-            self._off = end
+            self._index_record(
+                src_idx, mm, (src_idx << _SRC_SHIFT) | p_off, p_off, p_len
+            )
+            src.off = end
             new += 1
-        if new:
-            self.records += new
-            if self._tip != old_tip or len(self._main) - 1 != self._entries[self._tip].height:
-                self._rebuild_main()
-        self.refreshes += 1
         return new
 
-    def _index_record(self, off: int, length: int) -> None:
-        """Index one checksum-valid record at payload ``off``: header
-        digest, fork choice, txid index — no object construction."""
-        mm = self._mm
+    def _refresh_single(self, head: bytes) -> int | None:
+        if not self._srcs:
+            if head and head != MAGIC and head != V2_MAGIC and len(head) >= len(MAGIC):
+                raise ValueError(f"{self.path}: not a chain store")
+            if self._open_src("", self.path) is None:
+                return 0
+        return self._scan_src(self._srcs[0], self.path)
+
+    def _refresh_segmented(self) -> int | None:
+        from p1_tpu.chain.segstore import SegmentInfo, read_manifest
+
+        try:
+            mst = os.stat(self.path)
+        except FileNotFoundError:
+            return None
+        key = (mst.st_ino, mst.st_size, mst.st_mtime_ns)
+        if key != self._manifest_key:
+            manifest = read_manifest(self.path)
+            if manifest is None:
+                return 0  # mid-replace race: retry next refresh
+            self._manifest_rows = [
+                SegmentInfo.from_json(r) for r in manifest.get("segments", [])
+            ]
+            self._manifest_key = key
+        seg_dir = self.path.with_name(self.path.name + ".d")
+        total = 0
+        for row in self._manifest_rows:
+            if row.pruned:
+                raise ValueError(
+                    f"{self.path}: pruned store cannot back a replica — "
+                    "deep bodies are gone; serve from an archive copy"
+                )
+            src = self._by_name.get(row.name)
+            path = seg_dir / row.name
+            if src is None:
+                src = self._open_src(row.name, path)
+                if src is None:
+                    break  # manifest ahead of the directory: retry later
+            n = self._scan_src(src, path)
+            if n is None:
+                return None  # heal/compaction replaced this segment
+            total += n
+        return total
+
+    def _index_record(
+        self, src_idx: int, mm, packed_off: int, off: int, length: int
+    ) -> None:
+        """Index one checksum-valid record at payload ``off`` in
+        ``mm``: header digest, fork choice, txid index — no object
+        construction.  ``packed_off`` is what the entry retains."""
         hdr = bytes(mm[off : off + HEADER_SIZE])
         if len(hdr) < HEADER_SIZE:
             return
@@ -245,9 +381,11 @@ class ReplicaView:
             # Out-of-line record (shouldn't happen in a node's log, which
             # appends in connect order — but a foreign/hand-built store
             # may interleave): park until the parent shows up.
-            self._pending.setdefault(prev, []).append((bhash, hdr, off, length))
+            self._pending.setdefault(prev, []).append(
+                (bhash, hdr, packed_off, length)
+            )
             return
-        self._connect(bhash, hdr, off, length, parent)
+        self._connect(bhash, hdr, packed_off, length, parent)
         # Drain anything that was waiting on this block, recursively.
         queue = [bhash]
         while queue:
@@ -270,10 +408,11 @@ class ReplicaView:
             self._tip = bhash
         self._index_txids(bhash, off, length)
 
-    def _index_txids(self, bhash: bytes, off: int, length: int) -> None:
+    def _index_txids(self, bhash: bytes, packed_off: int, length: int) -> None:
         """txid -> block hash entries for one record, hashing raw tx
         slices straight off the map (no Transaction objects)."""
-        mm = self._mm
+        mm = self._srcs[packed_off >> _SRC_SHIFT].mm
+        off = packed_off & _SRC_MASK
         end = off + length
         pos = off + HEADER_SIZE
         if pos + 4 > end:
@@ -339,7 +478,7 @@ class ReplicaView:
             if entry is not None and entry.height == 0:
                 return self.genesis.serialize()
             return None
-        return bytes(self._mm[entry.off : entry.off + entry.length])
+        return self._slice(entry.off, entry.length)
 
     def read_block(self, bhash: bytes) -> Block | None:
         raw = self.raw_record(bhash)
@@ -353,7 +492,7 @@ class ReplicaView:
         entry = self._entries[self._main[height]]
         if entry.off == 0:
             return self.genesis.header.serialize()
-        return bytes(self._mm[entry.off : entry.off + HEADER_SIZE])
+        return self._slice(entry.off, HEADER_SIZE)
 
     def _start_after(self, locator: list[bytes]) -> int:
         for h in locator:

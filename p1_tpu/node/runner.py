@@ -55,6 +55,12 @@ async def run_node(args, miner=None) -> int:
         ),
         body_cache_blocks=getattr(args, "body_cache", 0),
         telemetry=not getattr(args, "no_telemetry", False),
+        # Archive-scale layout (chain/segstore.py): segment size is MB
+        # on the command line, bytes in the config.
+        store_segment_bytes=int(
+            getattr(args, "store_segment_mb", 0.0) * (1 << 20)
+        ),
+        prune_keep_blocks=getattr(args, "prune", 0),
     )
     node = Node(config, miner=miner)
     await node.start()

@@ -1231,3 +1231,99 @@ class TestLint:
     def test_bad_flag_is_usage_error_exit_2(self):
         proc = self._lint("--no-such-flag")
         assert proc.returncode == 2
+
+
+class TestFsckSegmented:
+    """Round 18: `p1 fsck` over segmented stores — per-segment
+    scan/salvage with the 0/1/2 exit contract intact — and the
+    `--json` machine-readable per-segment report for both layouts."""
+
+    @staticmethod
+    def _mk_segmented(path, n=6, difficulty=12, segment_bytes=500):
+        from p1_tpu.chain import SegmentedStore
+        from p1_tpu.node.testing import make_blocks
+
+        blocks = make_blocks(n, difficulty=difficulty)
+        store = SegmentedStore(path, segment_bytes=segment_bytes)
+        try:
+            for h, block in enumerate(blocks[1:], start=1):
+                store.append(block, height=h)
+        finally:
+            store.close()
+        assert len(store.segments) > 1
+        return blocks, store
+
+    def test_json_single_file_clean(self, tmp_path):
+        store = tmp_path / "clean.dat"
+        TestFsck._mk_store(store)
+        proc = TestFsck._fsck("--store", str(store), "--json")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip())
+        assert out["layout"] == "single" and out["status"] == "clean"
+        (row,) = out["segments"]
+        assert row["verdict"] == 0 and row["records_valid"] == 6
+
+    def test_segmented_clean_exit_0(self, tmp_path):
+        store = tmp_path / "seg.dat"
+        self._mk_segmented(store)
+        proc = TestFsck._fsck("--store", str(store))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip())
+        assert out["layout"] == "segmented" and out["status"] == "clean"
+        assert all(row["verdict"] == 0 for row in out["segments"])
+
+    def test_segmented_corruption_salvaged_per_segment(self, tmp_path):
+        from p1_tpu.chain import ChainStore
+
+        store = tmp_path / "seg.dat"
+        blocks, st = self._mk_segmented(store)
+        seg_dir = tmp_path / "seg.dat.d"
+        victim = seg_dir / st.segments[1].name
+        data = bytearray(victim.read_bytes())
+        # Flip a record's length prefix inside ONE sealed segment.
+        off, _n = ChainStore.scan(bytes(data)).spans[0]
+        data[off - 4] ^= 0x10
+        victim.write_bytes(bytes(data))
+        untouched = {
+            s.name: (seg_dir / s.name).read_bytes()
+            for s in st.segments
+            if s.name != victim.name
+        }
+        proc = TestFsck._fsck("--store", str(store), "--json")
+        assert proc.returncode == 1, (proc.stdout, proc.stderr[-2000:])
+        out = json.loads(proc.stdout.strip())
+        assert out["status"] == "salvaged"
+        by_name = {row["segment"]: row for row in out["segments"]}
+        assert by_name[victim.name]["verdict"] == 1
+        assert by_name[victim.name]["bad_spans"] == 1
+        assert sum(r["verdict"] for r in out["segments"]) == 1
+        # Containment: every OTHER segment's bytes untouched, evidence
+        # quarantined next to the victim.
+        for name, before in untouched.items():
+            assert (seg_dir / name).read_bytes() == before, name
+        assert (seg_dir / f"{victim.name}.quarantine").exists()
+        # Second pass: clean, exit 0.
+        assert TestFsck._fsck("--store", str(store)).returncode == 0
+
+    def test_segmented_refuses_out_flag(self, tmp_path):
+        store = tmp_path / "seg.dat"
+        self._mk_segmented(store)
+        proc = TestFsck._fsck(
+            "--store", str(store), "--out", str(tmp_path / "x.dat")
+        )
+        assert proc.returncode == 2
+        assert "in place" in proc.stderr
+
+    def test_locked_segmented_store_exit_2(self, tmp_path):
+        from p1_tpu.chain import SegmentedStore
+
+        store = tmp_path / "seg.dat"
+        self._mk_segmented(store)
+        holder = SegmentedStore(store)
+        holder.acquire()
+        try:
+            proc = TestFsck._fsck("--store", str(store))
+            assert proc.returncode == 2
+            assert "locked" in proc.stderr
+        finally:
+            holder.close()

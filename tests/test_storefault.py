@@ -626,3 +626,54 @@ class TestNodeDegradation:
                 await node.stop()
 
         run(scenario())
+
+
+class TestCompactWriteFailure:
+    """Satellite (round 18): compaction ENOSPC mid-rewrite must leave
+    the original store byte-identical AND release the writer flock —
+    the whole-file os.replace path was untested under tmp-write
+    failure."""
+
+    def test_enospc_mid_rewrite_original_untouched_lock_released(
+        self, tmp_path, blocks
+    ):
+        import functools
+
+        from p1_tpu.chain.tooling import run_compact
+
+        path = tmp_path / "chain.dat"
+        _fill_store(path, blocks)
+        before = path.read_bytes()
+        store_cls = functools.partial(
+            FaultStore,
+            plan=StoreFaultPlan(fail_write_at=3, write_errno=errno.ENOSPC),
+        )
+        rc = run_compact(str(path), None, store_cls=store_cls)
+        assert rc == 2
+        # The original store was never touched...
+        assert path.read_bytes() == before
+        # ...the partial tmp was removed...
+        assert not list(tmp_path.glob("*.compact.*"))
+        # ...and the writer flock was released: a fresh writer works.
+        st = ChainStore(path)
+        st.acquire()  # would raise "locked by another process" on a leak
+        st.close()
+
+    def test_enospc_with_out_flag_leaves_both_paths(self, tmp_path, blocks):
+        import functools
+
+        from p1_tpu.chain.tooling import run_compact
+
+        path = tmp_path / "chain.dat"
+        _fill_store(path, blocks)
+        before = path.read_bytes()
+        out = tmp_path / "out.dat"
+        store_cls = functools.partial(
+            FaultStore,
+            plan=StoreFaultPlan(fail_write_at=2, write_errno=errno.ENOSPC),
+        )
+        rc = run_compact(str(path), str(out), store_cls=store_cls)
+        assert rc == 2
+        assert path.read_bytes() == before
+        # The destination acquired its magic but no record ever landed.
+        assert ChainStore(out).load_blocks() == []

@@ -259,8 +259,15 @@ STATUS_KEYS = [
     "storage.last_error",
     "storage.pending_records",
     "storage.persistent",
+    "storage.pruned",
+    "storage.pruned.enabled",
+    "storage.pruned.floor",
+    "storage.pruned.keep_blocks",
+    "storage.pruned.refusals",
+    "storage.pruned.segments_pruned",
     "storage.recoveries",
     "storage.retries",
+    "storage.segmented",
     "sync",
     "sync.cblock_fetch_stalls",
     "sync.demotions",
