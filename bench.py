@@ -189,6 +189,42 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Staged node (round 19): the same ingest shape through the
+    # pipeline driver (node/pipeline.py) at 1 worker, against ITS
+    # recorded constant — staged ingest pays cold-cache signature math
+    # and fsynced appends, so it has its own denominator; the unstaged
+    # same-driver control rides along so the staging overhead is a
+    # measured per-session number (docs/PERF.md "Staged node").
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_STAGED_INGEST_BPS,
+        STAGED_INGEST_DEGRADED_FRACTION,
+    )
+
+    try:
+        import tempfile
+
+        from benchmarks.host_ingest import bench_staged_ingest
+
+        with tempfile.TemporaryDirectory() as _staged_tmp:
+            rungs = bench_staged_ingest(
+                raws, 1, [1], repeats=2, tmpdir=_staged_tmp
+            )
+        staged_bps = rungs[1]
+        extra["staged_ingest_bps"] = round(staged_bps)
+        extra["staged_ingest_vs_recorded"] = round(
+            staged_bps / RECORDED_STAGED_INGEST_BPS, 2
+        )
+        if rungs[0] > 0:
+            extra["staged_overhead_pct"] = round(
+                (rungs[0] - staged_bps) / rungs[0] * 100.0, 1
+            )
+        if staged_bps < (
+            STAGED_INGEST_DEGRADED_FRACTION * RECORDED_STAGED_INGEST_BPS
+        ):
+            extra["staged_ingest_degraded"] = True
+    except (ImportError, NameError):
+        pass  # bare package, or the ingest fixtures above didn't build
+
     # Telemetry plane (round 14): what the stage spans cost the same
     # ingest pipeline — blocks/s through the node's dispatch front door
     # with telemetry on vs off (benchmarks/telemetry_overhead.py), the

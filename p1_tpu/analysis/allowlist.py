@@ -116,11 +116,13 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
     "set-iteration": {},
     "blocking-in-async": {
         # Currently EMPTY: no direct blocking calls run on any async
-        # loop today (store fsyncs go through sync helpers called from
-        # sync paths or asyncio.to_thread — see node.py's
-        # _checkpoint_mempool for the house pattern).  Grants added
-        # here are acknowledged ROADMAP item-5 debt: each one names a
-        # call the multi-core stage split must move off-loop.
+        # loop today.  Store fsyncs, signature preverification, and
+        # checkpoint writes all travel through NodePipeline.run_store /
+        # run_validate (node/pipeline.py) — callables handed to a lane,
+        # never called from the coroutine — so the house pattern for new
+        # blocking work is "give it to the pipeline", not "grant it
+        # here".  A grant added here is acknowledged debt: each one
+        # names a call the staged pipeline has not absorbed yet.
     },
     "await-state": {},
     # -- transitive-blocking (round 16): THE ROADMAP-2 OFFLOAD WORK
@@ -131,54 +133,50 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
     #    multi-core split must move it to.  Removing a grant here
     #    should mean the chain moved off-loop — not that the lint
     #    stopped seeing it.
+    #
+    #    Round 19 retired ten of the twelve node/node.py grants: the
+    #    staged pipeline (node/pipeline.py) now owns every chain they
+    #    named.  Per-retirement record, auditable against the round-16
+    #    reasons above each key's old text (git log -p this file):
+    #
+    #    - Node._handle_block->ctypes.CDLL: wire blocks preverify
+    #      signatures on the VALIDATE lane before add_block; the
+    #      residual on-loop check_block verify is a sig-cache hit for
+    #      every honestly-signed block (only invalid-signature blocks
+    #      pay it, bounded by the ban that follows) and goes through
+    #      Chain.check_block, an instance-attribute seam the call
+    #      graph correctly no longer binds to the ctypes engine.
+    #    - Node._handle_block->open: _store_append submits
+    #      _store_flush_io to the STORE lane; append+fsync left the
+    #      loop.
+    #    - Node._dispatch->ctypes.CDLL: BLOCKS/MEMPOOL batch
+    #      preverification runs on the VALIDATE lane.
+    #    - Node._dispatch->os.fsync: the BLOCKS batch-close sync runs
+    #      on the STORE lane (_store_sync_io).
+    #    - Node._store_recovery_loop->open / ->os.fsync: degraded-mode
+    #      flush retries and the recovery sync probe submit the same
+    #      _io helpers to the STORE lane.
+    #    - Node._adopt_snapshot->open / ->os.fsync: the snapshot
+    #      sidecar write and the genesis-first store rewrite run on
+    #      the STORE lane.
+    #    - Node._snapshot_flip->os.fsync and
+    #      Node._snapshot_diverged->os.fsync: _rewrite_store — the
+    #      heaviest single blocking window in the node — runs on the
+    #      STORE lane for both the flip and the quarantine path.
+    #
+    #    The two survivors are boundary cases by design, not misses:
+    #    start() has no sessions to stall and stop() drains the
+    #    pipeline BEFORE its final flush precisely so shutdown IO can
+    #    stay synchronous.
     "transitive-blocking": {
         "node/node.py": {
-            "Node._handle_block->ctypes.CDLL": "VALIDATE stage: "
-            "check_block's batched Ed25519 (native engine behind the "
-            "ctypes seam) runs on the loop — the split's worker-pool "
-            "stage; the PR-5 verify pool only covers the wheel backend",
-            "Node._handle_block->open": "STORE stage: _store_append → "
-            "ChainStore.append fsyncs the accepted block on the loop — "
-            "the durability barrier the split moves to a store worker",
-            "Node._dispatch->ctypes.CDLL": "VALIDATE stage: deep-sync "
-            "BLOCKS batches preverify signatures (native seam) inline "
-            "in the dispatcher — same worker-pool stage as "
-            "_handle_block's verify",
-            # Round 18: ``self.store`` is a SegmentedStore or a
-            # ChainStore depending on layout; the binder unifies the
-            # conditional's two constructors to the ChainStore BASE
-            # (callgraph._unify_classes), so every store chain below
-            # stays provable across both layouts.
-            "Node._dispatch->os.fsync": "STORE stage: the BLOCKS "
-            "batch-sync path syncs the store inline after a quiesced "
-            "catch-up episode",
             "Node.start->open": "startup-only: the resume path opens/"
             "locks/replays the store before the node serves a single "
             "frame — no session exists to stall; stays on-loop by "
             "design",
             "Node.stop->open": "shutdown-only: the final store flush "
-            "runs after serving stopped; a worker would just add a "
-            "join",
-            "Node._store_recovery_loop->open": "STORE stage: degraded-"
-            "mode disk retries flush pending records on the loop; the "
-            "split gives the store worker the retry queue",
-            "Node._store_recovery_loop->os.fsync": "STORE stage: the "
-            "recovery probe's explicit sync — same store worker as the "
-            "flush",
-            "Node._adopt_snapshot->open": "snapshot adoption writes "
-            "the .snapshot sidecar inline — rare (once per IBD), but "
-            "the split's store worker should own sidecar IO too",
-            "Node._adopt_snapshot->os.fsync": "same sidecar write's "
-            "directory fsync (fsync_dir) — store-worker debt with the "
-            "flip/diverge rewrites below",
-            "Node._snapshot_flip->os.fsync": "snapshot flip rewrites "
-            "the store genesis-first on the loop (save_chain + "
-            "dir-fsync in _rewrite_store) — the heaviest single "
-            "blocking window in the node (~seconds at 100k); a "
-            "flagship ROADMAP-2 offload",
-            "Node._snapshot_diverged->os.fsync": "divergence "
-            "quarantines the sidecar and rewrites the store on the "
-            "loop — same store-worker offload as the flip path",
+            "runs after pipeline.drain_and_close() joined the store "
+            "worker; a lane submit here would race its own teardown",
         },
         "node/queryplane.py": {
             "serve_replica->open": "replica attach (ReplicaView "

@@ -250,6 +250,15 @@ class Chain:
         #: bytes-bounded LRUs the node charges to its memory gauge.
         self.proof_cache = ProofCache()
         self.filter_index = FilterIndex()
+        #: Stateless-validation entry point used by ``_insert`` and
+        #: ``_park_orphan`` — an instance attribute so the staged node
+        #: (node/pipeline.py) can interpose and so tests can instrument
+        #: connect order.  With the staged pipeline on, every wire
+        #: block's signatures are pre-verified OFF-loop before
+        #: ``add_block`` runs, so this call is a sig-cache hit on the
+        #: valid path — only hostile (invalid-signature) blocks pay an
+        #: on-loop verify here, bounded by the ban that follows.
+        self.check_block = check_block
 
     @classmethod
     def from_snapshot(
@@ -857,7 +866,7 @@ class Chain:
                 return AddStatus.REJECTED, reason
         if not prevalidated:
             try:
-                check_block(
+                self.check_block(
                     block,
                     expected,
                     chain_tag=self.genesis.block_hash(),
@@ -979,7 +988,7 @@ class Chain:
             # work (same floor as proof.py's SPV check).
             return AddStatus.REJECTED, "difficulty-0 block carries no work"
         try:
-            check_block(
+            self.check_block(
                 block,
                 claimed,
                 chain_tag=self.genesis.block_hash(),

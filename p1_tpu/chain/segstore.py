@@ -493,12 +493,13 @@ class SegmentedStore(ChainStore):
         self._active = None
         self._active_size = None
         self._append_off = None
-        for fd in self._read_fds.values():
-            os.close(fd)
-        self._read_fds.clear()
-        if self._read_fd is not None:
-            os.close(self._read_fd)
-            self._read_fd = None
+        with self._fd_lock:
+            for fd in self._read_fds.values():
+                os.close(fd)
+            self._read_fds.clear()
+            if self._read_fd is not None:
+                os.close(self._read_fd)
+                self._read_fd = None
         if self._lock_fh is not None:
             self._lock_fh.close()
             self._lock_fh = None
@@ -720,9 +721,10 @@ class SegmentedStore(ChainStore):
 
         self._body_spans.clear()
         self.read_failed_segments.clear()
-        for fd in self._read_fds.values():
-            os.close(fd)
-        self._read_fds.clear()
+        with self._fd_lock:
+            for fd in self._read_fds.values():
+                os.close(fd)
+            self._read_fds.clear()
         for seg in self._live_segments():
             try:
                 data = self._read_bytes_path(self._seg_path(seg))
@@ -740,6 +742,9 @@ class SegmentedStore(ChainStore):
     # -- body refetch ------------------------------------------------------
 
     def _seg_fd(self, seg_id: int) -> int:
+        # Callers hold ``self._fd_lock`` (read-fd lifecycle guard for the
+        # staged node — the eviction close below must not land under a
+        # concurrent pread on the victim's fd).
         fd = self._read_fds.get(seg_id)
         if fd is None:
             seg = self._seg_by_id(seg_id)
@@ -759,7 +764,8 @@ class SegmentedStore(ChainStore):
         off = (span >> _SPAN_SHIFT) & ((1 << (_SEG_SHIFT - _SPAN_SHIFT)) - 1)
         n = span & ((1 << _SPAN_SHIFT) - 1)
         try:
-            raw = self._pread(self._seg_fd(seg_id), n, off)
+            with self._fd_lock:
+                raw = self._pread(self._seg_fd(seg_id), n, off)
             if len(raw) != n:
                 raise OSError(
                     f"{self.seg_dir}/seg{seg_id:05d}: short body read at {off}"
@@ -770,9 +776,10 @@ class SegmentedStore(ChainStore):
             # recovery loop re-probes (bodies in OTHER segments keep
             # serving throughout).
             self.read_failed_segments.add(seg_id)
-            fd = self._read_fds.pop(seg_id, None)
-            if fd is not None:
-                os.close(fd)
+            with self._fd_lock:
+                fd = self._read_fds.pop(seg_id, None)
+                if fd is not None:
+                    os.close(fd)
             raise
         block = Block.deserialize(raw)
         if block.block_hash() != block_hash:
@@ -820,9 +827,10 @@ class SegmentedStore(ChainStore):
                 )
             os.unlink(self._seg_path(seg))
             seg.pruned = True
-            fd = self._read_fds.pop(seg.seg_id, None)
-            if fd is not None:
-                os.close(fd)
+            with self._fd_lock:
+                fd = self._read_fds.pop(seg.seg_id, None)
+                if fd is not None:
+                    os.close(fd)
             self.pruned_below = max(self.pruned_below, seg.max_height + 1)
         self._fsync_dir_path(self.seg_dir)
         self._write_manifest()

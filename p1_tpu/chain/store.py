@@ -35,6 +35,7 @@ import fcntl
 import io
 import os
 import struct
+import threading
 import zlib
 from pathlib import Path
 from typing import Iterator
@@ -157,6 +158,14 @@ class ChainStore:
         #: evictability of post-incident blocks, never correctness).
         self._append_off: int | None = None
         self._read_fd: int | None = None
+        #: Read-fd lifecycle guard for the staged node (node/pipeline.py):
+        #: ``read_body`` preads can run on the event-loop thread while the
+        #: store-writer lane rewrites/compacts/prunes on its worker — the
+        #: pread itself is seek-free and page-cache-safe, but open/close of
+        #: the shared read fd must not race a read in flight (a close
+        #: between the ``is None`` check and the pread would pread a dead —
+        #: or worse, recycled — descriptor).
+        self._fd_lock = threading.Lock()
 
     # -- file-layer seams (FaultStore overrides these) --------------------
     #
@@ -377,9 +386,10 @@ class ChainStore:
             self._fh.close()
             self._fh = None
         self._append_off = None
-        if self._read_fd is not None:
-            os.close(self._read_fd)
-            self._read_fd = None
+        with self._fd_lock:
+            if self._read_fd is not None:
+                os.close(self._read_fd)
+                self._read_fd = None
 
     # -- the framing walk -------------------------------------------------
 
@@ -527,8 +537,9 @@ class ChainStore:
         data = self._read_checked()
         spans = list(self._record_spans(data))
         del data
-        if self._read_fd is None:
-            self._read_fd = os.open(self.path, os.O_RDONLY)
+        with self._fd_lock:
+            if self._read_fd is None:
+                self._read_fd = os.open(self.path, os.O_RDONLY)
         for off, n in spans:
             raw = self._pread(self._read_fd, n, off)
             if len(raw) != n:
@@ -569,9 +580,10 @@ class ChainStore:
         from p1_tpu.core.hashutil import sha256d
 
         self._body_spans.clear()
-        if self._read_fd is not None:
-            os.close(self._read_fd)  # points at the replaced inode
-            self._read_fd = None
+        with self._fd_lock:
+            if self._read_fd is not None:
+                os.close(self._read_fd)  # points at the replaced inode
+                self._read_fd = None
         if not self.path.exists():
             return 0
         data = self._read_checked()
@@ -597,9 +609,10 @@ class ChainStore:
         a mismatch is a store-layer bug, not peer input, so it raises."""
         span = self._body_spans[block_hash]
         off, n = span >> _SPAN_SHIFT, span & ((1 << _SPAN_SHIFT) - 1)
-        if self._read_fd is None:
-            self._read_fd = os.open(self.path, os.O_RDONLY)
-        raw = self._pread(self._read_fd, n, off)
+        with self._fd_lock:
+            if self._read_fd is None:
+                self._read_fd = os.open(self.path, os.O_RDONLY)
+            raw = self._pread(self._read_fd, n, off)
         if len(raw) != n:
             raise OSError(f"{self.path}: short body read at {off}")
         block = Block.deserialize(raw)

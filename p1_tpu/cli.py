@@ -167,6 +167,18 @@ def build_parser() -> argparse.ArgumentParser:
         "releases the GIL); never changes validation outcomes",
     )
     p.add_argument(
+        "--pipeline-workers",
+        type=int,
+        default=0,
+        help="staged block pipeline: off-loop worker lanes for the "
+        "validate and store stages (node/pipeline.py).  0 = inline "
+        "historical node (every stage on the event loop); N >= 1 moves "
+        "batched signature pre-verification and the whole fsync chain "
+        "onto worker threads and, when --verify-workers is 0, sizes "
+        "the verify pool to N.  Never changes validation outcomes or "
+        "wire behavior, only where the CPU/IO cost is paid",
+    )
+    p.add_argument(
         "--sig-backend",
         default="auto",
         choices=["auto", "cryptography", "native", "fallback", "device"],

@@ -125,6 +125,18 @@ class NodeConfig:
     #: per window instead.  Worker count NEVER changes validation
     #: outcomes, only where the verify cost is paid.
     verify_workers: int = 0
+    #: Staged block pipeline (node/pipeline.py, round 19): off-loop
+    #: worker lanes for the validate and store stages.  0 (the default)
+    #: keeps the historical inline node — every stage on the event
+    #: loop, scheduling byte-identical to before the refactor.  N >= 1
+    #: moves batched signature pre-verification and the whole fsync
+    #: chain (append, checkpoints, snapshot flips) onto worker threads
+    #: and, when ``verify_workers`` is 0, sizes the Ed25519 verify pool
+    #: to N.  Staging NEVER changes validation outcomes or wire
+    #: behavior — the network simulator proves the trace digest is
+    #: byte-identical with staging on or off — only where the CPU/IO
+    #: cost is paid.
+    pipeline_workers: int = 0
     #: Signature-verification backend (core/keys.py ladder, round 15).
     #: "auto" (default) resolves wheel > native C++ engine > pure-Python
     #: fallback; "cryptography"/"native" pin a rung (degrading down the

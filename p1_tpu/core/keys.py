@@ -272,6 +272,25 @@ def backend() -> str:
     return want
 
 
+def backend_label() -> str:
+    """The backend name WITHOUT resolving: the memoized rung if any
+    verification already ran, else the pin/env request verbatim.
+
+    Status planes read this instead of ``backend()`` because resolution
+    may probe the native rung — a ctypes load that can compile the
+    shared object once — and a GETSTATUS served from the node's event
+    loop must never be the call that pays it.  The only divergence from
+    ``backend()`` is a node that has verified nothing yet, which
+    reports the request (``auto``/pin) rather than forcing the probe.
+    """
+    if _resolved is not None:
+        return _resolved
+    if _sig_backend is not None:
+        return _sig_backend
+    want = os.environ.get("P1_SIG_BACKEND") or "auto"
+    return want if want in SIG_BACKENDS else "auto"
+
+
 def _serial_backend() -> str:
     """Where one-at-a-time verifies go: the active backend, except that
     ``device`` is batch-only and serial work takes the ladder beneath."""

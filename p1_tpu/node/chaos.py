@@ -143,7 +143,11 @@ def generate_schedule(
     - ``snap_liar`` — a hostile SNAPSHOT SERVER (lying balances, a
       corrupted root, a truncated chunk stream, or a full stall) plus a
       joiner that dials it first and an honest node second: the joiner
-      must detect/contain the lie and still converge.
+      must detect/contain the lie and still converge;
+    - ``stage_crash`` — a crash at one pipeline stage boundary
+      (node/pipeline.py): validate/store arm a one-shot lane-worker
+      death on a live node (respawn-and-retry must hold), the on-loop
+      stages (frame/admission/relay) crash the process, stage-tagged.
     """
     rng = random.Random((seed << 3) ^ 0xC4A05)
     joiners: set[int] = set()
@@ -197,6 +201,14 @@ def generate_schedule(
             ops.append(("prune", 0.5))
         if crashed:
             ops.append(("compact_crash", 0.5))
+        # Staged-pipeline plane (round 19): a crash at every stage
+        # boundary.  The two lane stages (validate/store) die as WORKER
+        # deaths — the pipeline must respawn the lane and retry without
+        # losing the job; the three on-loop stages (frame/admission/
+        # relay) have no thread to kill, so their boundary crash IS a
+        # process crash, recorded with the stage name.
+        if len(crashed) < max(1, n_nodes - 2):
+            ops.append(("stage_crash", 1.0))
         op = rng.choices([o for o, _ in ops], [w for _, w in ops])[0]
         ev: dict = {"at": at, "op": op}
         if op == "mine":
@@ -273,6 +285,18 @@ def generate_schedule(
         elif op == "compact_crash":
             ev["node"] = rng.choice(sorted(crashed))
             ev["junk"] = rng.randrange(1, 1 << 16)
+        elif op == "stage_crash":
+            from p1_tpu.node.pipeline import LANE_STAGES, STAGES
+
+            universe = [*range(n_nodes), *sorted(joiners)]
+            victims = [i for i in universe if i not in crashed]
+            ev["node"] = rng.choice(victims)
+            ev["stage"] = rng.choice(STAGES)
+            if ev["stage"] not in LANE_STAGES:
+                # On-loop stage boundary: the process dies (clean kill —
+                # torn appends belong to the plain crash op).
+                crashed.add(ev["node"])
+                disks_down.discard(ev["node"])
         events.append(ev)
     return events
 
@@ -578,6 +602,7 @@ def run_chaos(
     txs: bool = True,
     keep_trace: bool = False,
     rss_bound_mb: float | None = None,
+    pipeline_workers: int = 0,
 ) -> dict:
     """Run one chaos schedule end to end and return the report.
 
@@ -613,6 +638,7 @@ def run_chaos(
                 txs=txs,
                 keep_trace=keep_trace,
                 rss_bound_mb=rss_bound_mb,
+                pipeline_workers=pipeline_workers,
             )
     t0 = time.monotonic()
     net = SimNet(
@@ -625,6 +651,10 @@ def run_chaos(
         # boundaries) — crashes/torn writes/bit-rot now land on segment
         # files, and the fsck invariant verdicts per segment.
         segmented_store=store_dir is not None,
+        # Round 19: staged-node sweeps run the whole corpus with lane
+        # workers enabled; the virtual loop keeps lane jobs synchronous
+        # (SimLoop.run_in_executor), so the digest stays seed-stable.
+        pipeline_workers=pipeline_workers,
     )
     runner = _ChaosRunner(
         net, nodes, difficulty, inject_bug, settle_vs, wall_limit_s,
@@ -842,6 +872,28 @@ class _ChaosRunner:
             tmp = victim.with_name(f"{victim.name}.seg.{ev['junk']}")
             tmp.write_bytes(b"P1TPUCH3" + bytes([ev["junk"] & 0xFF]) * 64)
             self._record("compact_crash", host)
+        elif op == "stage_crash":
+            from p1_tpu.node.pipeline import LANE_STAGES
+
+            stage = ev["stage"]
+            if stage in LANE_STAGES:
+                # Lane-worker death on a LIVE node: the pipeline must
+                # respawn the lane and retry the job (fires inline at
+                # pipeline_workers=0 too, so the sim exercises the same
+                # accounting) — the invariants then prove nothing was
+                # lost at the boundary.
+                host = self._alive(ev["node"])
+                if host is not None:
+                    self._record("stage_crash", host, stage)
+                    net.nodes[host].pipeline.fail_next(stage)
+            else:
+                # On-loop stage boundary: no thread to kill — the
+                # process dies, stage-tagged in the trace.
+                host = self.hosts[ev["node"]]
+                if host in net.nodes:
+                    self._record("stage_crash", host, stage)
+                    await net.crash_node(host, torn=0)
+                    self.counts["crashes"] += 1
         elif op == "partition":
             k = max(1, min(self.n - 1, int(self.n * ev["frac"])))
             self.partitioned = True

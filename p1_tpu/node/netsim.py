@@ -586,6 +586,7 @@ class SimNet:
         telemetry: bool = True,
         segmented_store: bool = False,
         segment_bytes: int = 1 << 14,
+        pipeline_workers: int = 0,
     ):
         from pathlib import Path
 
@@ -621,6 +622,13 @@ class SimNet:
         #: schedule corpus over segmented stores this way.
         self.segmented_store = segmented_store
         self.segment_bytes = segment_bytes
+        #: Default for every spawned node's ``config.pipeline_workers``
+        #: (node/pipeline.py, round 19).  Under the virtual loop a lane
+        #: submission completes synchronously (``SimLoop.run_in_executor``
+        #: above), so flipping this must not move the trace digest —
+        #: the staging determinism pair in tests/test_pipeline.py pins
+        #: exactly that, the same observer contract as ``telemetry``.
+        self.pipeline_workers = pipeline_workers
         #: host -> live FaultStore (chaos events re-arm plans on these).
         self.stores: dict[str, object] = {}
         #: Hosts currently dead from ``crash_node`` (host -> the dead
@@ -674,6 +682,7 @@ class SimNet:
         cfg.setdefault("mempool_ttl_s", 0.0)
         cfg.setdefault("rng_seed", self.rng.getrandbits(48))
         cfg.setdefault("telemetry", self.telemetry)
+        cfg.setdefault("pipeline_workers", self.pipeline_workers)
         if self.store_dir is not None:
             cfg.setdefault("store_path", str(self.store_dir / f"{host}.dat"))
         peer_strs = tuple(

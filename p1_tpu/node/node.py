@@ -50,6 +50,7 @@ from p1_tpu.node.governor import (
     WRITE_QUEUE_GOSSIP_MAX,
     ResourceGovernor,
 )
+from p1_tpu.node.pipeline import NodePipeline, WorkerCrash
 from p1_tpu.node.protocol import Hello, MsgType
 from p1_tpu.node.supervision import RequestSupervisor
 from p1_tpu.node.transport import SOCKET_TRANSPORT, Transport
@@ -322,6 +323,7 @@ _METRIC_COUNTERS = (
     "snapshot_fallbacks",
     "snapshot_stalls",
     "revalidated_blocks",
+    "worker_respawns",
 )
 #: Float-valued point-in-time fields (mining timing).
 _METRIC_GAUGES = ("mine_elapsed_s", "last_block_time_s")
@@ -629,6 +631,13 @@ class Node:
             # must survive multi-node test processes where the conftest
             # knob pinned workers=1 for determinism.
             keys.set_verify_workers(config.verify_workers)
+        elif config.pipeline_workers > 0:
+            # Staged pipeline sizing: --pipeline-workers N without an
+            # explicit verify pin sizes the Ed25519 verify pool too —
+            # the validate lane's parallelism lives INSIDE the verify
+            # pool (one lane thread fanning a preverify batch), so the
+            # two knobs default together.
+            keys.set_verify_workers(config.pipeline_workers)
         if config.sig_backend != "auto":
             # Same explicit-pin discipline for the signature backend
             # (core/keys.py ladder): "auto" must not clobber another
@@ -715,6 +724,19 @@ class Node:
             watermark_bytes=config.mem_watermark_bytes,
             admission=config.admission_control,
             clock=self.clock.monotonic,
+        )
+        #: Staged block pipeline (node/pipeline.py, round 19): the
+        #: validate and store lanes every CPU/IO-heavy stage routes
+        #: through.  workers=0 (default) executes inline — scheduling
+        #: byte-identical to the historical dispatch-everything-inline
+        #: node; workers>=1 moves signature pre-verification and the
+        #: whole fsync chain onto worker threads.  Lane depth/bytes feed
+        #: ``_memory_gauge`` so queue growth back-pressures at the
+        #: governor's front door; worker deaths respawn and count into
+        #: the task-crash lineage below.
+        self.pipeline = NodePipeline(
+            workers=config.pipeline_workers,
+            on_respawn=self._worker_respawned,
         )
         if miner is not None:
             self.miner = miner
@@ -848,6 +870,13 @@ class Node:
             self.metrics.task_crashes += 1
             self.log.error("session task %r died: %r", task.get_name(), exc)
 
+    def _worker_respawned(self, stage: str) -> None:
+        """Pipeline lane worker died and was respawned (node/pipeline.py
+        supervision) — same observability contract as the task
+        supervisor above: the crash is COUNTED, never silent."""
+        self.metrics.worker_respawns += 1
+        self.log.warning("%s pipeline worker died; respawned", stage)
+
     def _addr_book_path(self):
         return (
             Path(f"{self.config.store_path}.addrs")
@@ -923,15 +952,21 @@ class Node:
             return
         mutations = self.mempool.mutations
         rows = self.mempool.snapshot()
+        # Store-lane seam, not a bare to_thread: the checkpoint rides
+        # the same writer lane as every other persistence chain (append
+        # order with respect to block writes is preserved when staged),
+        # and ``offload=True`` keeps it off-loop at workers=0 exactly as
+        # the historical to_thread call did.
         self._mempool_io = asyncio.create_task(
-            asyncio.to_thread(
-                lambda: write_mempool_file(dump_mempool(rows), path)
+            self.pipeline.run_store(
+                lambda: write_mempool_file(dump_mempool(rows), path),
+                offload=True,
             )
         )
         try:
             await self._mempool_io
             self._mempool_saved_at = mutations
-        except OSError as e:
+        except (OSError, WorkerCrash) as e:
             self.log.warning("could not persist mempool %s: %s", path, e)
         finally:
             self._mempool_io = None
@@ -1309,6 +1344,11 @@ class Node:
             # the authoritative shutdown save, or the stale file could
             # land second and roll back every admission since.
             await asyncio.gather(self._mempool_io, return_exceptions=True)
+        # Drain the pipeline lanes: any store-lane job already submitted
+        # (appends, prune sidecars, checkpoint writes) completes before
+        # the synchronous shutdown writes below — stop() must never race
+        # its own store worker for the flock.
+        self.pipeline.drain_and_close()
         self._save_mempool()
         if self.store is not None:
             if self._store_pending:
@@ -1363,22 +1403,51 @@ class Node:
 
     # -- storage durability (degraded serve-only mode) --------------------
 
-    def _store_append(self, blocks) -> None:
-        """Persist freshly accepted blocks.  A failing disk (ENOSPC, EIO,
-        fsync error) degrades the NODE instead of unwinding the
-        connection handler that happened to deliver the block — the
-        fault is the disk's, never the peer's, and dropping the session
-        would punish a healthy peer and reconnect-loop forever against
-        the same full disk."""
+    async def _store_append(self, blocks) -> None:
+        """Persist freshly accepted blocks — the STORE stage.  The
+        append + fsync chain runs on the pipeline's store-writer lane
+        (inline when staging is off), so the event loop never waits on
+        the disk when a worker is configured; failure handling stays on
+        the loop (``_store_fail`` touches asyncio state).
+
+        A failing disk (ENOSPC, EIO, fsync error) degrades the NODE
+        instead of unwinding the connection handler that happened to
+        deliver the block — the fault is the disk's, never the peer's,
+        and dropping the session would punish a healthy peer and
+        reconnect-loop forever against the same full disk."""
         if self.store is None:
             return
         self._store_pending.extend(blocks)
         if not self._store_degraded:
-            if self._store_flush():
-                self._maybe_prune()
+            if await self._store_flush_staged(
+                nbytes=sum(len(b.serialize()) for b in blocks)
+            ):
+                await self._maybe_prune()
+
+    async def _store_flush_staged(self, nbytes: int = 0) -> bool:
+        """Drain pending records via the store lane; True = caught up."""
+        exc = await self.pipeline.run_store(self._store_flush_io, nbytes=nbytes)
+        if exc is not None:
+            self._store_fail(exc)
+            return False
+        return True
 
     def _store_flush(self) -> bool:
-        """Write every pending record in order; True when caught up."""
+        """Synchronous drain (the shutdown path — stop() runs after the
+        pipeline lanes closed, so the final flush is direct by design)."""
+        exc = self._store_flush_io()
+        if exc is not None:
+            self._store_fail(exc)
+            return False
+        return True
+
+    def _store_flush_io(self) -> OSError | None:
+        """Pure IO: write every pending record in order; returns the
+        failure instead of raising (it runs on the store lane, and the
+        degradation machinery — supervisor spawns, asyncio.Event — must
+        only ever run on the loop).  Reads of ``_store_pending`` and
+        the chain index are GIL-atomic; the lane is single-threaded, so
+        two drains never interleave."""
         while self._store_pending:
             block = self._store_pending[0]
             try:
@@ -1390,24 +1459,23 @@ class Node:
                     block, height=entry.height if entry else None
                 )
             except OSError as e:
-                self._store_fail(e)
-                return False
+                return e
             self._store_pending.pop(0)
-        return True
+        return None
 
-    def _maybe_prune(self) -> None:
+    async def _maybe_prune(self) -> None:
         """Pruned mode (round 18): discard body segments wholly below
         the prune floor — the older of (tip - prune_keep_blocks) and
         the latest snapshot-checkpoint height, so a pruned node can
         always still serve its newest snapshot's rollback window.
         Cheap when there is nothing to do (one pass over the manifest
-        rows); actual pruning is an unlink + manifest rewrite per
-        discarded segment."""
+        rows).  The decision and the ledger-state capture run ON-loop
+        (they read live chain structures the loop mutates); the sidecar
+        write + unlinks run on the store lane."""
         keep = self.config.prune_keep_blocks
         if keep <= 0 or self.store is None:
             return
-        prune_below = getattr(self.store, "prune_below", None)
-        if prune_below is None:
+        if getattr(self.store, "prune_below", None) is None:
             return  # single-file layout: nothing to discard per segment
         interval = self.chain.checkpoint_interval
         checkpoint = (self.chain.height // interval) * interval
@@ -1416,44 +1484,60 @@ class Node:
             return
         if not self.store.prunable_segments(floor):
             return
-        try:
-            # The prune-base sidecar FIRST, durably: our own validated
-            # state at the latest checkpoint is what the next boot
-            # anchors on once the history below it stops existing.
-            state = self.chain.snapshot_state()
-            if state is None:
-                return
-            s_height, s_block, balances, nonces, _root = state
-            manifest, chunks = chain_snapshot.build_records(
-                s_height, s_block, balances, nonces
+        # The prune-base sidecar FIRST, durably: our own validated
+        # state at the latest checkpoint is what the next boot
+        # anchors on once the history below it stops existing.
+        state = self.chain.snapshot_state()
+        if state is None:
+            return
+        s_height, s_block, balances, nonces, _root = state
+        manifest, chunks = chain_snapshot.build_records(
+            s_height, s_block, balances, nonces
+        )
+        result = await self.pipeline.run_store(
+            self._prune_io, manifest, chunks, floor
+        )
+        if isinstance(result, OSError):
+            self._store_fail(result)
+            return
+        if result:
+            self.metrics.store_segments_pruned += result
+            self.chain.prune_floor = self.store.pruned_below
+            self.log.info(
+                "pruned %d body segment(s) below height %d "
+                "(headers retained)",
+                result,
+                self.store.pruned_below,
             )
+
+    def _prune_io(self, manifest, chunks, floor) -> int | OSError:
+        """Store-lane half of pruning: durable prune-base sidecar, then
+        the segment unlinks.  Returns segments removed, or the failure."""
+        try:
             base_path = self._prunebase_path()
             tmp = base_path.with_name(f"{base_path.name}.{os.getpid()}")
             chain_snapshot.write_snapshot(tmp, manifest, chunks)
             os.replace(tmp, base_path)
             fsync_dir(base_path.parent)
-            n = prune_below(floor)
+            return self.store.prune_below(floor)
         except OSError as e:
-            self._store_fail(e)
-            return
-        if n:
-            self.metrics.store_segments_pruned += n
-            self.chain.prune_floor = self.store.pruned_below
-            self.log.info(
-                "pruned %d body segment(s) below height %d "
-                "(headers retained)",
-                n,
-                self.store.pruned_below,
-            )
+            return e
 
-    def _store_sync(self) -> None:
-        """Guarded batch-close fsync (the BLOCKS resync path)."""
+    async def _store_sync_staged(self) -> None:
+        """Guarded batch-close fsync via the store lane (the BLOCKS
+        resync path)."""
         if self.store is None or self._store_degraded:
             return
+        exc = await self.pipeline.run_store(self._store_sync_io)
+        if exc is not None:
+            self._store_fail(exc)
+
+    def _store_sync_io(self) -> OSError | None:
         try:
             self.store.sync()
         except OSError as e:
-            self._store_fail(e)
+            return e
+        return None
 
     def _store_fail(self, exc: OSError) -> None:
         self.metrics.store_errors += 1
@@ -1519,17 +1603,18 @@ class Node:
             if not (self._running and self._store_degraded):
                 return
             self.metrics.store_retries += 1
-            if not self._store_flush():
+            # Disk retries ride the store lane too — the recovery probe
+            # must not re-inline the very fsync chain the lane absorbed.
+            if not await self._store_flush_staged():
                 continue  # still failing: _store_fail counted it, back off
-            try:
-                # Prove durability, not just a buffered write.  (With an
-                # empty pending list this can pass while the disk is
-                # still full — the next real append re-degrades, which
-                # is self-correcting.)
-                self.store.sync()
-            except OSError as e:
+            # Prove durability, not just a buffered write.  (With an
+            # empty pending list this can pass while the disk is
+            # still full — the next real append re-degrades, which
+            # is self-correcting.)
+            exc = await self.pipeline.run_store(self._store_sync_io)
+            if exc is not None:
                 self.metrics.store_errors += 1
-                self._store_last_error = f"{type(e).__name__}: {e}"
+                self._store_last_error = f"{type(exc).__name__}: {exc}"
                 continue
             self._store_degraded = False
             self._store_last_error = None
@@ -1758,7 +1843,9 @@ class Node:
         snap_path = self._snapshot_path()
         if snap_path is not None:
             try:
-                chain_snapshot.write_snapshot(
+                # Store lane: sidecar IO (write + fsync) is writer work.
+                await self.pipeline.run_store(
+                    chain_snapshot.write_snapshot,
                     snap_path,
                     chain_snapshot.encode_manifest(snap.manifest),
                     chunk_payloads,
@@ -1770,7 +1857,7 @@ class Node:
         # persisted would otherwise leave a mixed log the resume cannot
         # interpret.  The history they held is re-fetched (and properly
         # revalidated) by the background lane anyway.
-        self._rewrite_store(chain)
+        await self.pipeline.run_store(self._rewrite_store, chain)
         if self.store is not None and self.config.body_cache_blocks > 0:
             chain.body_source = self.store
         self._bg_start()
@@ -1925,7 +2012,12 @@ class Node:
             "fully-validated at height %d",
             bg.height,
         )
-        self._rewrite_store(bg)
+        # The heaviest single blocking window in the old node (~seconds
+        # at 100k blocks): the genesis-first store rewrite, now absorbed
+        # by the store lane.  ``bg`` is already detached from serving
+        # (self.chain points at it, but nothing mutates it until this
+        # coroutine resumes), so the worker reads a quiescent chain.
+        await self.pipeline.run_store(self._rewrite_store, bg)
         snap_path = self._snapshot_path()
         if snap_path is not None and snap_path.exists():
             try:
@@ -1978,7 +2070,7 @@ class Node:
         self._snap_source = None
         self.chain = bg
         self.validation_state = VALIDATED
-        self._rewrite_store(bg)
+        await self.pipeline.run_store(self._rewrite_store, bg)
         if self.store is not None and self.config.body_cache_blocks > 0:
             bg.body_source = self.store
         await self.request_sync()
@@ -2065,6 +2157,12 @@ class Node:
             # Served-snapshot cache (round 12): one checkpoint's worth
             # of canonical state bytes, rebuilt per checkpoint.
             + (self._snapshot_cache[2] if self._snapshot_cache else 0)
+            # Staged pipeline (round 19): bytes referenced by in-flight
+            # lane jobs.  Queue growth on the validate/store lanes is
+            # memory the loop has admitted but not yet retired — wiring
+            # it here means back-pressure sheds at the front door
+            # instead of letting worker queues balloon.
+            + self.pipeline.queued_bytes
         )
 
     async def _governor_loop(self) -> None:
@@ -2919,17 +3017,23 @@ class Node:
             batch_fsync = self.store is not None and self.store.fsync
             if batch_fsync:
                 self.store.fsync = False
-            # Validation fast lane: prove the whole batch's transfer
+            # VALIDATE stage: prove the whole batch's transfer
             # signatures into the verify-once cache with one batched
             # call before the per-block connect loop — a deep-sync reply
             # of 500 tx-bearing blocks pays the Ed25519 backend once,
-            # not per transfer.  Purely a cache-warmer: per-block
-            # check_block still decides, with identical outcomes
-            # (chain/validate.py preverify_signatures).
-            preverify_signatures(
+            # not per transfer, and on the pipeline's validate lane the
+            # ctypes engine (which releases the GIL) runs off-loop.
+            # Purely a cache-warmer: per-block check_block still
+            # decides, with identical outcomes
+            # (chain/validate.py preverify_signatures).  The generator
+            # hands the lane the same tx objects the frame decoded —
+            # zero-copy, no re-encode.
+            await self.pipeline.run_validate(
+                preverify_signatures,
                 (tx for block in body for tx in block.txs),
                 self.chain.genesis.block_hash(),
                 self.sig_cache,
+                nbytes=sum(len(block.serialize()) for block in body),
             )
             accepted_any = False
             bg_accepted = 0
@@ -2974,7 +3078,7 @@ class Node:
             finally:
                 if batch_fsync:
                     self.store.fsync = True
-                    self._store_sync()
+                    await self._store_sync_staged()
             if bg_accepted:
                 # The replay advanced: verdict check (flip/diverge), and
                 # if still running, keep pulling history from this peer.
@@ -3048,11 +3152,16 @@ class Node:
         elif mtype is MsgType.MEMPOOL:
             more, txs = body
             peer.mempool_inflight_since = None  # page landed: not stalled
-            # Batch the page's signatures into the verify-once cache
-            # before per-tx admission (same fast lane as deep-sync
-            # block batches; outcomes unchanged).
-            preverify_signatures(
-                txs, self.chain.genesis.block_hash(), self.sig_cache
+            # VALIDATE stage: batch the page's signatures into the
+            # verify-once cache before per-tx admission (same fast lane
+            # as deep-sync block batches; outcomes unchanged), off-loop
+            # on the pipeline's validate lane.
+            await self.pipeline.run_validate(
+                preverify_signatures,
+                txs,
+                self.chain.genesis.block_hash(),
+                self.sig_cache,
+                nbytes=sum(len(tx.serialize()) for tx in txs),
             )
             for tx in txs:
                 await self._handle_tx(tx, origin=peer)
@@ -3458,6 +3567,22 @@ class Node:
         # fresh, once, on first use (their full frame never arrived).
         clk = self._tel_clock
         t0 = clk() if clk is not None else 0.0
+        if block.block_hash() not in self.chain:
+            # VALIDATE stage: batch-verify the block's transfer
+            # signatures into the verify-once cache on the pipeline's
+            # validate lane BEFORE the connect — add_block's check_block
+            # then hits the cache, so the Ed25519 cost (the old stage
+            # table's dominant term) is paid off-loop when staging is
+            # on.  Cache-warmer only: outcomes are check_block's alone,
+            # and a hostile invalid-signature block just pays its
+            # (bounded, ban-scored) verify at connect time instead.
+            await self.pipeline.run_validate(
+                preverify_signatures,
+                block.txs,
+                self.chain.genesis.block_hash(),
+                self.sig_cache,
+                nbytes=len(block.serialize()),
+            )
         res = self.chain.add_block(block)
         if clk is not None:
             self._h_validate.observe(clk() - t0)
@@ -3491,7 +3616,7 @@ class Node:
             # incl. cascaded orphans; a failing disk degrades, never
             # unwinds this handler (_store_append).
             t0 = clk() if clk is not None else 0.0
-            self._store_append(res.connected)
+            await self._store_append(res.connected)
             if clk is not None:
                 self._h_store.observe(clk() - t0)
             for b in res.connected:
@@ -3831,6 +3956,14 @@ class Node:
                 or self._store_degraded
                 or self.validation_state != VALIDATED,
             },
+            # Staged pipeline (round 19, node/pipeline.py): per-stage
+            # queue depths + worker liveness — an operator reading a
+            # growing store depth is watching disk back-pressure form
+            # before the governor sheds on it.
+            "pipeline": {
+                **self.pipeline.status(),
+                "worker_respawns": self.metrics.worker_respawns,
+            },
             # Untrusted snapshot sync (round 12, chain/snapshot.py): the
             # node's trust posture and the snapshot plane's telemetry —
             # an operator reading "assumed" knows every answer is
@@ -3879,7 +4012,11 @@ class Node:
                 "batches": keys.STATS.batches,
                 "serial": keys.STATS.serial,
                 "pool_dispatches": keys.STATS.pool_dispatches,
-                "backend": keys.backend(),
+                # backend_label, not backend(): the resolver may probe
+                # (and once-compile) the native rung — a GETSTATUS
+                # served on the loop must read the memoized name, never
+                # be the call that pays that load.
+                "backend": keys.backend_label(),
                 # Per-backend signature counts (round 15 ladder) — the
                 # key set is FIXED (every rung always present, zeros
                 # included) so the status wire contract stays

@@ -45,6 +45,7 @@ async def run_node(args, miner=None) -> int:
         sync_attempts_max=getattr(args, "sync_attempts", 8),
         revalidate_store=getattr(args, "revalidate_store", False),
         verify_workers=getattr(args, "verify_workers", 0),
+        pipeline_workers=getattr(args, "pipeline_workers", 0),
         sig_backend=getattr(args, "sig_backend", "auto"),
         store_degraded_exit=getattr(args, "store_degraded_exit", False),
         # Overload resilience (node/governor.py): the watermark flag is

@@ -113,6 +113,7 @@ def partition_heal(
     heal_timeout_vs: float = 180.0,
     wall_limit_s: float | None = 420.0,
     telemetry: bool = True,
+    pipeline_workers: int = 0,
 ) -> dict:
     """The flagship: mesh splits ``split``/1-``split``, both sides mine,
     the cut heals, one tip wins everywhere.  ok = global convergence at
@@ -121,8 +122,17 @@ def partition_heal(
     seconds of the heal.  ``telemetry=False`` disables the nodes'
     latency recording — the trace digest must not move (the round-14
     observer contract; tests/test_telemetry.py runs this scenario both
-    ways and compares)."""
-    net = SimNet(seed=seed, difficulty=difficulty, telemetry=telemetry)
+    ways and compares).  ``pipeline_workers`` stages every node's
+    validate/store pipeline (node/pipeline.py) — the same digest
+    contract holds: lane jobs are synchronous under the virtual loop,
+    so staging on/off must not move the trace (tests/test_pipeline.py
+    runs this scenario both ways at 200 nodes and compares)."""
+    net = SimNet(
+        seed=seed,
+        difficulty=difficulty,
+        telemetry=telemetry,
+        pipeline_workers=pipeline_workers,
+    )
     t0 = time.monotonic()
 
     async def main():

@@ -63,6 +63,12 @@ def pytest_configure(config):
         "(node/chaos.py) — tier-1 carries the bounded ~30-schedule "
         "sweep, the ≥200-schedule sweep is also `slow`",
     )
+    config.addinivalue_line(
+        "markers",
+        "staged: staged-pipeline coverage (node/pipeline.py, round 19) "
+        "— lane offload, ordering/digest equivalence with staging on "
+        "vs off, and worker-crash respawn; selectable with `-m staged`",
+    )
     from p1_tpu.core import keys
 
     keys.set_verify_workers(config.getoption("--verify-workers"))

@@ -349,6 +349,29 @@ class TestInterprocedural:
                                 "worker", "offload")
                 ), f"{key}: reason names no offload decision: {reason}"
 
+    def test_round19_staging_strictly_shrank_the_node_grant_inventory(self):
+        """Round-19 acceptance: the staged pipeline RETIRED grants, it
+        did not relabel them.  node/node.py's transitive-blocking table
+        held twelve chains at round 16; the two survivors are exactly
+        the start/stop boundary cases (no session to stall / pipeline
+        drained first), and every validate (ctypes) and store-append
+        (open/os.fsync) chain runs on a pipeline lane with NO grant —
+        so the count can only have strictly decreased."""
+        from p1_tpu.analysis.allowlist import GRANTS
+
+        node_grants = GRANTS["transitive-blocking"]["node/node.py"]
+        assert len(node_grants) < 12, "round-16 inventory must shrink"
+        assert set(node_grants) == {"Node.start->open", "Node.stop->open"}
+        assert not any(
+            key.endswith(("ctypes.CDLL", "os.fsync")) for key in node_grants
+        ), "validate/store chains must be offloaded, not granted"
+        # And the retirement is real, not a lint blind spot: the engine
+        # still settles with zero node.py findings against this table.
+        report = run_analysis(rules=[RULES["transitive-blocking"]])
+        assert not [
+            f for f in report.violations if f.file == "node/node.py"
+        ], [str(f) for f in report.violations]
+
 
 class TestScopedRuns:
     """run_analysis(paths=...) — the `p1 lint --path` engine contract:

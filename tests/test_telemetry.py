@@ -215,6 +215,14 @@ STATUS_KEYS = [
     "overload.watermark_bytes",
     "overload.write_queue_drops",
     "peers",
+    "pipeline",
+    "pipeline.queued_bytes",
+    "pipeline.store_alive",
+    "pipeline.store_depth",
+    "pipeline.validate_alive",
+    "pipeline.validate_depth",
+    "pipeline.worker_respawns",
+    "pipeline.workers",
     "propagation",
     "propagation.median_ms",
     "propagation.p95_ms",
