@@ -498,6 +498,49 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Always-on maintenance plane (round 20, benchmarks/
+    # maintenance_cadence.py): incremental snapshot rebuilds/sec (the
+    # continuous-publication cadence headroom, with the same-session
+    # full-rebuild speedup alongside) and the in-RAM live-rebase
+    # latency, against the pinned records (perf_record.py
+    # RECORDED_SNAPSHOT_CADENCE_BPS / RECORDED_REBASE_MS; the rebase
+    # figure is lower-is-better, so vs_recorded > 1 means slower).
+    from p1_tpu.hashx.perf_record import (
+        REBASE_DEGRADED_FACTOR,
+        RECORDED_REBASE_MS,
+        RECORDED_SNAPSHOT_CADENCE_BPS,
+        SNAPSHOT_CADENCE_DEGRADED_FRACTION,
+    )
+
+    try:
+        from benchmarks.maintenance_cadence import (
+            bench_quick as cadence_quick,
+        )
+
+        mc = cadence_quick()
+        extra["snapshot_incr_builds_per_sec"] = mc[
+            "snapshot_incr_builds_per_sec"
+        ]
+        extra["snapshot_cadence_speedup"] = mc["snapshot_cadence_speedup"]
+        extra["rebase_ms"] = mc["rebase_ms"]
+        extra["snapshot_cadence_vs_recorded"] = round(
+            mc["snapshot_incr_builds_per_sec"]
+            / RECORDED_SNAPSHOT_CADENCE_BPS,
+            2,
+        )
+        extra["rebase_vs_recorded"] = round(
+            mc["rebase_ms"] / RECORDED_REBASE_MS, 2
+        )
+        if (
+            mc["snapshot_incr_builds_per_sec"]
+            < SNAPSHOT_CADENCE_DEGRADED_FRACTION
+            * RECORDED_SNAPSHOT_CADENCE_BPS
+            or mc["rebase_ms"] > REBASE_DEGRADED_FACTOR * RECORDED_REBASE_MS
+        ):
+            extra["maintenance_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Static analysis plane (round 13, p1_tpu/analysis): unsettled
     # finding count (unallowlisted + stale grants — tier-1 holds it at
     # zero, so ANY nonzero here is drift the round record must show)

@@ -63,6 +63,7 @@ from p1_tpu.chain.snapshot import (
     LedgerSnapshot,
     state_root,
 )
+from p1_tpu.chain.statedelta import block_accounts
 from p1_tpu.chain.validate import ValidationError, check_block
 
 
@@ -182,6 +183,13 @@ class Chain:
         #: a reorg re-records the new branch's roots).  O(height /
         #: interval) * 32 B; the snapshot plane's commitment surface.
         self.state_checkpoints: dict[int, bytes] = {}
+        #: Accounts touched by ledger moves since the last
+        #: ``collect_dirty_accounts`` — recorded on BOTH apply and undo,
+        #: so the set is always a superset of the state diff between any
+        #: two collection points (reorgs included).  Feeds the
+        #: incremental snapshot builder (chain/snapshot.py); a too-big
+        #: set only costs chunk reuse, never correctness.
+        self._dirty_accounts: set[str] = set()
         #: Verify-once signature cache consulted by every ``check_block``
         #: this index runs (core/sigcache.py).  The process default by
         #: default; a Node wires its own instance in so admission-time
@@ -200,6 +208,12 @@ class Chain:
         self.resident_body_bytes = 0
         self.bodies_evicted = 0
         self.body_refetches = 0
+        #: The one indexed block whose body was never charged to
+        #: ``resident_body_bytes``: the construction-time base (genesis
+        #: here, the anchor in ``from_snapshot``) predates the gauge.
+        #: ``rebase`` consults this so dropping it does not over-credit;
+        #: every later base went through ``_insert`` and IS charged.
+        self._base_body_unaccounted: bytes | None = ghash
         #: Insertion-ordered candidates for body eviction (≈ height
         #: order).  Entries already evicted or de-indexed are skipped on
         #: the sweep, so the deque stays O(resident bodies).
@@ -301,6 +315,7 @@ class Chain:
         chain._ledger = Ledger.restore(snap.balances, snap.nonces)
         chain._tx_index = {tx.txid(): bhash for tx in block.txs}
         chain._children = {}
+        chain._base_body_unaccounted = bhash
         # The snapshot's own claim IS the base checkpoint: background
         # revalidation compares its replayed root against this height.
         chain.state_checkpoints = {snap.height: snap.state_root}
@@ -379,7 +394,15 @@ class Chain:
         candidate forever.  O(index); only called in that rare mode.
         Genesis always qualifies (its stamp is a fixed past constant)."""
         best_hash = self.genesis.block_hash()
-        best = self._index[best_hash]
+        best = self._index.get(best_hash)
+        if best is None:
+            # Genesis is not indexed on a from_snapshot or re-based
+            # chain — anchor the scan on the base block instead (the
+            # oldest block this index can even offer; nothing below it
+            # exists here, so it is the degenerate fallback by
+            # construction even when its stamp exceeds the bound).
+            best_hash = self._main_hashes[0]
+            best = self._index[best_hash]
         for bhash, entry in self._index.items():
             if (
                 entry.header.timestamp > ts_bound
@@ -574,6 +597,7 @@ class Chain:
         when the block lands on a checkpoint height — the ONE place
         application happens, so the commitment can never miss a move."""
         self._ledger.apply_block(block)
+        self._dirty_accounts.update(block_accounts(block))
         height = self._index[block.block_hash()].height
         if self._is_checkpoint(height):
             self.state_checkpoints[height] = state_root(
@@ -585,9 +609,20 @@ class Chain:
         (a reorg onto another branch re-records through
         ``_ledger_apply``)."""
         self._ledger.undo_block(block)
+        self._dirty_accounts.update(block_accounts(block))
         self.state_checkpoints.pop(
             self._index[block.block_hash()].height, None
         )
+
+    def collect_dirty_accounts(self) -> set[str]:
+        """Consume-and-clear the dirty-account set: every account the
+        ledger touched since the previous collection.  The incremental
+        snapshot builder calls this once per build; between two calls
+        the set is a guaranteed superset of the state diff, so entries
+        NOT in it are safe to reuse byte-for-byte."""
+        dirty = self._dirty_accounts
+        self._dirty_accounts = set()
+        return dirty
 
     def snapshot_state(
         self,
@@ -948,6 +983,96 @@ class Chain:
             freed += blen
         self._resident_fifo = keep
         return freed
+
+    # -- live re-basing (round 20: the always-on node) --------------------
+
+    def rebase(self, new_base: int) -> dict:
+        """Advance the chain's base to ``new_base`` IN PLACE — the
+        long-running-node move ``from_snapshot`` performs only at boot:
+        everything strictly below the new base (and every side branch
+        not descending from it) leaves the in-RAM index, and the chain
+        behaves from here on exactly like one booted from a snapshot
+        anchored at ``new_base``.  The ledger, tip, and mining are
+        untouched — this runs on a live node between awaits.
+
+        Caller's contract (Node.rebase owns it): the history being
+        dropped must already be durable and servable from disk — sealed
+        segments with their ``.hdrx`` sidecars written
+        (``SegmentedStore.ensure_sidecars``) — because after this call
+        the only copy of those headers this process can serve is the
+        disk plane.
+
+        ``new_base`` must be a checkpoint-interval multiple with a
+        recorded state root: the interval equals the retarget window on
+        retargeting chains, so ``_expected_difficulty``'s window walk
+        can never cross the new base (the same alignment
+        ``from_snapshot`` chains rely on), and the recorded root is
+        what continuous snapshot publication anchors to.
+
+        Returns ``{"old_base", "new_base", "dropped_blocks",
+        "freed_bytes"}``.
+        """
+        if not self.base_height < new_base <= self.height:
+            raise ValueError(
+                f"rebase target {new_base} outside "
+                f"({self.base_height}, {self.height}]"
+            )
+        if new_base % self.checkpoint_interval != 0:
+            raise ValueError(
+                f"rebase target {new_base} not on the "
+                f"{self.checkpoint_interval}-block checkpoint cadence"
+            )
+        if new_base not in self.state_checkpoints:
+            raise ValueError(
+                f"no recorded state root at rebase target {new_base}"
+            )
+        base_hash = self._main_hashes[new_base - self.base_height]
+        keep = {base_hash}
+        pending = [base_hash]
+        while pending:
+            for c in self._children_of(pending.pop()):
+                if c not in keep:
+                    keep.add(c)
+                    pending.append(c)
+        dropped = [h for h in self._index if h not in keep]
+        freed = 0
+        for h in dropped:
+            entry = self._index.pop(h)
+            if entry.block is not None and h != self._base_body_unaccounted:
+                freed += len(entry.block.serialize())
+            self.proof_cache.invalidate_block(h)
+            # Orphans parked on a dropped block can never connect —
+            # nothing below the base can ever re-index (its parent is
+            # gone too, recursively).  Their FIFO entries go stale and
+            # the existing sweep skips them.
+            for orphan in self._orphans.pop(h, []):
+                self._orphan_hashes.discard(orphan.block_hash())
+        self.resident_body_bytes -= freed
+        self._base_body_unaccounted = None
+        self._tx_index = {
+            t: h for t, h in self._tx_index.items() if h in keep
+        }
+        self._children = {
+            h: kids for h, kids in self._children.items() if h in keep
+        }
+        self._invalid = {
+            h: why for h, why in self._invalid.items() if h in keep
+        }
+        self.state_checkpoints = {
+            h: r for h, r in self.state_checkpoints.items() if h >= new_base
+        }
+        self.checkpoint_extra = {
+            h for h in self.checkpoint_extra if h > new_base
+        }
+        old_base = self.base_height
+        self._main_hashes = self._main_hashes[new_base - self.base_height :]
+        self.base_height = new_base
+        return {
+            "old_base": old_base,
+            "new_base": new_base,
+            "dropped_blocks": len(dropped),
+            "freed_bytes": freed,
+        }
 
     # -- internals -------------------------------------------------------
 

@@ -212,6 +212,16 @@ class SegmentedStore(ChainStore):
         self.read_failed_segments: set[int] = set()
         self.healed.setdefault("lost_segments", 0)
         self.healed.setdefault("hdrx_failures", 0)
+        self.healed.setdefault("sdx_failures", 0)
+        #: One-shot failure seam (chaos plane, ``seal_sidecar_crash``):
+        #: the next seal-time state-delta sidecar write raises OSError.
+        #: Exercises the derivable-cache tolerance — the roll must
+        #: survive, the counter must tick, the plane must rebuild.
+        self.fail_next_sidecar = False
+        #: One-shot failure seam (chaos plane, ``online_compact_crash``):
+        #: the next ``plan_compaction`` fails mid-tmp-write.  The live
+        #: segment files must be untouched afterwards.
+        self.fail_next_compact = False
 
     # -- layout helpers ---------------------------------------------------
 
@@ -226,6 +236,9 @@ class SegmentedStore(ChainStore):
 
     def hdrx_path(self, seg: SegmentInfo) -> Path:
         return self.seg_dir / f"seg{seg.seg_id:05d}.hdrx"
+
+    def sdx_path(self, seg: SegmentInfo) -> Path:
+        return self.seg_dir / f"seg{seg.seg_id:05d}.sdx"
 
     @property
     def segments(self) -> tuple[SegmentInfo, ...]:
@@ -339,6 +352,11 @@ class SegmentedStore(ChainStore):
         # Reconcile against the directory — the segments are the data.
         on_disk: set[int] = set()
         self.seg_dir.mkdir(parents=True, exist_ok=True)
+        for stale in self.seg_dir.glob("seg*.p1s.cmp.*"):
+            # A crashed online compaction leaves tmp replacements; the
+            # originals were never touched, so the tmps are pure waste
+            # (and can never adopt — the glob below requires ``.p1s``).
+            stale.unlink(missing_ok=True)
         for f in sorted(self.seg_dir.glob("seg*.p1s")):
             try:
                 on_disk.add(int(f.name[3:8]))
@@ -574,17 +592,38 @@ class SegmentedStore(ChainStore):
         self._fh.flush()
         self._fsync_file(self._fh)
         active = self._active
+        seg_bytes = None
         try:
-            from p1_tpu.chain import headerplane
-
-            headerplane.write_segment_index(
-                self._read_bytes_path(self._seg_path(active)),
-                self.hdrx_path(active),
-            )
+            seg_bytes = self._read_bytes_path(self._seg_path(active))
         except OSError:
-            # The plane is derivable from the segment: losing the
-            # sidecar costs a rebuild, never data.
+            # Neither sidecar can derive without the bytes; both planes
+            # rebuild later (prune_below / ensure_sidecars re-derive).
             self.healed["hdrx_failures"] += 1
+            self.healed["sdx_failures"] += 1
+        if seg_bytes is not None:
+            try:
+                from p1_tpu.chain import headerplane
+
+                headerplane.write_segment_index(
+                    seg_bytes, self.hdrx_path(active)
+                )
+            except OSError:
+                # The plane is derivable from the segment: losing the
+                # sidecar costs a rebuild, never data.
+                self.healed["hdrx_failures"] += 1
+            try:
+                from p1_tpu.chain import statedelta
+
+                if self.fail_next_sidecar:
+                    self.fail_next_sidecar = False
+                    raise OSError("injected sidecar failure (chaos seam)")
+                statedelta.write_segment_delta(
+                    seg_bytes, self.sdx_path(active)
+                )
+            except OSError:
+                # Same derivable-cache tolerance as the header plane:
+                # the delta recomputes from the segment on demand.
+                self.healed["sdx_failures"] += 1
         new = SegmentInfo(seg_id=active.seg_id + 1)
         path = self._seg_path(new)
         fh = self._open_fh_path(path)
@@ -825,6 +864,20 @@ class SegmentedStore(ChainStore):
                 headerplane.write_segment_index(
                     self._read_bytes_path(self._seg_path(seg)), hx
                 )
+            sx = self.sdx_path(seg)
+            if not sx.exists():
+                # The state-delta sidecar is the only record of what
+                # the discarded bodies did to the ledger — write it
+                # before the unlink, tolerating failure (the prunebase
+                # snapshot carries the state either way).
+                try:
+                    from p1_tpu.chain import statedelta
+
+                    statedelta.write_segment_delta(
+                        self._read_bytes_path(self._seg_path(seg)), sx
+                    )
+                except OSError:
+                    self.healed["sdx_failures"] += 1
             os.unlink(self._seg_path(seg))
             seg.pruned = True
             with self._fd_lock:
@@ -841,6 +894,218 @@ class SegmentedStore(ChainStore):
             if (sp >> _SEG_SHIFT) not in pruned_ids
         }
         return len(victims)
+
+    # -- always-on maintenance (round 20) ---------------------------------
+
+    def ensure_sidecars(self) -> int:
+        """Write any missing ``.hdrx``/``.sdx`` sidecars for sealed,
+        un-pruned segments — the live re-base's spill step: before the
+        chain drops its in-RAM header index below the new base, every
+        sealed segment must carry its packed-header plane so the
+        history stays servable/bootable from disk.  Returns sidecars
+        written.  A header-plane failure RAISES (the caller's re-base
+        depends on the plane existing and must abort cleanly); a
+        state-delta failure is tolerated (``sdx_failures``) like
+        everywhere else — it is an optimization cache, not the spill.
+        """
+        self.acquire()
+        written = 0
+        from p1_tpu.chain import headerplane, statedelta
+
+        for seg in self._segments:
+            if not seg.sealed or seg.pruned:
+                continue
+            data = None
+            hx = self.hdrx_path(seg)
+            if not hx.exists():
+                data = self._read_bytes_path(self._seg_path(seg))
+                headerplane.write_segment_index(data, hx)
+                written += 1
+            sx = self.sdx_path(seg)
+            if not sx.exists():
+                try:
+                    if data is None:
+                        data = self._read_bytes_path(self._seg_path(seg))
+                    statedelta.write_segment_delta(data, sx)
+                    written += 1
+                except OSError:
+                    self.healed["sdx_failures"] += 1
+        return written
+
+    def plan_compaction(self, drop: set[bytes]) -> list[dict]:
+        """Off-loop half of ONLINE compaction (the node runs this on
+        its store lane): for every sealed, un-pruned segment holding at
+        least one record whose block hash is in ``drop``, build a
+        compacted replacement under a tmp name — MAGIC + surviving
+        frames, fsync'd, then self-checked with a fresh scan (a
+        replacement that cannot prove itself byte-perfect is discarded
+        and the original left untouched: OSError).  Returns one plan
+        row per dirty segment for ``commit_compacted_segment``; the
+        LIVE segment files are never touched here, so a crash or
+        failure at any point inside this method costs only stray tmp
+        files (reaped at the next acquire).
+
+        ``drop`` must only ever name records the caller POSITIVELY
+        knows are off the main chain — unknown hashes are kept, so
+        compaction can never widen a prune's loss (chain/tooling.py's
+        rule, enforced the same way: keep is the default)."""
+        from p1_tpu.core.hashutil import sha256d
+        from p1_tpu.core.header import HEADER_SIZE
+
+        plans: list[dict] = []
+        tmp: Path | None = None
+        try:
+            for seg in self._segments:
+                if not seg.sealed or seg.pruned:
+                    continue
+                tmp = None
+                path = self._seg_path(seg)
+                data = self._read_bytes_path(path)
+                if not data.startswith(MAGIC):
+                    continue
+                scan = ChainStore.scan(data)
+                frames: list[bytes] = []
+                spans: list[tuple[bytes, int, int]] = []
+                pos = len(MAGIC)
+                for off, n in scan.spans:
+                    bhash = sha256d(data[off : off + HEADER_SIZE])
+                    if bhash in drop:
+                        continue
+                    frames.append(
+                        data[off - _LEN.size : off + n + _CRC.size]
+                    )
+                    spans.append((bhash, pos + _LEN.size, n))
+                    pos += _LEN.size + n + _CRC.size
+                if len(frames) == len(scan.spans):
+                    continue  # clean segment: nothing to drop
+                tmp = path.with_name(f"{path.name}.cmp.{os.getpid()}")
+                if self.fail_next_compact:
+                    self.fail_next_compact = False
+                    # Fail AFTER a partial tmp lands — the worst-case
+                    # interruption point the chaos plane exercises.
+                    tmp.write_bytes(MAGIC + (frames[0] if frames else b""))
+                    raise OSError("injected compaction failure (chaos seam)")
+                with open(tmp, "wb") as out:
+                    out.write(MAGIC)
+                    for frame in frames:
+                        out.write(frame)
+                    out.flush()
+                    os.fsync(out.fileno())
+                vscan = ChainStore.scan(self._read_bytes_path(tmp))
+                if not vscan.clean or len(vscan.spans) != len(frames):
+                    raise OSError(
+                        f"{tmp}: compacted segment fails self-check"
+                    )
+                plans.append(
+                    {
+                        "seg_id": seg.seg_id,
+                        "tmp": str(tmp),
+                        "records": len(frames),
+                        "bytes": len(MAGIC)
+                        + sum(len(f) for f in frames),
+                        "spans": spans,
+                        "dropped": len(scan.spans) - len(frames),
+                        # Staleness pin for the commit half: the exact
+                        # size this plan was derived from.
+                        "orig_bytes": len(data),
+                    }
+                )
+        except OSError:
+            # Live failure: drop every replacement built so far,
+            # including the one mid-write.  (A kill-9 leaves them
+            # instead — the next acquire reaps stray ``.cmp.`` tmps.)
+            if tmp is not None:
+                tmp.unlink(missing_ok=True)
+            self.discard_compaction(plans)
+            raise
+        return plans
+
+    def commit_compacted_segment(self, plan: dict) -> int:
+        """On-loop half: atomically swap ONE compacted segment into
+        place and fix every in-RAM structure that referenced the old
+        inode — the span map entries for this segment and its cached
+        read fd — in one synchronous step.  The caller (node) runs
+        this between awaits, so no reader can interleave between the
+        replace and the span fixup; until then, readers holding the
+        old cached fd kept reading the old (still-live) inode at the
+        old offsets, which is consistent by construction.  Returns
+        records dropped."""
+        seg = self._seg_by_id(plan["seg_id"])
+        if seg is None or seg.pruned:
+            Path(plan["tmp"]).unlink(missing_ok=True)
+            return 0
+        path = self._seg_path(seg)
+        try:
+            current = path.stat().st_size
+        except OSError:
+            current = -1
+        if not seg.sealed or current != plan["orig_bytes"]:
+            # The segment changed since the plan was derived (a roll
+            # raced the off-loop planner, or a failed roll re-activated
+            # it).  Replacing now would lose records — skip; the next
+            # compaction re-plans from current bytes.
+            Path(plan["tmp"]).unlink(missing_ok=True)
+            return 0
+        os.replace(plan["tmp"], path)
+        self._fsync_dir_path(self.seg_dir)
+        with self._fd_lock:
+            fd = self._read_fds.pop(seg.seg_id, None)
+            if fd is not None:
+                os.close(fd)
+        sid = seg.seg_id
+        self._body_spans = {
+            h: sp
+            for h, sp in self._body_spans.items()
+            if (sp >> _SEG_SHIFT) != sid
+        }
+        for bhash, off, n in plan["spans"]:
+            self._body_spans[bhash] = (
+                (sid << _SEG_SHIFT) | (off << _SPAN_SHIFT) | n
+            )
+        seg.records = plan["records"]
+        seg.bytes = plan["bytes"]
+        return plan["dropped"]
+
+    def flush_manifest(self) -> None:
+        """Persist the in-RAM segment rows.  Appends and prunes write
+        the manifest themselves; a compaction COMMIT changes a sealed
+        segment's records/bytes without either, so the node calls this
+        (off-loop, with the sidecar refresh) once a commit batch lands.
+        Crash before it: the manifest's stale row sizes are healed by
+        the next acquire's scan, costing an fsck repair, never data."""
+        self._write_manifest()
+
+    def discard_compaction(self, plans: list[dict]) -> None:
+        """Abort path: drop any tmp replacements already built.  The
+        live segments were never touched."""
+        for plan in plans:
+            Path(plan["tmp"]).unlink(missing_ok=True)
+
+    def refresh_sidecars(self, seg_ids: list[int]) -> None:
+        """Rewrite the ``.hdrx``/``.sdx`` sidecars for segments whose
+        bytes just changed (post-compaction, on the store lane).
+        Failures are tolerated and counted — both planes are derivable
+        caches."""
+        from p1_tpu.chain import headerplane, statedelta
+
+        for seg_id in seg_ids:
+            seg = self._seg_by_id(seg_id)
+            if seg is None or seg.pruned:
+                continue
+            try:
+                data = self._read_bytes_path(self._seg_path(seg))
+            except OSError:
+                self.healed["hdrx_failures"] += 1
+                self.healed["sdx_failures"] += 1
+                continue
+            try:
+                headerplane.write_segment_index(data, self.hdrx_path(seg))
+            except OSError:
+                self.healed["hdrx_failures"] += 1
+            try:
+                statedelta.write_segment_delta(data, self.sdx_path(seg))
+            except OSError:
+                self.healed["sdx_failures"] += 1
 
     # -- fsck surface ------------------------------------------------------
 

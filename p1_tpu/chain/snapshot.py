@@ -54,10 +54,12 @@ __all__ = [
     "CHUNK_ACCOUNTS",
     "DEFAULT_CHECKPOINT_INTERVAL",
     "MAGIC",
+    "IncrementalState",
     "LedgerSnapshot",
     "Manifest",
     "SnapshotError",
     "build_records",
+    "build_records_incremental",
     "chunk_digest",
     "encode_chunks",
     "load_snapshot",
@@ -322,6 +324,219 @@ def build_records(
         block=block,
     )
     return encode_manifest(manifest), chunks
+
+
+# -- incremental building (round 20: continuous snapshot publication) ------
+
+
+@dataclasses.dataclass
+class IncrementalState:
+    """The reusable residue of one ``build_records_incremental`` run:
+    every per-account encoded entry and leaf hash, the canonical key
+    order, and the chunk payloads + digests — everything the NEXT build
+    can reuse for accounts a dirty set does not name.  Purely an
+    optimization cache: holding a stale or wrong one can cost bytes
+    re-encoded, never a wrong snapshot, because reuse is gated on the
+    dirty set the chain derived from its own ledger applications."""
+
+    entries: dict[str, bytes]
+    leaves: dict[str, bytes]
+    keys: list[str]
+    chunks: list[bytes]
+    digests: list[bytes]
+    chunk_accounts: int
+    #: Every level of the state-root merkle tree (leaves up to root,
+    #: virtual odd-tail duplication — see ``_merkle_levels``) and the
+    #: key → leaf-index map: together they turn the root recompute into
+    #: an O(delta·log n) path update when the key set is stable, which
+    #: profiling showed was the whole residual cost of a warm build.
+    levels: list[list[bytes]] = dataclasses.field(default_factory=list)
+    index: dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+def _merkle_levels(leaves: list[bytes]) -> list[list[bytes]]:
+    """All levels of ``merkle_root``'s tree WITHOUT materializing the
+    odd-tail duplicates (the pair step treats a missing right sibling
+    as the left one, exactly like core/block.py's combine) — so a path
+    update never has to keep a trailing copy coherent."""
+    levels = [list(leaves)]
+    while len(levels[-1]) > 1:
+        lvl = levels[-1]
+        levels.append(
+            [
+                sha256d(lvl[i] + (lvl[i + 1] if i + 1 < len(lvl) else lvl[i]))
+                for i in range(0, len(lvl), 2)
+            ]
+        )
+    return levels
+
+
+def _merkle_update(levels: list[list[bytes]], changed: set[int]) -> None:
+    """Recompute only the tree paths above the ``changed`` leaf indices
+    (level 0 must already hold the new leaves)."""
+    for depth in range(len(levels) - 1):
+        lvl, up = levels[depth], levels[depth + 1]
+        parents = {i // 2 for i in changed}
+        for pi in sorted(parents):
+            i = 2 * pi
+            up[pi] = sha256d(
+                lvl[i] + (lvl[i + 1] if i + 1 < len(lvl) else lvl[i])
+            )
+        changed = parents
+
+
+def build_records_incremental(
+    prev: IncrementalState | None,
+    height: int,
+    block: Block,
+    balances: dict[str, int],
+    nonces: dict[str, int],
+    dirty: set[str],
+    chunk_accounts: int = CHUNK_ACCOUNTS,
+) -> tuple[bytes, list[bytes], IncrementalState, int]:
+    """``build_records``, continuously: re-encode and re-hash ONLY the
+    accounts in ``dirty`` (plus any the previous build never saw),
+    reuse untouched chunk payloads and digests outright, and return
+    the new reusable state alongside ``(manifest_payload, chunks)``
+    plus the count of chunks reused verbatim.
+
+    **Byte-identity contract** (pinned in tests): the manifest and
+    chunk payloads are byte-for-byte what ``build_records`` produces
+    for the same state — incremental is a cost model, never a format.
+
+    **Correctness contract on ``dirty``**: it must be a superset of
+    every account whose (balance, nonce) differs from the state
+    ``prev`` was built over — the chain guarantees this by recording
+    touched accounts on BOTH apply and undo, so reorgs and tip
+    advances alike land in the set.  A too-big set only costs reuse.
+
+    Cost: O(delta·log accounts) on the steady-state path — the key set
+    is stable (no account created or emptied), so entry encodes, leaf
+    hashes, chunk joins, and the merkle path updates are all bounded by
+    the delta, and the O(accounts) work left is pointer copies of the
+    cached dicts/levels.  A membership change (create/delete shifts the
+    canonical order) degrades that build to the O(accounts) re-sort and
+    tree rebuild, exactly like the chunk-reuse gate below.
+    """
+    # Steady-state fast path: by the dirty-superset contract, the key
+    # set can only change at accounts the dirty set names — if each of
+    # those keeps its membership (existed before and still has state,
+    # or neither), the canonical order is prev's, verbatim.
+    if (
+        prev is not None
+        and prev.levels
+        and prev.chunk_accounts == chunk_accounts
+        and all(
+            (a in prev.entries)
+            == bool(balances.get(a, 0) or nonces.get(a, 0))
+            for a in dirty
+        )
+    ):
+        keys = prev.keys
+        entries = dict(prev.entries)
+        leaves = dict(prev.leaves)
+        levels = [lvl.copy() for lvl in prev.levels]
+        changed: set[int] = set()
+        for a in dirty:
+            if a not in entries:
+                continue  # touched but stateless before and after
+            e = _encode_entry(a, balances.get(a, 0), nonces.get(a, 0))
+            if entries[a] == e:
+                continue  # dirty is a superset; this one didn't move
+            entries[a] = e
+            leaves[a] = sha256d(e)
+            pos = prev.index[a]
+            levels[0][pos] = leaves[a]
+            changed.add(pos)
+        if changed:
+            _merkle_update(levels, changed)
+        chunks = list(prev.chunks)
+        digests = list(prev.digests)
+        dirty_chunks = sorted({pos // chunk_accounts for pos in sorted(changed)})
+        for ci in dirty_chunks:
+            i = ci * chunk_accounts
+            part_keys = keys[i : i + chunk_accounts]
+            payload = _U32.pack(len(part_keys)) + b"".join(
+                entries[a] for a in part_keys
+            )
+            chunks[ci] = payload
+            digests[ci] = chunk_digest(payload)
+        reused = len(chunks) - len(dirty_chunks)
+        manifest = Manifest(
+            height=height,
+            block_hash=block.block_hash(),
+            state_root=levels[-1][0] if keys else merkle_root([]),
+            accounts=len(keys),
+            chunk_digests=tuple(digests),
+            block=block,
+        )
+        state = IncrementalState(
+            entries=entries,
+            leaves=leaves,
+            keys=keys,
+            chunks=chunks,
+            digests=digests,
+            chunk_accounts=chunk_accounts,
+            levels=levels,
+            index=prev.index,
+        )
+        return encode_manifest(manifest), chunks, state, reused
+
+    accounts = {a for a, v in balances.items() if v}
+    accounts.update(a for a, n in nonces.items() if n)
+    keys = sorted(accounts, key=lambda a: a.encode("utf-8"))
+    reuse_entries = prev is not None
+    entries: dict[str, bytes] = {}
+    leaves: dict[str, bytes] = {}
+    for a in keys:
+        if reuse_entries and a not in dirty and a in prev.entries:
+            entries[a] = prev.entries[a]
+            leaves[a] = prev.leaves[a]
+        else:
+            e = _encode_entry(a, balances.get(a, 0), nonces.get(a, 0))
+            entries[a] = e
+            leaves[a] = sha256d(e)
+    chunks: list[bytes] = []
+    digests: list[bytes] = []
+    reused = 0
+    reuse_chunks = prev is not None and prev.chunk_accounts == chunk_accounts
+    for ci, i in enumerate(range(0, len(keys), chunk_accounts)):
+        part_keys = keys[i : i + chunk_accounts]
+        if (
+            reuse_chunks
+            and ci < len(prev.chunks)
+            and prev.keys[i : i + chunk_accounts] == part_keys
+            and not any(a in dirty for a in part_keys)
+        ):
+            chunks.append(prev.chunks[ci])
+            digests.append(prev.digests[ci])
+            reused += 1
+            continue
+        payload = _U32.pack(len(part_keys)) + b"".join(
+            entries[a] for a in part_keys
+        )
+        chunks.append(payload)
+        digests.append(chunk_digest(payload))
+    levels = _merkle_levels([leaves[a] for a in keys]) if keys else []
+    manifest = Manifest(
+        height=height,
+        block_hash=block.block_hash(),
+        state_root=levels[-1][0] if keys else merkle_root([]),
+        accounts=len(keys),
+        chunk_digests=tuple(digests),
+        block=block,
+    )
+    state = IncrementalState(
+        entries=entries,
+        leaves=leaves,
+        keys=keys,
+        chunks=chunks,
+        digests=digests,
+        chunk_accounts=chunk_accounts,
+        levels=levels,
+        index={a: i for i, a in enumerate(keys)},
+    )
+    return encode_manifest(manifest), chunks, state, reused
 
 
 # -- the file format -------------------------------------------------------

@@ -311,6 +311,16 @@ def build_parser() -> argparse.ArgumentParser:
         "disconnecting (0 = archive node; implies a segmented store)",
     )
     p.add_argument(
+        "--snapshot-interval",
+        type=int,
+        default=0,
+        metavar="BLOCKS",
+        help="state checkpoint / served-snapshot cadence in blocks — "
+        "also the granularity of `p1 maintain rebase` targets (must "
+        "agree across nodes for served snapshot heights to line up; "
+        "0 = the chain default)",
+    )
+    p.add_argument(
         "--no-admission-control",
         action="store_true",
         help="disable the per-peer blocks/txs/queries admission budgets "
@@ -365,6 +375,34 @@ def build_parser() -> argparse.ArgumentParser:
         "--prom",
         action="store_true",
         help="Prometheus text exposition (scrape-ready)",
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
+        "maintain",
+        help="drive a running node's zero-downtime maintenance plane "
+        "(v13): live re-base, online prune/compact, or the maintenance/"
+        "version-bits status report — all without restarting the node",
+    )
+    p.add_argument(
+        "op",
+        choices=("status", "rebase", "prune", "compact"),
+        help="status = report the maintenance plane (counters + "
+        "version-bits deployments); rebase = advance the in-RAM base "
+        "to a checkpoint, spilling history to the sidecar planes; "
+        "prune = discard body segments below the floor; compact = "
+        "rewrite dirty segments without dead side-branch records",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--keep",
+        type=int,
+        default=None,
+        metavar="N",
+        help="blocks to keep behind the tip (rebase/prune; default: "
+        "the node's checkpoint interval)",
     )
     _add_retarget(p)
 
@@ -1129,6 +1167,53 @@ def cmd_status(args) -> int:
         # Ctrl-C is how a watch ENDS, not an error: exit clean wherever
         # in the poll/sleep cycle it lands.
         return 0
+
+
+def cmd_maintain(args) -> int:
+    """Drive a running node's maintenance plane (`p1 maintain`,
+    GETMAINTAIN/MAINTAIN v13).  Exit-code contract, test-pinned: 0 when
+    the node answered ``{"ok": true}``; 1 when it REFUSED (``{"ok":
+    false}`` — busy, assumed chain, degraded store, nothing to do at
+    this height) or the wire failed; 2 on usage errors caught locally.
+    The refusal detail lands on stderr, the full reply JSON on stdout
+    either way — scripts branch on the exit code, operators read the
+    reply."""
+    from p1_tpu.node.client import maintain
+
+    if args.keep is not None and args.keep < 0:
+        print("--keep must be >= 0", file=sys.stderr)
+        return 2
+    if args.keep is not None and args.op in ("status", "compact"):
+        print(f"--keep does not apply to {args.op!r}", file=sys.stderr)
+        return 2
+    command: dict = {"op": args.op}
+    if args.keep is not None:
+        command["keep"] = args.keep
+    try:
+        reply = asyncio.run(
+            maintain(
+                args.host,
+                args.port,
+                command,
+                args.difficulty,
+                retarget=_retarget_rule(args),
+            )
+        )
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.TimeoutError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"maintain command failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(reply, indent=2, sort_keys=True), flush=True)
+    if not (isinstance(reply, dict) and reply.get("ok") is True):
+        error = reply.get("error") if isinstance(reply, dict) else reply
+        print(f"maintain refused: {error}", file=sys.stderr)
+        return 1
+    return 0
 
 
 def cmd_metrics(args) -> int:
@@ -2048,6 +2133,7 @@ def main(argv=None) -> int:
         "node": cmd_node,
         "status": cmd_status,
         "metrics": cmd_metrics,
+        "maintain": cmd_maintain,
         "tx": cmd_tx,
         "keygen": cmd_keygen,
         "account": cmd_account,

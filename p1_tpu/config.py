@@ -193,6 +193,24 @@ class NodeConfig:
     #: 100k blocks, docs/PERF.md); set True when on-disk integrity is in
     #: question.
     revalidate_store: bool = False
+    #: Version-bits protocol evolution (chain/versionbits.py, the BIP9
+    #: analog, round 20): named feature deployments as
+    #: ``(name, bit, start_height, timeout_height)`` tuples.  Miners
+    #: aware of a deployment signal its bit in mined header versions
+    #: while it is STARTED/LOCKED_IN; activation is a pure function of
+    #: the header chain, so every configured node reports the same
+    #: state at the same height.  Empty (the default) mines the legacy
+    #: ``version=1`` headers — byte-identical to every earlier round.
+    #: Header version is NOT a consensus field, so mixed
+    #: configured/legacy meshes never fork on signaling alone (the
+    #: ``version_activation`` scenario pins this).
+    deployments: tuple = ()
+    #: Signaling window length in blocks and the signal count within one
+    #: completed window that locks a deployment in.  Like
+    #: ``snapshot_interval``: must agree across nodes for their state
+    #: reports to line up — policy coordination, never consensus.
+    vb_window: int = 8
+    vb_threshold: int = 6
 
     def retarget_rule(self):
         """The chain's ``RetargetRule``, or None for fixed difficulty."""

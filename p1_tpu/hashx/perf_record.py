@@ -231,3 +231,27 @@ RECORDED_ARCHIVE_BOOT_RSS_MB = 170.0
 #: RSS is an allocator property and should barely move — flag at 2x.
 ARCHIVE_RESUME_DEGRADED_FRACTION = 0.4
 ARCHIVE_BOOT_RSS_DEGRADED_FACTOR = 2.0
+
+#: Always-on maintenance plane (round 20, chain/snapshot.py
+#: ``build_records_incremental`` + chain/chain.py ``rebase``): the
+#: bench.py quick probe (benchmarks/maintenance_cadence.py
+#: ``bench_quick`` — 20k accounts, 64 dirty per build, 96-block chain;
+#: the 100k/1M acceptance ladder lives in docs/PERF.md "Maintenance
+#: cadence").  ``RECORDED_SNAPSHOT_CADENCE_BPS`` is incremental
+#: snapshot rebuilds/sec on the warm O(delta·log n) path — the
+#: continuous-publication cadence a serving node can sustain (the
+#: full O(accounts) rebuild it replaces measured 9.4/s on the same
+#: shape, a ~56x spread the speedup field reports live).
+#: ``RECORDED_REBASE_MS`` is the in-RAM half of `p1 maintain rebase`
+#: — the event-loop stall the command costs a serving node (the
+#: durable store half runs off-loop; archive bench territory).
+#: Measured 2026-08-06 on the 1-vCPU bench host at idle.
+RECORDED_SNAPSHOT_CADENCE_BPS = 523.0
+RECORDED_REBASE_MS = 0.08
+
+#: Degraded thresholds: the cadence is hash-bound (co-tenant
+#: sensitive, same band as the other CPU rates); the rebase figure is
+#: sub-100µs, so absolute jitter is a huge relative band — only a
+#: 10x+ move says the dict-surgery cost model changed.
+SNAPSHOT_CADENCE_DEGRADED_FRACTION = 0.4
+REBASE_DEGRADED_FACTOR = 10.0

@@ -147,11 +147,24 @@ def generate_schedule(
     - ``stage_crash`` — a crash at one pipeline stage boundary
       (node/pipeline.py): validate/store arm a one-shot lane-worker
       death on a live node (respawn-and-retry must hold), the on-loop
-      stages (frame/admission/relay) crash the process, stage-tagged.
+      stages (frame/admission/relay) crash the process, stage-tagged;
+    - ``rebase`` — a LIVE node advances its base via the maintenance
+      plane (round 20) while mining and serving; the ``crash: true``
+      variant runs the durable store half (seal + sidecar spill) and
+      kills the process BEFORE the in-RAM rebase — the mid-rebase
+      kill-9, which must reboot as an ordinary un-rebased node;
+    - ``seal_sidecar_crash`` — the ``.sdx`` state-delta write fails at
+      a forced seal (the tolerated sidecar failure family: the roll
+      must land, the failure must count, the plane must self-heal);
+    - ``online_prune`` / ``online_compact_crash`` — the round-20
+      node-side maintenance commands: prune while serving, and a
+      compaction whose off-loop planning dies mid-write (the node must
+      self-clean the tmp artifacts and keep serving).
     """
     rng = random.Random((seed << 3) ^ 0xC4A05)
     joiners: set[int] = set()
     pruned_any = False
+    rebased_any = False
     times = sorted(
         round(rng.uniform(0.5, horizon_vs), 3) for _ in range(n_events)
     )
@@ -201,6 +214,19 @@ def generate_schedule(
             ops.append(("prune", 0.5))
         if crashed:
             ops.append(("compact_crash", 0.5))
+        # Always-on maintenance plane (round 20): the node-side
+        # zero-downtime operations, driven through the same _maintain
+        # entry `p1 maintain` uses.  Re-basing and pruning both shrink
+        # a host's deep-history serving capacity, so each is capped
+        # like ``prune`` — someone must keep the archive.  All degrade
+        # to no-ops on single-file stores or refused preconditions
+        # (subset-runnability for the shrinker).
+        if not rebased_any and len(crashed) < max(1, n_nodes - 2):
+            ops.append(("rebase", 0.75))
+        ops.append(("seal_sidecar_crash", 0.5))
+        if not pruned_any:
+            ops.append(("online_prune", 0.5))
+        ops.append(("online_compact_crash", 0.5))
         # Staged-pipeline plane (round 19): a crash at every stage
         # boundary.  The two lane stages (validate/store) die as WORKER
         # deaths — the pipeline must respawn the lane and retry without
@@ -285,6 +311,28 @@ def generate_schedule(
         elif op == "compact_crash":
             ev["node"] = rng.choice(sorted(crashed))
             ev["junk"] = rng.randrange(1, 1 << 16)
+        elif op == "rebase":
+            victims = [i for i in range(n_nodes) if i not in crashed]
+            ev["node"] = rng.choice(victims)
+            # Small keeps: a 30-vs schedule mines ~a dozen blocks, and
+            # a keep past the chain height degrades the event to a
+            # refusal no-op every time (we want SOME organic fires).
+            ev["keep"] = rng.choice((2, 4))
+            ev["crash"] = rng.random() < 0.34
+            rebased_any = True
+            if ev["crash"]:
+                # The mid-rebase kill: the process dies after the store
+                # half — downstream scheduling must treat it as crashed.
+                crashed.add(ev["node"])
+                disks_down.discard(ev["node"])
+        elif op == "seal_sidecar_crash":
+            ev["node"] = rng.randrange(n_nodes)
+        elif op == "online_prune":
+            ev["node"] = rng.randrange(n_nodes)
+            ev["keep"] = rng.choice((2, 4))
+            pruned_any = True
+        elif op == "online_compact_crash":
+            ev["node"] = rng.randrange(n_nodes)
         elif op == "stage_crash":
             from p1_tpu.node.pipeline import LANE_STAGES, STAGES
 
@@ -336,6 +384,7 @@ def generate_soak_schedule(
     never meant to test."""
     rng = random.Random((seed << 4) ^ 0x50AC7)
     events: list[dict] = []
+    maintained = 0
     for b in range(blocks):
         at = (b + 1) * horizon_vs / (blocks + 1)
         events.append(
@@ -359,6 +408,7 @@ def generate_soak_schedule(
                 "hostile",
                 "flood",
                 "snap_join",
+                "maintenance",
             )
         )
         if kind == "crash":
@@ -451,6 +501,42 @@ def generate_soak_schedule(
                     ),
                 }
             )
+        elif kind == "maintenance":
+            # A round-20 maintenance cycle inside one fault envelope:
+            # sidecar-failure at a seal, then a live re-base, then
+            # either an online prune (FIRST cluster only — someone
+            # must keep the archive over a week of clusters) or a
+            # compaction with its planning failure injected.  Recurring
+            # across a virtual week, this is exactly the "always-on
+            # node" longevity question: does repeated self-maintenance
+            # leak or drift anything the quiesce gauges can see?
+            victim = rng.randrange(n_nodes)
+            events.append(
+                {"at": at, "op": "seal_sidecar_crash", "node": victim}
+            )
+            events.append(
+                {
+                    "at": round((at + end) / 2, 3),
+                    "op": "rebase",
+                    "node": victim,
+                    "keep": 8,
+                    "crash": False,
+                }
+            )
+            if maintained == 0:
+                events.append(
+                    {
+                        "at": end,
+                        "op": "online_prune",
+                        "node": victim,
+                        "keep": 4,
+                    }
+                )
+            else:
+                events.append(
+                    {"at": end, "op": "online_compact_crash", "node": victim}
+                )
+            maintained += 1
         for _ in range(txs_per_cluster):
             events.append(
                 {
@@ -872,6 +958,90 @@ class _ChaosRunner:
             tmp = victim.with_name(f"{victim.name}.seg.{ev['junk']}")
             tmp.write_bytes(b"P1TPUCH3" + bytes([ev["junk"] & 0xFF]) * 64)
             self._record("compact_crash", host)
+        elif op == "rebase":
+            host = self._alive(ev["node"])
+            if host is None:
+                return
+            node = net.nodes[host]
+            store = net.stores.get(host)
+            if store is None or not hasattr(store, "ensure_sidecars"):
+                return  # no segmented spill plane: nothing to rebase onto
+            if ev.get("crash"):
+                # Kill-9 mid-rebase: the durable store half (seal +
+                # sidecar spill) lands, the process dies BEFORE the
+                # in-RAM rebase.  Reboot must come back as an ordinary
+                # un-rebased node (fsck <= 1, records an exact prefix)
+                # with the spare sidecars simply awaiting reuse.
+                try:
+                    store.roll_segment()
+                    store.ensure_sidecars()
+                except OSError:
+                    return  # an armed disk-fault plan owns this failure
+                self._record("rebase_crash", host)
+                await net.crash_node(host, torn=0)
+                self.counts["crashes"] += 1
+                return
+            reply = await node._maintain(
+                {"op": "rebase", "keep": ev["keep"]}
+            )
+            # Refusals (short chain, assumed posture, degraded store)
+            # are fine — the event degrades to a no-op, which is what
+            # keeps arbitrary schedule subsets runnable for the
+            # shrinker.
+            if reply.get("ok"):
+                self._record("rebase", host, reply["new_base"])
+        elif op == "seal_sidecar_crash":
+            host = self._alive(ev["node"])
+            store = net.stores.get(host) if host is not None else None
+            if (
+                host is None
+                or store is None
+                or not hasattr(store, "fail_next_sidecar")
+            ):
+                return
+            before = store.healed["sdx_failures"]
+            store.fail_next_sidecar = True
+            try:
+                store.roll_segment()
+            except OSError:
+                return  # an armed disk-fault plan owns this failure
+            finally:
+                # An empty active segment skips the roll and leaves the
+                # seam armed — disarm so a later organic seal does not
+                # inherit this event's fault.
+                store.fail_next_sidecar = False
+            if store.healed["sdx_failures"] > before:
+                self._record("seal_sidecar_crash", host)
+        elif op == "online_prune":
+            host = self._alive(ev["node"])
+            if host is None:
+                return
+            reply = await net.nodes[host]._maintain(
+                {"op": "prune", "keep": ev["keep"]}
+            )
+            if reply.get("ok") and reply.get("segments_pruned"):
+                self._record(
+                    "online_prune", host, reply["segments_pruned"]
+                )
+        elif op == "online_compact_crash":
+            host = self._alive(ev["node"])
+            store = net.stores.get(host) if host is not None else None
+            if (
+                host is None
+                or store is None
+                or not hasattr(store, "fail_next_compact")
+            ):
+                return
+            # The off-loop planner dies mid-write (a partial tmp on
+            # disk): the node must self-clean the artifact, degrade
+            # cleanly, and recover — while every session it was serving
+            # stays connected.
+            store.fail_next_compact = True
+            reply = await net.nodes[host]._maintain({"op": "compact"})
+            store.fail_next_compact = False
+            self._record(
+                "online_compact_crash", host, int(bool(reply.get("ok")))
+            )
         elif op == "stage_crash":
             from p1_tpu.node.pipeline import LANE_STAGES
 

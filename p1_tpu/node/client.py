@@ -340,6 +340,41 @@ async def get_status(
     return await asyncio.wait_for(_run(), timeout)
 
 
+async def maintain(
+    host: str,
+    port: int,
+    command: dict,
+    difficulty: int,
+    timeout: float = 30.0,
+    retarget=None,
+) -> dict:
+    """Drive a running node's maintenance plane (`p1 maintain`, v13):
+    ``{"op": "status"|"rebase"|"prune"|"compact", ...}`` over
+    GETMAINTAIN, returning the MAINTAIN reply — ``{"ok": bool, ...}``.
+    A refused command comes back as ``{"ok": false, "error": ...}``:
+    the zero-downtime contract means refusals are answers, never
+    dropped sessions.  Kept reachable under SHED like GETSTATUS — an
+    overloaded node must still accept the operation that relieves it.
+    The default timeout is longer than the query probes': a re-base or
+    compaction spills real bytes before answering."""
+
+    async def _run() -> dict:
+        async with _session(host, port, difficulty, retarget) as (
+            reader,
+            writer,
+            _,
+        ):
+            await protocol.write_frame(
+                writer, protocol.encode_getmaintain(command)
+            )
+            while True:
+                mtype, body = await _read_msg(reader, writer)
+                if mtype is MsgType.MAINTAIN:
+                    return body
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
 async def get_metrics(
     host: str,
     port: int,

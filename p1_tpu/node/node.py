@@ -33,6 +33,7 @@ from p1_tpu.chain.store import fsync_dir
 from p1_tpu.chain import snapshot as chain_snapshot
 from p1_tpu.chain.snapshot import SnapshotError
 from p1_tpu.chain.validate import ValidationError, preverify_signatures
+from p1_tpu.chain.versionbits import Deployment, VersionBits
 from p1_tpu.config import NodeConfig
 from p1_tpu.core import keys
 from p1_tpu.core.block import Block, merkle_root
@@ -175,6 +176,7 @@ _MSG_CLASS = {
     MsgType.GETFILTERS: CLASS_QUERIES,
     MsgType.GETSNAPSHOT: CLASS_QUERIES,
     MsgType.GETMETRICS: CLASS_QUERIES,
+    MsgType.GETMAINTAIN: CLASS_QUERIES,
 }
 
 #: The OTHER half of the admission contract, spelled out: frames the
@@ -205,6 +207,7 @@ _ADMISSION_EXEMPT = frozenset(
         MsgType.METRICS,
         MsgType.FILTERS,
         MsgType.SNAPSHOT,
+        MsgType.MAINTAIN,
     }
 )
 assert (
@@ -268,6 +271,13 @@ _SHED_KEEPS = frozenset(
         MsgType.GETSTATUS,
         MsgType.STATUS,
         MsgType.METRICS,
+        # The maintenance plane stays reachable under overload for the
+        # same reason GETSTATUS does: online prune/compact/rebase are
+        # exactly the operations an operator reaches for WHILE the node
+        # is resource-pressured — shedding them would make the fix for
+        # overload unavailable during overload.
+        MsgType.GETMAINTAIN,
+        MsgType.MAINTAIN,
     }
 )
 assert (
@@ -324,6 +334,16 @@ _METRIC_COUNTERS = (
     "snapshot_stalls",
     "revalidated_blocks",
     "worker_respawns",
+    # The always-on maintenance plane (round 20): zero-downtime
+    # operations a long-running node performs on itself while mining
+    # and serving, plus the continuous-snapshot economics they enable.
+    "rebases",
+    "online_prunes",
+    "online_compactions",
+    "segments_compacted",
+    "compaction_records_dropped",
+    "snapshot_incremental_builds",
+    "snapshot_chunks_reused",
 )
 #: Float-valued point-in-time fields (mining timing).
 _METRIC_GAUGES = ("mine_elapsed_s", "last_block_time_s")
@@ -618,6 +638,27 @@ class Node:
         #: rebuilt lazily when the checkpoint moves, charged to the
         #: memory gauge.
         self._snapshot_cache = None
+        #: Incremental snapshot residue (round 20, chain/snapshot.py
+        #: ``build_records_incremental``): the per-account builder state
+        #: from the LAST checkpoint build, plus the dirty accounts whose
+        #: changes postdate that build's checkpoint — together they make
+        #: the next build O(accounts touched), never O(accounts).
+        self._snapshot_inc = None
+        self._snapshot_dirty: set[str] = set()
+        #: Version-bits activation engine (round 20,
+        #: chain/versionbits.py): in-place protocol evolution by miner
+        #: signal.  Empty deployment table (the default) mines the
+        #: legacy ``version=1`` byte-identically to every prior round.
+        self.versionbits = VersionBits(
+            tuple(Deployment(*d) for d in config.deployments),
+            window=config.vb_window,
+            threshold=config.vb_threshold,
+        )
+        #: Name of the maintenance operation currently running, or None.
+        #: One at a time: rebase/prune/compact each assume the store
+        #: segment set is not shifting under them, and serializing here
+        #: is cheaper than making them mutually crash-consistent.
+        self._maintenance_busy: str | None = None
         #: Verify-once signature cache (core/sigcache.py): ONE instance
         #: shared by this node's mempool admission and its chain's block
         #: validation, so a transfer verified at relay/admission connects
@@ -1482,14 +1523,22 @@ class Node:
         floor = min(self.chain.height - keep, checkpoint)
         if floor <= self.chain.prune_floor:
             return
+        await self._prune_now(floor)
+
+    async def _prune_now(self, floor: int) -> int:
+        """The prune executor shared by the automatic policy above and
+        the explicit ``online_prune`` maintenance command: durable
+        prune-base sidecar first, then the segment unlinks, on the
+        store lane.  Returns segments removed (0 when nothing qualifies
+        or the store failed — a failure flips degraded mode)."""
         if not self.store.prunable_segments(floor):
-            return
+            return 0
         # The prune-base sidecar FIRST, durably: our own validated
         # state at the latest checkpoint is what the next boot
         # anchors on once the history below it stops existing.
         state = self.chain.snapshot_state()
         if state is None:
-            return
+            return 0
         s_height, s_block, balances, nonces, _root = state
         manifest, chunks = chain_snapshot.build_records(
             s_height, s_block, balances, nonces
@@ -1499,7 +1548,7 @@ class Node:
         )
         if isinstance(result, OSError):
             self._store_fail(result)
-            return
+            return 0
         if result:
             self.metrics.store_segments_pruned += result
             self.chain.prune_floor = self.store.pruned_below
@@ -1509,6 +1558,7 @@ class Node:
                 result,
                 self.store.pruned_below,
             )
+        return result
 
     def _prune_io(self, manifest, chunks, floor) -> int | OSError:
         """Store-lane half of pruning: durable prune-base sidecar, then
@@ -1522,6 +1572,242 @@ class Node:
             return self.store.prune_below(floor)
         except OSError as e:
             return e
+
+    # -- the always-on maintenance plane (round 20) -----------------------
+
+    async def _maintain(self, command) -> dict:
+        """Execute one maintenance command (the GETMAINTAIN wire frame,
+        driven by `p1 maintain`).  Refusals are ANSWERS — ``{"ok":
+        false, "error": ...}`` — never dropped sessions or protocol
+        violations: the whole point of the plane is that operating on a
+        live node must not cost it connectivity.  One operation at a
+        time (``_maintenance_busy``): rebase/prune/compact each assume
+        the segment set is not shifting under them."""
+        if not isinstance(command, dict):
+            return {"ok": False, "error": "maintenance command must be an object"}
+        op = command.get("op")
+        if op == "status":
+            return {"ok": True, **self.maintenance_report()}
+        if op not in ("rebase", "prune", "compact"):
+            return {"ok": False, "error": f"unknown maintenance op {op!r}"}
+        if self._maintenance_busy is not None:
+            return {
+                "ok": False,
+                "error": f"maintenance busy: {self._maintenance_busy}",
+            }
+        if self.validation_state != VALIDATED:
+            return {
+                "ok": False,
+                "error": "chain is assumed: maintenance waits for revalidation",
+            }
+        if self._store_degraded:
+            return {
+                "ok": False,
+                "error": "store degraded: maintenance needs a healthy disk",
+            }
+        keep = command.get("keep", self.chain.checkpoint_interval)
+        if not isinstance(keep, int) or isinstance(keep, bool) or keep < 0:
+            return {"ok": False, "error": "keep must be a non-negative integer"}
+        self._maintenance_busy = op
+        try:
+            if op == "rebase":
+                return await self.rebase(keep)
+            if op == "prune":
+                return await self.online_prune(keep)
+            return await self.online_compact()
+        finally:
+            self._maintenance_busy = None
+
+    async def rebase(self, keep: int) -> dict:
+        """Live re-basing, leg (a) of the zero-downtime plane: advance
+        the chain's base to the newest checkpoint at least ``keep``
+        blocks below the tip WITHOUT restarting.  Ordering is the crash
+        contract: the store half runs first and durably (seal the
+        active segment, spill ``.hdrx``/``.sdx`` sidecars for every
+        sealed segment, off-loop on the store lane), so by the time the
+        in-RAM index forgets the deep history it is already servable
+        and bootable from the sidecar planes — a kill between the two
+        halves reboots as an un-rebased node with spare sidecars."""
+        chain = self.chain
+        interval = chain.checkpoint_interval
+        target = ((chain.height - keep) // interval) * interval
+        if target <= chain.base_height:
+            return {
+                "ok": False,
+                "error": (
+                    f"nothing to rebase: target {target} at or below "
+                    f"base {chain.base_height}"
+                ),
+            }
+        if target not in chain.state_checkpoints:
+            return {
+                "ok": False,
+                "error": f"no state checkpoint at height {target}",
+            }
+        t0 = self.clock.monotonic()
+        if self.store is not None and hasattr(self.store, "ensure_sidecars"):
+
+            def _spill():
+                try:
+                    self.store.roll_segment()
+                    return self.store.ensure_sidecars()
+                except OSError as e:
+                    return e
+
+            spilled = await self.pipeline.run_store(_spill, offload=True)
+            if isinstance(spilled, OSError):
+                self._store_fail(spilled)
+                return {"ok": False, "error": f"sidecar spill failed: {spilled}"}
+        stats = chain.rebase(target)
+        self.metrics.rebases += 1
+        self.log.info(
+            "rebased live: base %d -> %d, dropped %d block(s), "
+            "freed ~%d bytes",
+            stats["old_base"],
+            stats["new_base"],
+            stats["dropped_blocks"],
+            stats["freed_bytes"],
+        )
+        return {
+            "ok": True,
+            "duration_s": round(self.clock.monotonic() - t0, 6),
+            **stats,
+        }
+
+    async def online_prune(self, keep: int) -> dict:
+        """Online pruning, half of leg (c): discard body segments
+        wholly below min(tip - keep, latest checkpoint) on the LIVE
+        node — the explicit-command twin of the automatic
+        ``_maybe_prune`` policy, sharing its executor (and therefore
+        its prune-base durability ordering) exactly."""
+        if self.store is None or getattr(self.store, "prune_below", None) is None:
+            return {"ok": False, "error": "online prune needs a segmented store"}
+        chain = self.chain
+        checkpoint = (
+            chain.height // chain.checkpoint_interval
+        ) * chain.checkpoint_interval
+        floor = min(chain.height - keep, checkpoint)
+        t0 = self.clock.monotonic()
+        if floor <= chain.prune_floor:
+            pruned = 0
+        else:
+            pruned = await self._prune_now(floor)
+            if self._store_degraded:
+                return {
+                    "ok": False,
+                    "error": self._store_last_error or "store failed during prune",
+                }
+        self.metrics.online_prunes += 1
+        return {
+            "ok": True,
+            "segments_pruned": pruned,
+            "floor": chain.prune_floor,
+            "duration_s": round(self.clock.monotonic() - t0, 6),
+        }
+
+    async def online_compact(self) -> dict:
+        """Online compaction, the other half of leg (c): rewrite dirty
+        sealed segments without their dead (off-main-chain) records
+        while the node keeps mining and serving.  Split exactly like
+        pruning: the expensive half (read sealed bytes, build verified
+        replacements under tmp names) runs off-loop on the store lane
+        and never touches a live file; each swap then commits ON-loop
+        between awaits — rename + span-table fixup as one synchronous
+        step, so no reader can observe a half-swapped segment.  The
+        drop set is only ever hashes this chain POSITIVELY indexes off
+        its main chain — unknown records are kept (chain/tooling.py's
+        rule), so online compaction can never widen data loss."""
+        store = self.store
+        if store is None or getattr(store, "plan_compaction", None) is None:
+            return {"ok": False, "error": "online compact needs a segmented store"}
+        chain = self.chain
+        drop = {
+            bhash
+            for bhash, entry in chain._index.items()
+            if chain.main_hash_at(entry.height) != bhash
+        }
+        t0 = self.clock.monotonic()
+
+        def _plan():
+            try:
+                return store.plan_compaction(drop)
+            except OSError as e:
+                return e
+
+        plans = await self.pipeline.run_store(_plan, offload=True)
+        if isinstance(plans, OSError):
+            self._store_fail(plans)
+            return {"ok": False, "error": f"compaction planning failed: {plans}"}
+        committed: list[int] = []
+        dropped = 0
+        for i, plan in enumerate(plans):
+            try:
+                n = store.commit_compacted_segment(plan)
+            except OSError as e:
+                # A failed swap degrades the store like any other write
+                # fault; unswapped replacements are stale the moment it
+                # recovers, so discard them all.
+                store.discard_compaction(plans[i:])
+                self._store_fail(e)
+                return {"ok": False, "error": f"compaction commit failed: {e}"}
+            if n:
+                committed.append(plan["seg_id"])
+                dropped += n
+        if committed:
+
+            def _refresh():
+                try:
+                    store.refresh_sidecars(committed)
+                    store.flush_manifest()
+                except OSError as e:
+                    return e
+
+            refreshed = await self.pipeline.run_store(_refresh, offload=True)
+            if isinstance(refreshed, OSError):
+                self._store_fail(refreshed)
+                return {
+                    "ok": False,
+                    "error": f"post-compaction refresh failed: {refreshed}",
+                }
+            self.metrics.segments_compacted += len(committed)
+            self.metrics.compaction_records_dropped += dropped
+            self.log.info(
+                "compacted %d segment(s) online, dropped %d dead record(s)",
+                len(committed),
+                dropped,
+            )
+        self.metrics.online_compactions += 1
+        return {
+            "ok": True,
+            "segments_compacted": len(committed),
+            "records_dropped": dropped,
+            "duration_s": round(self.clock.monotonic() - t0, 6),
+        }
+
+    def maintenance_report(self) -> dict:
+        """The maintenance plane's JSON surface — ``status()`` embeds
+        it, and ``{"op": "status"}`` over GETMAINTAIN serves it alone.
+        Fixed key set (tests/test_telemetry.py pins status keys)."""
+        return {
+            "busy": self._maintenance_busy,
+            "rebases": self.metrics.rebases,
+            "online_prunes": self.metrics.online_prunes,
+            "online_compactions": self.metrics.online_compactions,
+            "segments_compacted": self.metrics.segments_compacted,
+            "compaction_records_dropped": (
+                self.metrics.compaction_records_dropped
+            ),
+            "snapshot_incremental_builds": (
+                self.metrics.snapshot_incremental_builds
+            ),
+            "snapshot_chunks_reused": self.metrics.snapshot_chunks_reused,
+            "base_height": self.chain.base_height,
+            "versionbits": {
+                "window": self.versionbits.window,
+                "threshold": self.versionbits.threshold,
+                "deployments": self.versionbits.states_report(self.chain),
+            },
+        }
 
     async def _store_sync_staged(self) -> None:
         """Guarded batch-close fsync via the store lane (the BLOCKS
@@ -1650,13 +1936,81 @@ class Node:
         state = chain.snapshot_state()
         if state is None:
             return None
-        h, block, balances, nonces, _root = state
-        manifest_payload, chunks = chain_snapshot.build_records(
-            h, block, balances, nonces
+        h, block, balances, nonces, root = state
+        # Incremental build (round 20): re-encode only the accounts the
+        # ledger touched since the LAST build — the pending residue
+        # (accounts whose changes postdated the previous checkpoint)
+        # plus everything applied/undone since.  A superset of the true
+        # diff is always safe; missing an account would serve a stale
+        # chunk, so the manifest root is cross-checked against the
+        # chain's own checkpoint commitment below.
+        self._snapshot_dirty |= chain.collect_dirty_accounts()
+        manifest_payload, chunks, inc, reused = (
+            chain_snapshot.build_records_incremental(
+                self._snapshot_inc,
+                h,
+                block,
+                balances,
+                nonces,
+                self._snapshot_dirty,
+            )
         )
-        size = len(manifest_payload) + sum(len(c) for c in chunks)
+        built_root = chain_snapshot.parse_manifest(manifest_payload).state_root
+        if built_root != root:
+            # The incremental path disagreeing with the validated
+            # checkpoint root means the dirty set missed an account —
+            # a bug, but one that must cost a full rebuild, never a
+            # lying snapshot on the wire.
+            self.log.error(
+                "incremental snapshot root mismatch at height %d; "
+                "falling back to full rebuild",
+                h,
+            )
+            manifest_payload, chunks, inc, reused = (
+                chain_snapshot.build_records_incremental(
+                    None, h, block, balances, nonces, set()
+                )
+            )
+        self.metrics.snapshot_incremental_builds += 1
+        self.metrics.snapshot_chunks_reused += reused
+        self._snapshot_inc = inc
+        # Accounts touched by blocks BEYOND this checkpoint were just
+        # consumed from the dirty set but are not reflected in the
+        # published state — they must stay dirty for the next build.
+        try:
+            self._snapshot_dirty = self._dirty_beyond(h)
+        except OSError:
+            # A body refetch failing (degraded store) only costs the
+            # residue: the next build runs cold but correct.
+            self._snapshot_inc = None
+            self._snapshot_dirty = set()
+        size = (
+            len(manifest_payload)
+            + sum(len(c) for c in chunks)
+            # The builder residue is retained state too: charge its
+            # dominant parts (entry payloads + leaf hashes) to the same
+            # gauge the served-snapshot cache rides.
+            + sum(len(e) for e in inc.entries.values())
+            + 32 * len(inc.leaves)
+        )
         self._snapshot_cache = (key, (manifest_payload, chunks), size)
         return manifest_payload, chunks
+
+    def _dirty_beyond(self, height: int) -> set[str]:
+        """Accounts touched by main-chain blocks ABOVE ``height`` — the
+        part of the ledger's dirty set a snapshot anchored AT ``height``
+        does not capture.  O(blocks past the checkpoint), normally under
+        one checkpoint interval; bodies may refetch from the store."""
+        from p1_tpu.chain.statedelta import block_accounts
+
+        chain = self.chain
+        out: set[str] = set()
+        for hh in range(height + 1, chain.height + 1):
+            bh = chain.main_hash_at(hh)
+            if bh is None:
+                continue
+            out |= block_accounts(chain._block_at(bh))
+        return out
 
     async def _request_snapshot(self, peer: _Peer) -> None:
         """Start a snapshot download from ``peer`` (manifest first).
@@ -3330,7 +3684,16 @@ class Node:
             await self._send_guarded(
                 peer, protocol.encode_metrics(self.telemetry_snapshot())
             )
-        elif mtype in (MsgType.STATUS, MsgType.METRICS):
+        elif mtype is MsgType.GETMAINTAIN:
+            # Maintenance command (`p1 maintain`): live re-basing,
+            # online prune/compact, version-bits status — executed
+            # inline on the dispatch loop (the ops themselves push
+            # their heavy halves onto the store lane), refusals
+            # answered as {"ok": false}, never dropped sessions.
+            await self._send_guarded(
+                peer, protocol.encode_maintain(await self._maintain(body))
+            )
+        elif mtype in (MsgType.STATUS, MsgType.METRICS, MsgType.MAINTAIN):
             pass  # reply frames: meaningful to querying clients only
         elif mtype is MsgType.PING:
             await self._send_guarded(peer, protocol.encode_pong(body))
@@ -3750,7 +4113,12 @@ class Node:
                 height - 1, parent.header.timestamp, ts
             )
         header = BlockHeader(
-            version=1,
+            # Version-bits signaling (round 20): top-bits + every
+            # deployment bit worth signaling on this parent, or the
+            # legacy literal 1 when no deployments are configured.
+            version=self.versionbits.mining_version(
+                self.chain, parent.block_hash()
+            ),
             prev_hash=parent.block_hash(),
             merkle_root=merkle_root([tx.txid() for tx in txs]),
             timestamp=ts,
@@ -3988,6 +4356,12 @@ class Node:
                 "stalls": self.metrics.snapshot_stalls,
                 "revalidated_blocks": self.metrics.revalidated_blocks,
             },
+            # The always-on maintenance plane (round 20): what the node
+            # has done to itself while serving — live re-bases, online
+            # prune/compact — plus the continuous-snapshot economics
+            # (incremental builds vs chunks reused) and the
+            # version-bits activation report.
+            "maintenance": self.maintenance_report(),
             # Query serving plane (round 9): read-traffic counters (how
             # many proofs/filters this node served and at what cache hit
             # rate) — the host-side view of the tier benchmarks/

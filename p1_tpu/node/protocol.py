@@ -146,6 +146,20 @@ Messages:
              root at the end — a peer lying mid-transfer is caught on
              the first bad chunk.  The payloads are exactly the
              snapshot-file records, so wire and disk cannot drift.
+- GETMAINTAIN: a maintenance command as canonical JSON (utf-8):
+             ``{"op": "status"}`` reports the maintenance plane
+             (version-bits deployment states, rebase/prune/compact
+             counters, busy flag); ``{"op": "rebase", "keep": N}``,
+             ``{"op": "prune", "keep": N}`` and ``{"op": "compact"}``
+             run the corresponding zero-downtime operation on a live
+             node (`p1 maintain`).  JSON like STATUS: the operator
+             surface grows, the wire version must not.
+- MAINTAIN:  the maintenance reply as canonical JSON — ``{"ok": bool,
+             ...}`` with op-specific fields (the rebase result, prune
+             floor, compaction stats, or the status report).  Errors
+             come back as ``{"ok": false, "error": "..."}`` rather
+             than a dropped session: a refused maintenance command is
+             an answer, not a protocol violation.
 """
 
 from __future__ import annotations
@@ -200,8 +214,11 @@ _LEN = struct.Struct(">I")
 #: snapshot sync (GETSNAPSHOT/SNAPSHOT — chunked ledger-state snapshots
 #: with a self-describing manifest, chain/snapshot.py); v12 the
 #: telemetry plane (GETMETRICS/METRICS — the metrics registry snapshot
-#: of node/telemetry.py, served by nodes and replicas).
-PROTOCOL_VERSION = 12
+#: of node/telemetry.py, served by nodes and replicas); v13 the
+#: maintenance plane (GETMAINTAIN/MAINTAIN — `p1 maintain` drives live
+#: re-basing, online prune/compact, and version-bits status on a
+#: running node without restarting it).
+PROTOCOL_VERSION = 13
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -244,6 +261,8 @@ class MsgType(enum.IntEnum):
     SNAPSHOT = 28
     GETMETRICS = 29
     METRICS = 30
+    GETMAINTAIN = 31
+    MAINTAIN = 32
 
 
 #: The wire version that introduced each frame type — the version-gate
@@ -287,6 +306,8 @@ MSG_SINCE: dict[MsgType, int] = {
     MsgType.SNAPSHOT: 11,
     MsgType.GETMETRICS: 12,
     MsgType.METRICS: 12,
+    MsgType.GETMAINTAIN: 13,
+    MsgType.MAINTAIN: 13,
 }
 assert set(MSG_SINCE) == set(MsgType), "every frame type needs a version row"
 assert all(1 <= v <= PROTOCOL_VERSION for v in MSG_SINCE.values())
@@ -510,6 +531,28 @@ def encode_metrics(snapshot: dict) -> bytes:
 
     return bytes([MsgType.METRICS]) + json.dumps(
         snapshot, separators=(",", ":")
+    ).encode("utf-8")
+
+
+def encode_getmaintain(command: dict) -> bytes:
+    """A maintenance command (v13, `p1 maintain`) as canonical JSON —
+    ``{"op": "status"|"rebase"|"prune"|"compact", ...}``.  JSON for the
+    same reason as STATUS: operator surfaces grow every round and must
+    not cost a wire version per field."""
+    import json
+
+    return bytes([MsgType.GETMAINTAIN]) + json.dumps(
+        command, separators=(",", ":")
+    ).encode("utf-8")
+
+
+def encode_maintain(reply: dict) -> bytes:
+    """The maintenance reply — ``{"ok": bool, ...}``; refusals travel
+    as ``{"ok": false, "error": ...}``, never as dropped sessions."""
+    import json
+
+    return bytes([MsgType.MAINTAIN]) + json.dumps(
+        reply, separators=(",", ":")
     ).encode("utf-8")
 
 
@@ -873,7 +916,7 @@ def _decode(payload: bytes):
         if body:
             raise ValueError("bad GETMETRICS")
         return mtype, None
-    if mtype in (MsgType.STATUS, MsgType.METRICS):
+    if mtype in (MsgType.STATUS, MsgType.METRICS, MsgType.GETMAINTAIN, MsgType.MAINTAIN):
         import json
 
         try:

@@ -62,6 +62,7 @@ async def run_node(args, miner=None) -> int:
             getattr(args, "store_segment_mb", 0.0) * (1 << 20)
         ),
         prune_keep_blocks=getattr(args, "prune", 0),
+        snapshot_interval=getattr(args, "snapshot_interval", 0),
     )
     node = Node(config, miner=miner)
     await node.start()

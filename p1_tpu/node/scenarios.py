@@ -1729,6 +1729,209 @@ def snapshot_cartel(
     return net.run(main())
 
 
+# -- version-bits activation ---------------------------------------------
+
+
+def version_activation(
+    nodes: int = 8,
+    seed: int = 0,
+    difficulty: int = 8,
+    vb_window: int = 8,
+    vb_threshold: int = 6,
+    straggler_per_window: int = 2,
+    extra_windows: int = 1,
+    fork_bound: int = 0,
+    margin: int = 0,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """BIP9-analog version-bits activation on a MIXED-version mesh: a
+    majority of round-20 nodes signal ``feature-x`` on bit 0 while one
+    straggler runs the legacy table — it mines literal ``version=1``
+    forever and has never heard of deployments.  The straggler keeps a
+    deterministic slice of the hashrate (``straggler_per_window`` block
+    slots per retarget-window), so the signaling window carries exactly
+    ``vb_window - straggler_per_window`` signaling blocks — at or above
+    ``vb_threshold`` by construction — and the deployment must walk
+    DEFINED → STARTED → LOCKED_IN → ACTIVE at the predicted heights.
+
+    The no-fork bound under test: header ``version`` is NOT consensus
+    here (exactly as in Bitcoin's soft-fork deployments pre-enforcement),
+    so the mixed mesh must never diverge — not while the stragglers'
+    legacy blocks interleave with signaling ones pre-activation, not at
+    the LOCKED_IN boundary, and not after ACTIVE clears the signal bit.
+    ok asserts persistent-fork observations ≤ ``fork_bound + margin``
+    (``margin=-1`` is the impossible-bound control) plus the structural
+    bits: the straggler really mined on both sides of activation and its
+    blocks were accepted by everyone, and the signaling window really
+    carried ≥ threshold signaling headers."""
+    assert vb_window - straggler_per_window >= vb_threshold, (
+        "shape can never lock in: raise the signaling share"
+    )
+    start = vb_window  # first full window: heights [W, 2W)
+    deploy = (("feature-x", 0, start, vb_window * 16),)
+    # Ladder prediction, in tip heights at window boundaries: the
+    # window [W, 2W) is STARTED and is the one whose signal count is
+    # judged, so LOCKED_IN begins at 2W and ACTIVE at 3W.
+    activation_height = 3 * vb_window
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    STRAGGLER = "straggler"
+
+    async def main():
+        from p1_tpu.chain.versionbits import TOP_BITS, signals
+
+        rng = random.Random(seed ^ 0xB1B9)
+        for i in range(nodes - 1):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)],
+                miner_id=f"signal-{i}",
+                deployments=deploy,
+                vb_window=vb_window,
+                vb_threshold=vb_threshold,
+            )
+        hosts = list(net.nodes)
+        rep = net.nodes[hosts[0]]
+        # The straggler joins the same mesh as a full peer — the point
+        # is precisely that nothing about deployments is negotiated.
+        straggler = await net.add_node(
+            peers=[hosts[0], hosts[-1]], miner_id=STRAGGLER
+        )
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+
+        dep_report = (
+            lambda: rep.versionbits.states_report(rep.chain)["feature-x"]
+        )
+        ladder: dict[int, str] = {0: dep_report()["state"]}
+        versions = {"signaling": set(), "straggler": set()}
+        stats = {
+            "straggler_finds": 0,
+            "straggler_finds_pre_activation": 0,
+            "signal_finds": 0,
+            "fork_checks": 0,
+            "forks_observed": 0,
+        }
+
+        # Versionbits windows are ANCHORED at multiples of vb_window
+        # (genesis fills slot 0 of window 0), so the straggler's slots
+        # are sampled per anchored window — its share of any window the
+        # threshold judges is exact, which is what makes the lock-in
+        # deterministic rather than a coin flip on the seed.
+        target_height = vb_window * (3 + extra_windows) - 1
+        window, slots = -1, set()
+        for h in range(1, target_height + 1):
+            if h // vb_window != window:
+                window = h // vb_window
+                slots = set(
+                    rng.sample(range(vb_window), straggler_per_window)
+                )
+            if h % vb_window in slots:
+                miner, side = straggler, "straggler"
+                stats["straggler_finds"] += 1
+                if h < activation_height:
+                    stats["straggler_finds_pre_activation"] += 1
+            else:
+                miner = net.nodes[hosts[h % (nodes - 1)]]
+                side = "signaling"
+                stats["signal_finds"] += 1
+            blk = await net.mine_on(miner, spacing_s=1.0)
+            versions[side].add(blk.header.version)
+            assert await net.run_until(
+                lambda: min(net.heights()) >= h,
+                60, step=0.25, wall_limit_s=wall_limit_s,
+            ), f"block {h} never propagated"
+            if h % vb_window == 0:
+                # Window boundary: a persistent tip split here is
+                # exactly the fork the scenario exists to rule out.
+                stats["fork_checks"] += 1
+                if not await net.run_until(
+                    net.converged, 60, step=0.25,
+                    wall_limit_s=wall_limit_s,
+                ):
+                    stats["forks_observed"] += 1
+                ladder[h] = dep_report()["state"]
+
+        settled = await net.run_until(
+            net.converged, 120, step=0.25, wall_limit_s=wall_limit_s
+        )
+
+        # Chain autopsy: whose coinbases landed, and did the STARTED
+        # window really carry enough signaling headers.
+        chain = rep.chain
+        straggler_on_chain = {"pre": 0, "post": 0}
+        signal_bit_in_started_window = 0
+        for h in range(1, chain.height + 1):
+            block = chain._block_at(chain.main_hash_at(h))
+            if block.txs[0].recipient == STRAGGLER:
+                side = "pre" if h < activation_height else "post"
+                straggler_on_chain[side] += 1
+            if start <= h < 2 * vb_window and signals(
+                block.header.version, 0
+            ):
+                signal_bit_in_started_window += 1
+
+        ladder_ok = (
+            ladder.get(vb_window) == "started"
+            and ladder.get(2 * vb_window) == "locked_in"
+            and ladder.get(3 * vb_window) == "active"
+        )
+        # Every signaling node must agree the bit is ACTIVE; the
+        # straggler's report is empty — it has no deployments to state.
+        states_agree = all(
+            net.nodes[h].versionbits.states_report(net.nodes[h].chain)[
+                "feature-x"
+            ]["state"] == "active"
+            for h in hosts
+        ) and straggler.versionbits.states_report(straggler.chain) == {}
+
+        bound = fork_bound + margin
+        containment_held = stats["forks_observed"] <= bound
+        report = _report(
+            net, "version-activation", t0,
+            vb_window=vb_window,
+            vb_threshold=vb_threshold,
+            activation_height=activation_height,
+            ladder={str(h): s for h, s in sorted(ladder.items())},
+            ladder_ok=ladder_ok,
+            states_agree=states_agree,
+            signal_bit_in_started_window=signal_bit_in_started_window,
+            straggler_blocks_pre_activation=straggler_on_chain["pre"],
+            straggler_blocks_post_activation=straggler_on_chain["post"],
+            signaling_versions=sorted(
+                f"0x{v:08x}" for v in versions["signaling"]
+            ),
+            straggler_versions=sorted(
+                f"0x{v:08x}" for v in versions["straggler"]
+            ),
+            fork_bound_effective=bound,
+            containment_held=containment_held,
+            settled=settled,
+            **stats,
+        )
+        report["ok"] = bool(
+            settled
+            and report["converged"]
+            and report["ledger_conserved"]
+            and containment_held
+            and ladder_ok
+            and states_agree
+            # The mix must actually have run, or the no-fork claim is
+            # vacuous: legacy blocks on BOTH sides of activation, all
+            # accepted; the signaling window really cleared threshold;
+            # the straggler never emitted anything but literal 1.
+            and straggler_on_chain["pre"] > 0
+            and straggler_on_chain["post"] > 0
+            and signal_bit_in_started_window >= vb_threshold
+            and versions["straggler"] == {1}
+            and TOP_BITS | 1 in versions["signaling"]
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
 # -- registry / CLI entry ------------------------------------------------
 
 def soak(
@@ -1763,6 +1966,7 @@ SCENARIOS = {
     "fee-spam": fee_spam,
     "retarget-shock": retarget_shock,
     "snapshot-cartel": snapshot_cartel,
+    "version-activation": version_activation,
     "soak": soak,
 }
 

@@ -616,3 +616,140 @@ class TestLongevitySoak:
         # ~12,000x time compression makes the week a sub-two-minute
         # test; the wall guard is the regression tripwire.
         assert r["wall_s"] < 300.0
+
+
+@pytest.mark.chaos
+class TestMaintenanceChaos:
+    """Round-20 fault families: the always-on maintenance plane under
+    chaos.  The generated corpus must carry all four ops (so the sweeps
+    exercise them organically), a crafted schedule must FIRE all four
+    against live invariants, and the kill-9 mid-rebase must reboot as
+    an ordinary un-rebased node."""
+
+    FAMILIES = (
+        "rebase",
+        "seal_sidecar_crash",
+        "online_prune",
+        "online_compact_crash",
+    )
+
+    def test_generated_corpus_carries_all_four_families(self):
+        ops: set[str] = set()
+        crash_flags: set[bool] = set()
+        for seed in range(40):
+            for ev in chaos.generate_schedule(seed, 5, 10):
+                ops.add(ev["op"])
+                if ev["op"] == "rebase":
+                    crash_flags.add(ev["crash"])
+        for family in self.FAMILIES:
+            assert family in ops, f"{family} never generated in 40 seeds"
+        # Both rebase variants appear: the clean live re-base and the
+        # kill-9 between the store half and the in-RAM half.
+        assert crash_flags == {True, False}
+
+    def test_crafted_schedule_fires_all_four_families(self, monkeypatch):
+        """A hand-laid schedule where every family FIRES (not degrades
+        to a refusal no-op), proven by spying the runner's trace
+        records; the run itself must hold every invariant."""
+        t = [0.0]
+
+        def ev(**kw):
+            t[0] += 0.8
+            return {"at": round(t[0], 3), **kw}
+
+        events = (
+            # Enough depth for a checkpointed rebase target and a
+            # pruneable sealed segment (snapshot cadence is 4).
+            [ev(op="mine", node=0) for _ in range(14)]
+            + [
+                # Forced seal with the .sdx write failing: tolerated,
+                # healed, recorded.
+                ev(op="seal_sidecar_crash", node=0),
+                # Live re-base (rolls + spills sidecars, then advances
+                # the in-RAM base) on the mining node.
+                ev(op="rebase", node=0, keep=2, crash=False),
+            ]
+            + [ev(op="mine", node=0) for _ in range(4)]
+            + [
+                # The rebase's roll sealed everything below the new
+                # checkpoint: this prune MUST discard segments.
+                ev(op="online_prune", node=0, keep=2),
+                # Planner death mid-compaction on a peer that keeps
+                # serving.
+                ev(op="online_compact_crash", node=1),
+            ]
+            + [ev(op="mine", node=1) for _ in range(2)]
+        )
+        recorded: list[tuple] = []
+        orig = chaos._ChaosRunner._record
+
+        def spy(self, *fields):
+            recorded.append(fields)
+            orig(self, *fields)
+
+        monkeypatch.setattr(chaos._ChaosRunner, "_record", spy)
+        report = chaos.run_chaos(0, nodes=3, events=events)
+        assert report["ok"], report["violations"]
+        fired = {r[0] for r in recorded}
+        for family in self.FAMILIES:
+            assert family in fired, (family, sorted(fired))
+        # online_prune only records when segments actually dropped;
+        # the count rode into the trace.
+        prune = next(r for r in recorded if r[0] == "online_prune")
+        assert prune[2] >= 1
+
+    def test_kill9_mid_rebase_reboots_unrebased(self, monkeypatch):
+        """The crash contract of leg (a): the durable store half (seal
+        + sidecar spill) lands, the process dies before the in-RAM
+        rebase — reboot must come back consistent (fsck clean, exact
+        prefix), i.e. the kill-9 costs the rebase, never the chain."""
+        t = [0.0]
+
+        def ev(**kw):
+            t[0] += 0.8
+            return {"at": round(t[0], 3), **kw}
+
+        events = (
+            [ev(op="mine", node=0) for _ in range(10)]
+            + [
+                ev(op="rebase", node=0, keep=2, crash=True),
+                ev(op="recover", node=0),
+            ]
+            + [ev(op="mine", node=0) for _ in range(2)]
+        )
+        recorded: list[tuple] = []
+        orig = chaos._ChaosRunner._record
+
+        def spy(self, *fields):
+            recorded.append(fields)
+            orig(self, *fields)
+
+        monkeypatch.setattr(chaos._ChaosRunner, "_record", spy)
+        report = chaos.run_chaos(3, nodes=3, events=events)
+        assert report["ok"], report["violations"]
+        assert report["crashes"] == 1 and report["recoveries"] == 1
+        assert any(r[0] == "rebase_crash" for r in recorded)
+        # The rebase itself never happened — no "rebase" record, so the
+        # reboot was an ordinary un-rebased node with spare sidecars.
+        assert not any(r[0] == "rebase" for r in recorded)
+
+    def test_soak_schedule_carries_maintenance_clusters(self):
+        """generate_soak_schedule's `maintenance` cluster kind: a week
+        of recurring self-maintenance must appear in the soak corpus —
+        sidecar failure at a seal, live re-base, and exactly one prune
+        (someone keeps the archive) with compaction faults thereafter."""
+        ops: list[str] = []
+        for seed in range(8):
+            events = chaos.generate_soak_schedule(
+                seed=seed, n_nodes=5, horizon_vs=7 * chaos.DAY_VS,
+                fault_clusters=28, blocks=336,
+            )
+            ops.extend(e["op"] for e in events)
+            # At most one online_prune per schedule: the archive rule.
+            assert ops.count("online_prune") <= len(ops)
+            assert (
+                sum(1 for e in events if e["op"] == "online_prune") <= 1
+            )
+        for family in ("seal_sidecar_crash", "rebase", "online_prune",
+                       "online_compact_crash"):
+            assert family in ops, f"{family} absent from 8 soak seeds"

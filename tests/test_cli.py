@@ -1233,6 +1233,167 @@ class TestLint:
         assert proc.returncode == 2
 
 
+class TestMaintain:
+    """`p1 maintain` (round 20, GETMAINTAIN/MAINTAIN v13): the exit-code
+    contract — 0 when the node answered ``{"ok": true}``, 1 when it
+    refused or the wire failed, 2 on local usage errors — plus one
+    subprocess e2e driving a live node through status/rebase/compact
+    while it keeps mining."""
+
+    def test_help_smoke(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "maintain", "--help"],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0
+        assert "rebase" in proc.stdout and "--keep" in proc.stdout
+
+    def test_negative_keep_is_usage_error_exit_2(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "maintain", "rebase",
+                "--difficulty", "12", "--keep", "-1",
+            ],
+            capture_output=True, text=True, timeout=110, cwd="/root/repo",
+        )
+        assert proc.returncode == 2
+        assert "--keep must be >= 0" in proc.stderr
+
+    def test_keep_with_status_is_usage_error_exit_2(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "maintain", "status",
+                "--difficulty", "12", "--keep", "4",
+            ],
+            capture_output=True, text=True, timeout=110, cwd="/root/repo",
+        )
+        assert proc.returncode == 2
+        assert "--keep does not apply" in proc.stderr
+
+    def test_connection_failure_exit_1(self):
+        # A port nothing listens on: the wire error must land as exit 1
+        # with the detail on stderr, not a traceback.
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "maintain", "status",
+                "--difficulty", "12", "--port", str(port),
+            ],
+            capture_output=True, text=True, timeout=110, cwd="/root/repo",
+        )
+        assert proc.returncode == 1
+        assert "maintain command failed" in proc.stderr
+
+    def test_maintain_e2e_live_rebase_while_mining(self, tmp_path):
+        """One mining node, driven across the whole contract: status
+        (0), a live rebase that lands (0), a too-deep rebase refused as
+        an ANSWER (1, detail on stderr), and an online compact (0) —
+        the node never restarts and keeps extending its chain
+        throughout."""
+        import asyncio
+        import time
+
+        from p1_tpu.node.client import get_status
+
+        node_log = open(tmp_path / "node.log", "w")
+        node = subprocess.Popen(
+            [
+                sys.executable, "-m", "p1_tpu", "node",
+                "--difficulty", "12", "--backend", "cpu", "--chunk", "16384",
+                "--port", "0", "--deadline", "stdin",
+                "--miner-id", "alice",
+                "--store", str(tmp_path / "chain.dat"),
+                "--store-segment-mb", "0.0004",
+                "--snapshot-interval", "4",
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=node_log,
+            text=True,
+            cwd="/root/repo",
+        )
+        try:
+            port = None
+            for line in node.stdout:
+                line = line.strip()
+                if line.startswith("{"):
+                    port = str(json.loads(line)["ready"])
+                    break
+            assert port, "node never printed its ready line"
+
+            def maintain(*argv):
+                return subprocess.run(
+                    [
+                        sys.executable, "-m", "p1_tpu", "maintain", *argv,
+                        "--difficulty", "12", "--port", port,
+                    ],
+                    capture_output=True, text=True, timeout=60,
+                    cwd="/root/repo",
+                )
+
+            # Let the miner build past two checkpoint boundaries.
+            deadline = time.monotonic() + 90
+            height = 0
+            while height < 9 and time.monotonic() < deadline:
+                status = asyncio.run(get_status("127.0.0.1", int(port), 12))
+                height = status["height"]
+                time.sleep(0.2)
+            assert height >= 9, f"miner stalled at height {height}"
+
+            proc = maintain("status")
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            report = json.loads(proc.stdout)
+            assert report["ok"] is True and report["base_height"] == 0
+            assert report["versionbits"]["window"] == 8
+
+            proc = maintain("rebase", "--keep", "4")
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            reply = json.loads(proc.stdout)
+            assert reply["ok"] is True and reply["new_base"] >= 4
+            assert reply["dropped_blocks"] == reply["new_base"]
+
+            # Refusal contract: a rebase the chain cannot satisfy comes
+            # back as an answer (exit 1 + stderr detail), the node keeps
+            # serving.
+            proc = maintain("rebase", "--keep", "100000")
+            assert proc.returncode == 1
+            assert "maintain refused" in proc.stderr
+            assert json.loads(proc.stdout)["ok"] is False
+
+            proc = maintain("compact")
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            assert json.loads(proc.stdout)["ok"] is True
+
+            proc = maintain("status")
+            report = json.loads(proc.stdout)
+            assert report["base_height"] >= 4
+            assert report["rebases"] == 1 and report["online_compactions"] == 1
+
+            # The node is still alive and still mining on its rebased
+            # chain.
+            status = asyncio.run(get_status("127.0.0.1", int(port), 12))
+            assert status["height"] >= height
+            assert status["maintenance"]["base_height"] >= 4
+        finally:
+            if node.poll() is None:
+                node.stdin.write(f"{time.time()!r}\n")
+                node.stdin.flush()
+                node.stdin.close()
+                try:
+                    node.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    node.kill()
+            node_log.close()
+
+
 class TestFsckSegmented:
     """Round 18: `p1 fsck` over segmented stores — per-segment
     scan/salvage with the 0/1/2 exit contract intact — and the

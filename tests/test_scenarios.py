@@ -278,6 +278,46 @@ class TestSnapshotCartel:
         assert not r["ok"] and not r["honest_history_kept"]
 
 
+class TestVersionActivation:
+    def test_mixed_version_mesh_activates_without_forking(self):
+        from p1_tpu.node.scenarios import version_activation
+
+        r = version_activation(nodes=8, seed=0)
+        assert r["ok"], r
+        # The ladder walked on schedule: STARTED at the first full
+        # window, LOCKED_IN one window later, ACTIVE one after that.
+        assert r["ladder_ok"] and r["activation_height"] == 24
+        assert r["ladder"]["8"] == "started"
+        assert r["ladder"]["16"] == "locked_in"
+        assert r["ladder"]["24"] == "active"
+        # The mix was real: the straggler mined on BOTH sides of
+        # activation with literal version=1 and everyone accepted it —
+        # version is not consensus, so zero forks is the bound.
+        assert r["straggler_blocks_pre_activation"] > 0
+        assert r["straggler_blocks_post_activation"] > 0
+        assert r["straggler_versions"] == ["0x00000001"]
+        assert r["forks_observed"] == 0 and r["containment_held"]
+        # Lock-in was earned, not gifted: the judged window carried
+        # exactly threshold signaling headers (the straggler's legacy
+        # headers in that window do NOT count — top-bits convention).
+        assert r["signal_bit_in_started_window"] == r["vb_threshold"]
+        # Post-ACTIVE the signal bit clears but top-bits stay.
+        assert "0x20000000" in r["signaling_versions"]
+        assert "0x20000001" in r["signaling_versions"]
+        # Every signaling node reports active; the straggler has no
+        # deployment table at all and agrees on the chain anyway.
+        assert r["states_agree"]
+
+    def test_no_fork_bound_is_load_bearing(self):
+        from p1_tpu.node.scenarios import version_activation
+
+        r = version_activation(nodes=8, seed=0, margin=-1)
+        assert not r["ok"] and not r["containment_held"]
+        # The control fails ONLY on the impossible bound — the mesh
+        # itself still activated and converged.
+        assert r["ladder_ok"] and r["converged"]
+
+
 class TestRegistry:
     def test_run_scenario_dispatches_and_rejects_unknown(self):
         r = run_scenario("wan", region_nodes=3, blocks=2, seed=1)

@@ -193,6 +193,20 @@ STATUS_KEYS = [
     "liveness",
     "liveness.peers_evicted_idle",
     "liveness.pings_sent",
+    "maintenance",
+    "maintenance.base_height",
+    "maintenance.busy",
+    "maintenance.compaction_records_dropped",
+    "maintenance.online_compactions",
+    "maintenance.online_prunes",
+    "maintenance.rebases",
+    "maintenance.segments_compacted",
+    "maintenance.snapshot_chunks_reused",
+    "maintenance.snapshot_incremental_builds",
+    "maintenance.versionbits",
+    "maintenance.versionbits.deployments",
+    "maintenance.versionbits.threshold",
+    "maintenance.versionbits.window",
     "mempool",
     "miner_id",
     "overload",
