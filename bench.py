@@ -345,6 +345,36 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Wallet push plane (round 21): live subscriptions held and p95
+    # per-block notify latency on the shared-decode push path
+    # (benchmarks/wallet_plane.py bench_quick, 20k sessions — the 100k
+    # acceptance run is the benchmark's main()).  LOWER is better for
+    # the p95, so notify_vs_recorded > 1 means slower than the record
+    # (perf_record.py RECORDED_NOTIFY_P95_MS).
+    from p1_tpu.hashx.perf_record import (
+        NOTIFY_DEGRADED_FACTOR,
+        RECORDED_NOTIFY_P95_MS,
+        RECORDED_WALLET_SUBS,
+    )
+
+    try:
+        from benchmarks.wallet_plane import bench_quick as wallet_quick
+
+        wp = wallet_quick()
+        extra["wallet_subs"] = wp["wallet_subs"]
+        extra["notify_p95_ms"] = wp["notify_p95_ms"]
+        extra["notify_events_per_sec"] = wp["notify_events_per_sec"]
+        extra["notify_vs_recorded"] = round(
+            wp["notify_p95_ms"] / RECORDED_NOTIFY_P95_MS, 2
+        )
+        if wp["wallet_subs"] < RECORDED_WALLET_SUBS or (
+            wp["notify_p95_ms"]
+            > NOTIFY_DEGRADED_FACTOR * RECORDED_NOTIFY_P95_MS
+        ):
+            extra["notify_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Deterministic network simulator (round 10): node-seconds of
     # simulated mesh per wall second on a quick 100-node partition-heal
     # (benchmarks/netsim_scale.py scales linearly enough that the small

@@ -1,0 +1,277 @@
+"""Wallet push-plane benchmark: live subscriptions at scale, host-side.
+
+ROUND21's "done" bar: one process sustaining >= 100k live wallet
+subscriptions on a SubscriptionManager with per-block notify latency
+(p95) under one block interval — plus a small real-socket
+submit -> confirm -> push end-to-end measurement (the SLO row in
+docs/PERF.md).  The 100k figure is what makes the shared-decode design
+honest: notify cost is O(filter decode + subs x items), NOT
+O(subs x filter decode), so one decode is amortized across every
+session (node/subscriptions.py).
+
+Measurements:
+
+- **wallet_subs** — live subscriptions held while the notify figures
+  below were taken (the scale knob, default 100_000).
+- **notify_p95_ms / notify_mean_ms** — per-block connect-to-delivered
+  latency of SubscriptionManager.notify() across the measured blocks:
+  decode the block's filter once, probe every session's watch set,
+  personalize matched events, hand every non-matched session the one
+  shared pre-encoded frame.
+- **notify_events_per_sec** — delivered events/s during those blocks
+  (subs x blocks / total notify time).
+- **push_e2e_ms** — real sockets: a node mining on loopback, a
+  `client.watch` session subscribed to the recipient account; wall
+  time from send_tx() to the verified matched EVENT arriving (submit,
+  mine/confirm, filter build, push, client-side commitment check).
+
+JSON: {"metric": "wallet_subs", "value": ..., "notify_p95_ms": ...}
+— one line, measured, no estimates (the bench.py contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.query_plane import build_chain  # noqa: E402
+
+
+class _ThrottledSource:
+    """ChainSubSource with a movable tip: the fixture chain is fully
+    built up front, then 'connected' one block at a time so each
+    notify() measures exactly one block's push cost."""
+
+    def __init__(self, chain):
+        self._chain = chain
+        self.tip = 0
+
+    @property
+    def tip_height(self) -> int:
+        return self.tip
+
+    def hash_at(self, height: int):
+        if not 0 <= height <= self.tip:
+            return None
+        return self._chain.main_hash_at(height)
+
+    def raw_header_at(self, height: int):
+        bh = self.hash_at(height)
+        return None if bh is None else self._chain.header_of(bh).serialize()
+
+    def filter_at(self, height: int):
+        bh = self.hash_at(height)
+        return None if bh is None else self._chain.block_filter(bh)
+
+    def fheader_at(self, height: int):
+        if height > self.tip:
+            return None
+        return self._chain.filter_headers.header_at(height)
+
+    def block_items_at(self, height: int):
+        from p1_tpu.node.subscriptions import block_items_index
+
+        bh = self.hash_at(height)
+        return None if bh is None else block_items_index(self._chain.get(bh))
+
+
+def bench_subs(
+    subs: int = 100_000,
+    warm_blocks: int = 4,
+    measure_blocks: int = 12,
+    txs: int = 24,
+    matched_fraction: float = 0.01,
+) -> dict:
+    """>= ``subs`` live sessions on one SubscriptionManager; p95 notify
+    latency per connected block.  ``matched_fraction`` of the sessions
+    watch an account the fixture blocks actually pay (every block's
+    transfers go to "bob"), the rest watch cold accounts — the
+    realistic shape: almost every wallet is a non-match almost always.
+    Delivery sinks count bytes and never backpressure (buffer 0), so
+    the figure isolates the push plane, not the benchmark's sockets."""
+    from p1_tpu.node.subscriptions import SubscriptionManager
+
+    chain = build_chain(warm_blocks + measure_blocks, txs, difficulty=1)
+    source = _ThrottledSource(chain)
+    mgr = SubscriptionManager(source)
+
+    delivered = [0]
+
+    async def _sink(payload: bytes) -> None:
+        delivered[0] += 1
+
+    def _buf() -> int:
+        return 0
+
+    def _close() -> None:
+        pass
+
+    async def _run() -> dict:
+        n_matched = int(subs * matched_fraction)
+        for i in range(subs):
+            items = (
+                [b"bob"]
+                if i < n_matched
+                else [b"cold-account-%d" % i, b"cold-change-%d" % i]
+            )
+            ok = await mgr.subscribe(
+                i, items, None, send=_sink, buffer_size=_buf, close=_close
+            )
+            assert ok
+        assert len(mgr) == subs
+
+        # Warm-up: first connects touch cold caches (filter decode path).
+        for h in range(1, warm_blocks + 1):
+            source.tip = h
+            await mgr.notify()
+
+        samples_ms = []
+        t_total = 0.0
+        for h in range(warm_blocks + 1, warm_blocks + measure_blocks + 1):
+            source.tip = h
+            t0 = time.perf_counter()
+            await mgr.notify()
+            dt = time.perf_counter() - t0
+            samples_ms.append(dt * 1000.0)
+            t_total += dt
+        samples_ms.sort()
+        p95 = samples_ms[min(len(samples_ms) - 1, int(0.95 * len(samples_ms)))]
+        return {
+            "wallet_subs": len(mgr),
+            "notify_p95_ms": round(p95, 2),
+            "notify_mean_ms": round(
+                sum(samples_ms) / len(samples_ms), 2
+            ),
+            "notify_events_per_sec": round(subs * measure_blocks / t_total),
+            "events_delivered": delivered[0],
+            "measure_blocks": measure_blocks,
+        }
+
+    return asyncio.run(_run())
+
+
+def bench_push_e2e(difficulty: int = 20, timeout: float = 60.0) -> dict:
+    """submit -> confirm -> push over real loopback sockets: a mining
+    node, one watch session on the recipient account, wall time from
+    send_tx to the verified matched EVENT.
+
+    The default difficulty pins block cadence near one per second; at
+    test-grade difficulties this host mines hundreds of blocks a
+    second, which measures the watch client's replay treadmill instead
+    of the push path."""
+    from p1_tpu.config import NodeConfig
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.node.client import send_tx, watch
+    from p1_tpu.node.node import Node
+
+    alice = Keypair.from_seed_text("wallet-plane-alice")
+
+    async def _run() -> dict:
+        node = Node(
+            NodeConfig(
+                host="127.0.0.1",
+                port=0,
+                difficulty=difficulty,
+                mine=True,
+                miner_id=alice.account,
+            )
+        )
+        await node.start()
+        try:
+            # Let the miner fund alice before the spend.
+            for _ in range(600):
+                if node.chain.balance(alice.account) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            gen = watch(
+                "127.0.0.1",
+                node.port,
+                ["bob-wallet-plane"],
+                difficulty,
+                max_session_failures=3,
+            )
+            t0 = None
+            latency_ms = None
+            try:
+                agen = gen.__aiter__()
+                # First event proves the session is live before we time.
+                await asyncio.wait_for(agen.__anext__(), timeout)
+                tx = Transaction.transfer(
+                    alice,
+                    "bob-wallet-plane",
+                    1,
+                    1,
+                    0,
+                    chain=node.chain.genesis.block_hash(),
+                )
+                t0 = time.perf_counter()
+                await send_tx("127.0.0.1", node.port, tx, difficulty)
+                while True:
+                    ev = await asyncio.wait_for(agen.__anext__(), timeout)
+                    if ev["matched"]:
+                        latency_ms = (time.perf_counter() - t0) * 1000.0
+                        break
+            finally:
+                await gen.aclose()
+            return {"push_e2e_ms": round(latency_ms, 1)}
+        finally:
+            await node.stop()
+
+    return asyncio.run(_run())
+
+
+def bench_quick(subs: int = 20_000, measure_blocks: int = 8) -> dict:
+    """The bench.py hook: the same notify measurement at a size that
+    keeps the headline bench fast; the 100k figure is main()'s job."""
+    return bench_subs(subs=subs, warm_blocks=2, measure_blocks=measure_blocks)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--subs", type=int, default=100_000)
+    ap.add_argument("--blocks", type=int, default=12, help="measured blocks")
+    ap.add_argument("--txs", type=int, default=24, help="transfers per block")
+    ap.add_argument(
+        "--skip-e2e",
+        action="store_true",
+        help="skip the real-socket submit->confirm->push measurement",
+    )
+    args = ap.parse_args()
+
+    res = bench_subs(
+        subs=args.subs, measure_blocks=args.blocks, txs=args.txs
+    )
+    if not args.skip_e2e:
+        res.update(bench_push_e2e())
+
+    import os
+
+    try:
+        load_1m, load_5m, _ = os.getloadavg()
+    except OSError:
+        load_1m = load_5m = None
+
+    print(
+        json.dumps(
+            {
+                "metric": "wallet_subs",
+                "value": res["wallet_subs"],
+                "unit": "live subscriptions",
+                "load_avg_1m": load_1m,
+                "load_avg_5m": load_5m,
+                "cpu_count": os.cpu_count(),
+                **res,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -55,7 +55,7 @@ from p1_tpu.core.block import Block
 from p1_tpu.core.genesis import make_genesis
 from p1_tpu.core.header import BlockHeader
 from p1_tpu.core.retarget import RetargetRule
-from p1_tpu.chain.filters import FilterIndex
+from p1_tpu.chain.filters import FilterHeaderChain, FilterIndex
 from p1_tpu.chain.ledger import Ledger, LedgerError
 from p1_tpu.chain.proof import ProofCache, TxProof, build_block_proofs
 from p1_tpu.chain.snapshot import (
@@ -264,6 +264,15 @@ class Chain:
         #: bytes-bounded LRUs the node charges to its memory gauge.
         self.proof_cache = ProofCache()
         self.filter_index = FilterIndex()
+        #: BIP157-analog filter-header commitment chain, kept in
+        #: lockstep with the main chain at every connect (add_block) so
+        #: the push/serving planes can hand wallets a commitment to
+        #: cross-check untrusted filter streams against.  Stays
+        #: honestly empty on re-based / from_snapshot chains (no height
+        #: 0 to anchor at) and honestly short past a pruned body — a
+        #: refusal wallets treat as "ask an archive replica", never as
+        #: a partial answer.
+        self.filter_headers = FilterHeaderChain()
         #: Stateless-validation entry point used by ``_insert`` and
         #: ``_park_orphan`` — an instance attribute so the staged node
         #: (node/pipeline.py) can interpose and so tests can instrument
@@ -567,6 +576,19 @@ class Chain:
             return None  # pruned body and no cached filter: refuse
         return self.filter_index.get_or_build(block_hash, self._block_at)
 
+    def _sync_filter_headers(self) -> None:
+        """Advance ``filter_headers`` to the current main chain.  O(1)
+        per plain extension (one filter build, cached in the filter
+        index); reorgs walk back by hash comparison.  Pruned bodies
+        with no cached filter stop the walk — the commitment stays
+        honestly short rather than guessing."""
+
+        def filter_at(height: int) -> bytes | None:
+            bh = self.main_hash_at(height)
+            return None if bh is None else self.block_filter(bh)
+
+        self.filter_headers.sync(self.height, self.main_hash_at, filter_at)
+
     def main_hash_at(self, height: int) -> bytes | None:
         """The main-chain block hash at ``height`` (None above the tip,
         and None below an assumed chain's base — heights this index
@@ -775,6 +797,11 @@ class Chain:
             bh = b.block_hash()
             for tx in b.txs:
                 self._tx_index[tx.txid()] = bh
+        # Extend (or reorg-repair) the filter-header commitment chain in
+        # the same call that moved the tip — every connect site (mining,
+        # gossip, sync, store replay) funnels through here, so the
+        # commitment can never lag the chain it commits to.
+        self._sync_filter_headers()
         if bhash in self._invalid:
             # Indexed but contextually invalid (its transfers overdraw
             # somewhere on its branch) — callers see a rejection, and the

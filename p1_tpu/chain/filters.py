@@ -229,6 +229,31 @@ def matches_any(
     return False
 
 
+def decode_value_set(filter_bytes: bytes, p: int = FILTER_P) -> frozenset:
+    """The filter's mapped values as a set — the push plane's shared
+    decode: one pass per block, then ``matches_values`` per subscriber
+    is a handful of hashes and set probes instead of a re-decode (the
+    difference between O(subs · filter) and O(filter + subs · items)
+    per connect at 100k live subscriptions)."""
+    return frozenset(decode_values(filter_bytes, p))
+
+
+def matches_values(
+    values,
+    n: int,
+    key: bytes,
+    items,
+    m: int = FILTER_M,
+) -> bool:
+    """``matches_any`` against a pre-decoded value set (``values`` from
+    ``decode_value_set``, ``n`` from ``filter_count``)."""
+    if n == 0 or not items:
+        return False
+    key = key[:_KEY_LEN]
+    f = n * m
+    return any(_hash_to_range(key, it, f) in values for it in items)
+
+
 def block_filter(block, p: int = FILTER_P, m: int = FILTER_M) -> bytes:
     """The canonical filter for ``block`` — keyed by its own hash, so a
     filter is verifiable against (and only against) the block it claims
@@ -304,3 +329,113 @@ class FilterIndex:
             "hits": self.hits,
             "misses": self.misses,
         }
+
+
+# -- the filter-header commitment chain (BIP157 analog) --------------------
+
+#: The virtual header "before genesis" — the chain's anchor.  All-zero,
+#: like BIP157's: the first real header is then a pure function of the
+#: genesis block's filter, so two honest servers can never disagree.
+GENESIS_FILTER_HEADER = b"\x00" * 32
+
+
+def filter_hash(filter_bytes: bytes) -> bytes:
+    return hashlib.sha256(filter_bytes).digest()
+
+
+def next_filter_header(fhash: bytes, prev_header: bytes) -> bytes:
+    """``filter_header[i] = H(filter_hash[i] || filter_header[i-1])`` —
+    each header commits to every filter before it, so a wallet that
+    knows ONE trusted header height can verify a whole served filter
+    stream below it, and two servers that disagree anywhere disagree at
+    the tip."""
+    return hashlib.sha256(fhash + prev_header).digest()
+
+
+class FilterHeaderChain:
+    """The height-indexed commitment chain over the main branch.
+
+    This is what closes the ROUND9 trust gap: filters themselves are
+    pure functions of block bytes, but a wallet syncing from ONE
+    untrusted replica had no way to tell a served filter from a forged
+    one without downloading the block.  The header chain makes forgery
+    *comparable*: any two servers of the same chain must serve identical
+    filter headers at every height, so a wallet cross-checks the stream
+    against a second source (or a single hash-pinned block fetch) and
+    demotes whichever side broke the commitment.
+
+    Maintained incrementally by ``sync()`` against any height→hash /
+    height→filter source (the node's ``Chain``, a replica's mmap view).
+    Entries store ``(block_hash, filter_header)`` so a reorg is detected
+    by hash comparison and handled by truncate-and-extend.  A source
+    that cannot produce a filter (pruned/re-based history with the body
+    gone) simply stops the extension: the chain stays short and range
+    queries refuse cleanly — wallets fail over to an archive holder,
+    they are never served an uncommitted guess.
+    """
+
+    def __init__(self):
+        self._entries: list[tuple[bytes, bytes]] = []  # index = height
+        self.rebuilds = 0  # reorg truncations observed
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def tip_height(self) -> int:
+        """Highest committed height; -1 when empty/unavailable."""
+        return len(self._entries) - 1
+
+    def header_at(self, height: int) -> bytes | None:
+        if 0 <= height < len(self._entries):
+            return self._entries[height][1]
+        if height == -1:
+            return GENESIS_FILTER_HEADER
+        return None
+
+    def hash_at(self, height: int) -> bytes | None:
+        """The BLOCK hash the commitment at ``height`` was built for."""
+        if 0 <= height < len(self._entries):
+            return self._entries[height][0]
+        return None
+
+    def range(self, start: int, count: int) -> list[bytes]:
+        """Headers for ``start .. start+count-1``; empty when any part of
+        the span is not committed (refusal, never a partial lie)."""
+        if start < 0 or count <= 0 or start + count > len(self._entries):
+            return []
+        return [h for _, h in self._entries[start : start + count]]
+
+    def sync(self, tip_height: int, hash_at, filter_at) -> list[int]:
+        """Advance (or repair) the chain against a source of truth;
+        returns the heights whose commitments are new or changed — the
+        push plane's notification list.
+
+        ``hash_at(h) -> bytes | None`` and ``filter_at(h) -> bytes |
+        None`` read the source's main branch.  The common case is O(1):
+        the stored tip hash still matches and only new heights extend.
+        A mismatch walks back to the fork point, truncates, and
+        re-extends (the reorg path).  ``filter_at`` returning None stops
+        the extension — the remaining span stays uncommitted."""
+        # Walk back over any suffix the source no longer agrees with.
+        top = len(self._entries) - 1
+        while top >= 0 and self._entries[top][0] != hash_at(top):
+            top -= 1
+        if top < len(self._entries) - 1:
+            del self._entries[top + 1 :]
+            self.rebuilds += 1
+        changed: list[int] = []
+        prev = (
+            self._entries[-1][1] if self._entries else GENESIS_FILTER_HEADER
+        )
+        for h in range(len(self._entries), tip_height + 1):
+            bhash = hash_at(h)
+            if bhash is None:
+                break
+            fbytes = filter_at(h)
+            if fbytes is None:
+                break  # pruned/spilled body: stay honestly short
+            prev = next_filter_header(filter_hash(fbytes), prev)
+            self._entries.append((bhash, prev))
+            changed.append(h)
+        return changed

@@ -519,6 +519,61 @@ def build_parser() -> argparse.ArgumentParser:
     _add_retarget(p)
 
     p = sub.add_parser(
+        "watch",
+        help="live wallet push plane (v14): subscribe for block events, "
+        "verify each against the filter-header commitment chain, and "
+        "print one JSON line per verified event; a peer caught lying is "
+        "demoted and the watch fails over to --fallback replicas at the "
+        "verified cursor; exit 4 when every peer is proven dishonest",
+    )
+    p.add_argument("account", help="account id to watch (utf-8 watch item)")
+    p.add_argument(
+        "--item",
+        action="append",
+        default=[],
+        help="extra watch item: another account id, or a txid as 64 hex "
+        "chars (repeatable)",
+    )
+    p.add_argument("--difficulty", type=int, default=16, help="chain selector")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9444)
+    p.add_argument(
+        "--fallback",
+        nargs="*",
+        default=[],
+        help="host:port replicas to fail over to when the primary dies "
+        "or is caught lying (also the cross-check sources)",
+    )
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        help="exit 0 after this many seconds (tests/harnesses); "
+        "default: watch until interrupted",
+    )
+    p.add_argument(
+        "--max-events",
+        type=int,
+        default=0,
+        help="exit 0 after this many verified events (0 = no cap)",
+    )
+    p.add_argument(
+        "--cross-check-every",
+        type=int,
+        default=32,
+        help="verify the committed tip against a fallback replica every "
+        "N events (0 = self-consistency checks only)",
+    )
+    p.add_argument(
+        "--max-session-failures",
+        type=int,
+        default=None,
+        help="give up (exit 1) after N consecutive dead sessions "
+        "(default: retry forever)",
+    )
+    _add_retarget(p)
+
+    p = sub.add_parser(
         "keygen", help="create an Ed25519 spending key (account = fingerprint)"
     )
     p.add_argument("--out", required=True, help="key file to write (0600)")
@@ -1626,6 +1681,93 @@ def cmd_headers(args) -> int:
     return 0 if report.valid else 4
 
 
+# -- watch ---------------------------------------------------------------
+
+
+def cmd_watch(args) -> int:
+    """Live wallet notifications: subscribe to a node or replica,
+    verify every pushed event against the filter-header commitment
+    chain (client.watch does the believing only after checking), and
+    print one JSON line per verified block — matched ones carry the
+    confirmed txids.  A peer caught lying is demoted and the watch
+    fails over to --fallback replicas at the last verified cursor, so
+    no confirmation is missed across the switch; when every peer is
+    proven dishonest the exit is loud (4), like a lying proof."""
+    from p1_tpu.node.client import CommitmentViolation, watch
+
+    rule = _retarget_rule(args)
+
+    def _addr(spec: str) -> tuple[str, int]:
+        host, _, port = spec.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
+    def _item(s: str):
+        # 64 hex chars = a raw txid; anything else is an account id.
+        if len(s) == 64:
+            try:
+                return bytes.fromhex(s)
+            except ValueError:
+                pass
+        return s
+
+    items = [args.account, *(_item(s) for s in args.item)]
+
+    async def _run() -> int:
+        gen = watch(
+            args.host,
+            args.port,
+            items,
+            args.difficulty,
+            retarget=rule,
+            fallback_peers=[_addr(s) for s in args.fallback],
+            cross_check_every=args.cross_check_every,
+            max_session_failures=args.max_session_failures,
+        )
+        n = 0
+        try:
+            async for ev in gen:
+                print(
+                    json.dumps(
+                        {
+                            "height": ev["height"],
+                            "block": ev["block_hash"].hex(),
+                            "filter_header": ev["filter_header"].hex(),
+                            "matched": ev["matched"],
+                            "txids": [t.hex() for t in ev["txids"]]
+                            if ev["matched"]
+                            else [],
+                            "peer": f"{ev['peer'][0]}:{ev['peer'][1]}",
+                        }
+                    ),
+                    flush=True,
+                )
+                n += 1
+                if args.max_events and n >= args.max_events:
+                    return 0
+        finally:
+            await gen.aclose()
+        return 0
+
+    try:
+        if args.deadline is not None:
+            try:
+                return asyncio.run(asyncio.wait_for(_run(), args.deadline))
+            except (asyncio.TimeoutError, TimeoutError):
+                return 0  # the deadline is a clean exit, like `p1 serve`
+        return asyncio.run(_run())
+    except CommitmentViolation as e:
+        print(f"watch failed: {e}", file=sys.stderr)
+        return 4
+    except (
+        ConnectionError,
+        OSError,
+        ValueError,
+        asyncio.IncompleteReadError,
+    ) as e:
+        print(f"watch failed: {e}", file=sys.stderr)
+        return 1
+
+
 # -- keygen --------------------------------------------------------------
 
 
@@ -2140,6 +2282,7 @@ def main(argv=None) -> int:
         "proof": cmd_proof,
         "fees": cmd_fees,
         "headers": cmd_headers,
+        "watch": cmd_watch,
         "balances": cmd_balances,
         "compact": cmd_compact,
         "fsck": cmd_fsck,

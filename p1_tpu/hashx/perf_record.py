@@ -255,3 +255,27 @@ RECORDED_REBASE_MS = 0.08
 #: 10x+ move says the dict-surgery cost model changed.
 SNAPSHOT_CADENCE_DEGRADED_FRACTION = 0.4
 REBASE_DEGRADED_FACTOR = 10.0
+
+#: Wallet push plane (round 21, node/subscriptions.py): the bench.py
+#: quick probe (benchmarks/wallet_plane.py ``bench_quick`` — 20k live
+#: subscriptions, 8 measured block connects; the 100k acceptance run
+#: is ``python benchmarks/wallet_plane.py --subs 100000`` and its row
+#: lives in docs/PERF.md "Wallet push plane").
+#: ``RECORDED_WALLET_SUBS`` is the live-subscription count the quick
+#: probe holds while measuring; ``RECORDED_NOTIFY_P95_MS`` is the p95
+#: per-block notify latency at that scale — decode the block's filter
+#: ONCE, probe every session's watch set against the decoded value
+#: set, share one pre-encoded frame across all non-matched sessions
+#: (the O(filter + subs·items) shape that makes 100k sessions per
+#: process feasible, vs the naive O(subs·filter-decode)).  Measured
+#: 2026-08-07 on the 1-vCPU bench host.  LOWER is better for the p95
+#: — ``bench.py`` emits
+#: ``notify_vs_recorded`` = measured / recorded, flagged degraded
+#: above the factor below.
+RECORDED_WALLET_SUBS = 20_000
+RECORDED_NOTIFY_P95_MS = 97.0
+
+#: Factor over the recorded notify p95 above which the measurement is
+#: flagged degraded (pure-Python hot loop on the shared box — wide
+#: band, same rationale as the sim figures).
+NOTIFY_DEGRADED_FACTOR = 3.0

@@ -81,6 +81,11 @@ MAX_JOINERS = 2
 #: real snapshots to boot from inside short schedules.
 SNAPSHOT_INTERVAL = 4
 
+#: Live wallet watchers a schedule may run concurrently (slots 0..N-1):
+#: enough to compose watch + crash + flood interactions, small enough
+#: to keep tier-1 sweep runtimes flat (mirrors MAX_JOINERS).
+MAX_WATCHERS = 2
+
 #: Test-only injectable bugs, each a known-broken recovery behavior the
 #: shrinker acceptance proof seeds deliberately (never reachable from
 #: production config — only the ``--inject-bug`` flag threads them):
@@ -93,7 +98,11 @@ SNAPSHOT_INTERVAL = 4
 #: - ``deaf-recover``: the recovered node comes back with an empty peer
 #:   list — the "recovered node rejoins nothing" bug class; when nobody
 #:   happens to dial it, the mesh converges without it.
-CHAOS_BUGS = ("relapse-disk", "deaf-recover")
+#: - ``mute-push``: a watcher's confirmations arrive stripped of their
+#:   match — the "push plane silently drops the one event the wallet
+#:   subscribed for" bug class; the zero-missed-confirmations invariant
+#:   must flag it at quiesce.
+CHAOS_BUGS = ("relapse-disk", "deaf-recover", "mute-push")
 
 
 # -- schedule generation ---------------------------------------------------
@@ -159,10 +168,23 @@ def generate_schedule(
     - ``online_prune`` / ``online_compact_crash`` — the round-20
       node-side maintenance commands: prune while serving, and a
       compaction whose off-loop planning dies mid-write (the node must
-      self-clean the tmp artifacts and keep serving).
+      self-clean the tmp artifacts and keep serving);
+    - ``watch_start`` / ``watch_stop`` — a live wallet watcher
+      (``client.watch`` over the sim transport, round 21) subscribes to
+      the payee account against one node with the whole mesh as
+      fallback, and later churns away.  Watchers still live at quiesce
+      owe the push-plane invariant: a gap-free, commitment-verified
+      stream to the converged tip with ZERO missed confirmations —
+      crashes of the serving node mid-push, floods, and partitions
+      included;
+    - ``sub_flood`` — a GreedyPeer hammering the subscription plane
+      (SUBSCRIBE churn plus unverifiable resume cursors): the
+      degradation ladder and admission tables must shed it without
+      harming honest watchers.
     """
     rng = random.Random((seed << 3) ^ 0xC4A05)
     joiners: set[int] = set()
+    watchers: set[int] = set()
     pruned_any = False
     rebased_any = False
     times = sorted(
@@ -201,6 +223,16 @@ def generate_schedule(
         if len(joiners) < MAX_JOINERS:
             ops.append(("snap_join", 1.0))
             ops.append(("snap_liar", 0.75))
+        # Wallet push plane (round 21): live watchers churn on and off
+        # mid-schedule, and the subscription port takes protocol-valid
+        # floods; a stopped slot may restart (the churn the soak's
+        # ``subs`` clusters run at week scale).
+        if len(watchers) < MAX_WATCHERS:
+            ops.append(("watch_start", 1.0))
+        if watchers:
+            ops.append(("watch_stop", 0.5))
+        if hostiles < 2:
+            ops.append(("sub_flood", 0.5))
         # Segmented-store plane (round 18).  ``seg_roll`` forces a live
         # node's active segment to seal mid-mesh; ``prune`` discards a
         # live node's deep body segments while it serves (at most one
@@ -302,6 +334,17 @@ def generate_schedule(
             ev["node"] = rng.randrange(n_nodes)
             ev["kind"] = rng.choice(("queries", "blocks"))
             hostiles += 1
+        elif op == "watch_start":
+            slot = min(s for s in range(MAX_WATCHERS) if s not in watchers)
+            ev["watcher"] = slot
+            ev["node"] = rng.randrange(n_nodes)
+            watchers.add(slot)
+        elif op == "watch_stop":
+            ev["watcher"] = rng.choice(sorted(watchers))
+            watchers.discard(ev["watcher"])
+        elif op == "sub_flood":
+            ev["node"] = rng.randrange(n_nodes)
+            hostiles += 1
         elif op == "seg_roll":
             ev["node"] = rng.randrange(n_nodes)
         elif op == "prune":
@@ -368,7 +411,8 @@ def generate_soak_schedule(
     same event vocabulary as ``generate_schedule``, but every
     disruptive fault is paired with its clearing event inside a bounded
     ``fault_window_vs`` envelope (crash→recover, partition→heal,
-    disk_fail→disk_heal, slow_link→restore_link, hostile/flood→calm).
+    disk_fail→disk_heal, slow_link→restore_link, hostile/flood→calm,
+    watch_start→watch_stop).
     A week-long open partition is the partition-heal scenario's
     question; the longevity question is whether a week of RECURRING
     fault/heal cycles, steady mining, and wallet traffic leaves any
@@ -409,6 +453,7 @@ def generate_soak_schedule(
                 "flood",
                 "snap_join",
                 "maintenance",
+                "subs",
             )
         )
         if kind == "crash":
@@ -537,6 +582,33 @@ def generate_soak_schedule(
                     {"at": end, "op": "online_compact_crash", "node": victim}
                 )
             maintained += 1
+        elif kind == "subs":
+            # Subscription churn (round 21): a wallet rides the push
+            # plane across the envelope, then unsubscribes.  Recurring
+            # subscribe/consume/drop cycles are the push plane's
+            # longevity question — does a week of watcher churn leave
+            # sessions, queue bytes, or registry entries behind for the
+            # quiesce gauges to see?
+            events.append(
+                {
+                    "at": at,
+                    "op": "watch_start",
+                    "node": rng.randrange(n_nodes),
+                    "watcher": c % MAX_WATCHERS,
+                }
+            )
+            # A block inside the envelope, so every churn cycle carries
+            # at least one real push before the watcher unsubscribes.
+            events.append(
+                {
+                    "at": round(at + (end - at) * 0.75, 3),
+                    "op": "mine",
+                    "node": rng.randrange(n_nodes),
+                }
+            )
+            events.append(
+                {"at": end, "op": "watch_stop", "watcher": c % MAX_WATCHERS}
+            )
         for _ in range(txs_per_cluster):
             events.append(
                 {
@@ -772,6 +844,106 @@ def run_chaos(
     return report
 
 
+class _Watcher:
+    """One live wallet on the push plane: ``client.watch`` driven over
+    the sim transport against a primary node with the whole founder
+    mesh as fallback, recording every VERIFIED event for the quiesce
+    invariants.  The watch itself is deterministic (no randomness, no
+    wall clock), so watchers ride the trace-digest contract like any
+    other actor.
+
+    ``floor`` is the strict-coverage floor: the height below the first
+    verified event, pushed UP whenever the stream re-anchors past a
+    hole (a reorg deeper than the rewind ring resets the TOFU anchor —
+    the wallet would rescan history below it, so the gap-free claim
+    restarts there).  ``resets`` counts those holes."""
+
+    def __init__(
+        self, net, serial, primary, fallbacks, item, difficulty, mute=False
+    ):
+        self.net = net
+        self.serial = serial
+        self.primary = primary
+        self.targets = [(primary, NODE_PORT)] + [
+            (h, NODE_PORT) for h in fallbacks if h != primary
+        ]
+        self.item = item
+        self.difficulty = difficulty
+        self.mute = mute
+        self.events: list[dict] = []
+        self.by_height: dict[int, dict] = {}  # height -> LAST event there
+        self.floor: int | None = None
+        self.resets = 0
+        self.error: str | None = None
+        self._last_h: int | None = None
+        self._task: asyncio.Task | None = None
+
+    @property
+    def live(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    @property
+    def tip_height(self) -> int:
+        """The watch's CURRENT verified position (not its max — after a
+        reorg rewind the re-pushed branch is where the stream stands)."""
+        return -1 if self._last_h is None else self._last_h
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _run(self) -> None:
+        from p1_tpu.node import client
+
+        transport = self.net.net.host(f"77.7.0.{self.serial}")
+        try:
+            # cross_check_every=0: the per-event commitment verification
+            # (header link, PoW, H-link) stays on; the periodic
+            # cross-replica audit is OFF because an honest mesh mid-fork
+            # genuinely disagrees — when the fork point predates this
+            # watch's ring, adjudication resolves conservatively by
+            # demoting the serving peer, and a week of partitions would
+            # slowly demote honest nodes.  The audit path is proven by
+            # the lying-replica suites (tests/test_subscriptions.py).
+            async for ev in client.watch(
+                self.targets[0][0],
+                NODE_PORT,
+                [self.item],
+                self.difficulty,
+                fallback_peers=self.targets[1:],
+                transport=transport,
+                cross_check_every=0,
+                reconnect_delay_s=0.5,
+                max_session_failures=None,
+            ):
+                h = ev["height"]
+                if self.floor is None:
+                    self.floor = h - 1
+                elif self._last_h is not None and h > self._last_h + 1:
+                    self.resets += 1
+                    self.floor = h - 1
+                self._last_h = h
+                if self.mute and ev["matched"]:
+                    # Injected bug (``mute-push``): the confirmation
+                    # arrives stripped of its match — exactly what the
+                    # zero-missed-confirmations invariant must catch.
+                    ev = {**ev, "matched": False, "txids": ()}
+                self.events.append(ev)
+                self.by_height[h] = ev
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — recorded, judged at quiesce
+            self.error = f"{type(e).__name__}: {e}"
+
+
 class _ChaosRunner:
     """One schedule's execution state (hosts, wallets, live actors)."""
 
@@ -801,6 +973,11 @@ class _ChaosRunner:
         self.wallet = Keypair.from_seed_text(f"p1-chaos-{net.seed}")
         self.payee = Keypair.from_seed_text(f"p1-chaos-{net.seed}-payee")
         self.actors: list = []  # hostile/greedy peers, stopped at epilogue
+        #: Live wallet watchers by schedule slot; churned-away ones move
+        #: to ``retired_watchers`` (still judged for honesty at quiesce).
+        self.watchers: dict[int, _Watcher] = {}
+        self.retired_watchers: list[_Watcher] = []
+        self.watch_serial = 0
         self.slowed: set[str] = set()
         self.partitioned = False
         self.rss_bound_mb = rss_bound_mb
@@ -809,7 +986,13 @@ class _ChaosRunner:
         #: the quiesce leak invariants compare the last two.
         self.probes: list[dict] = []
         self.recover_verdicts: list[int] = []
-        self.counts = {"applied": 0, "crashes": 0, "recoveries": 0, "txs": 0}
+        self.counts = {
+            "applied": 0,
+            "crashes": 0,
+            "recoveries": 0,
+            "txs": 0,
+            "watchers": 0,
+        }
 
     # -- helpers ----------------------------------------------------------
 
@@ -1165,7 +1348,58 @@ class _ChaosRunner:
             self._record("flood", victim, ev["kind"])
             await gp.start(victim, NODE_PORT)
             self.actors.append(gp)
+        elif op == "watch_start":
+            await self._watch_start(ev)
+        elif op == "watch_stop":
+            w = self.watchers.pop(ev["watcher"], None)
+            if w is not None:
+                self._record("watch_stop", ev["watcher"], len(w.events))
+                await w.stop()
+                self.retired_watchers.append(w)
+        elif op == "sub_flood":
+            from p1_tpu.node.testing import FloodPlan, GreedyPeer, make_blocks
+
+            victim = self._alive(ev["node"])
+            if victim is None:
+                return
+            src = f"66.6.2.{len(self.actors)}"
+            gp = GreedyPeer(
+                make_blocks(2, self.difficulty),
+                plan=FloodPlan(subscribe=True, burst=4, pause_s=0.25),
+                transport=net.net.host(src),
+                rng=random.Random(net.seed * 109 + len(self.actors)),
+            )
+            self._record("sub_flood", victim)
+            await gp.start(victim, NODE_PORT)
+            self.actors.append(gp)
         self.counts["applied"] += 1
+
+    async def _watch_start(self, ev: dict) -> None:
+        """Spawn one live watcher (op ``watch_start``) on the payee
+        account — the wallet whose confirmations the quiesce invariant
+        must prove were never missed.  Idempotent per slot (subsets of
+        a schedule stay runnable); a slot freed by ``watch_stop`` may
+        restart with a fresh watcher — the churn."""
+        slot = ev["watcher"]
+        if slot in self.watchers:
+            return
+        primary = self._alive(ev["node"])
+        if primary is None:
+            return
+        w = _Watcher(
+            self.net,
+            serial=self.watch_serial,
+            primary=primary,
+            fallbacks=self.hosts[: self.n],
+            item=self.payee.account,
+            difficulty=self.difficulty,
+            mute=self.inject_bug == "mute-push",
+        )
+        self.watch_serial += 1
+        self.counts["watchers"] += 1
+        self.watchers[slot] = w
+        self._record("watch_start", primary, slot)
+        await w.start()
 
     async def _snap_join(self, ev: dict, fault: str | None = None) -> None:
         """Spawn one snapshot-syncing joiner (op ``snap_join``), or one
@@ -1341,6 +1575,24 @@ class _ChaosRunner:
             wall_limit_s=self.wall_limit_s,
         )
         settle_vs = net.clock.now - faults_cleared_at
+        # Push-plane quiesce: the settle block above was pushed to every
+        # live subscription — give every surviving watcher the window to
+        # verify its way (failovers and gap replays included) to the
+        # converged tip before judging its stream.
+        if converged and self.watchers:
+            tip_h = max(net.heights())
+            await net.run_until(
+                lambda: all(
+                    # Zero events = the watch TOFU-anchored AT the
+                    # converged tip (a late start racing the settle
+                    # block): caught up by definition.
+                    not w.live or w.tip_height < 0 or w.tip_height >= tip_h
+                    for w in self.watchers.values()
+                ),
+                self.settle_vs / 2,
+                step=0.25,
+                wall_limit_s=self.wall_limit_s,
+            )
 
         # -- the invariant suite, at quiesce -------------------------------
         if not converged:
@@ -1389,7 +1641,11 @@ class _ChaosRunner:
         violations.extend(self._check_pools())
         violations.extend(self._check_caches())
         violations.extend(self._check_assumed_samples())
+        violations.extend(self._check_watchers(converged))
         violations.extend(self._check_leaks())
+        all_watchers = self.retired_watchers + list(self.watchers.values())
+        for w in self.watchers.values():
+            await w.stop()
 
         from p1_tpu.node.telemetry import propagation_summary_ms
 
@@ -1410,6 +1666,8 @@ class _ChaosRunner:
                 "end": self.probes[-1] if self.probes else None,
             },
             "settle_virtual_s": round(settle_vs, 3),
+            "watch_events": sum(len(w.events) for w in all_watchers),
+            "watch_resets": sum(w.resets for w in all_watchers),
             "heights": {"min": min(heights), "max": max(heights)},
             "reorgs_total": sum(
                 n.metrics.reorgs for n in net.nodes.values()
@@ -1460,6 +1718,8 @@ class _ChaosRunner:
                 "tried_addrs": len(node._tried_addrs),
                 "mempool": len(node.mempool),
                 "sig_cache": len(node.sig_cache),
+                "subs_live": node.subscriptions.snapshot()["live"],
+                "subs_queue_bytes": node.subscriptions.queue_depth_bytes,
                 "gauge_bytes": node._memory_gauge(),
                 # Supervision/store retry counters: monotone by design —
                 # the leak check bounds their second-half GROWTH, not
@@ -1612,6 +1872,140 @@ class _ChaosRunner:
                         }
                     )
                 break
+        return out
+
+    def _check_watchers(self, converged: bool) -> list[dict]:
+        """The push-plane invariants at quiesce (round 21).
+
+        Every watcher STILL LIVE at the horizon owes the tentpole
+        claim: its verified stream is gap-free from its coverage floor
+        to the converged tip, byte-agrees with the converged chain
+        (block hash AND filter-header commitment per height), and holds
+        a matched event carrying the paying txids for EVERY height the
+        watched wallet was paid — zero missed confirmations, whatever
+        the schedule did to the serving nodes.  Churned-away watchers
+        are judged for honesty only: the mesh tells no lies, so a
+        watch that ended in a CommitmentViolation demoted an honest
+        node — itself a bug.  And no node may hold more live
+        subscription entries than there are live watchers: a dead
+        session whose registry entry survived is a leak."""
+        out: list[dict] = []
+        all_watchers = self.retired_watchers + list(self.watchers.values())
+        for w in all_watchers:
+            if w.error is not None and "CommitmentViolation" in w.error:
+                out.append(
+                    {
+                        "invariant": "push-honest",
+                        "detail": f"watcher {w.serial} convicted an honest "
+                        f"mesh of lying: {w.error}",
+                    }
+                )
+        if not converged or not self.watchers:
+            return out
+        live_watchers = sum(1 for w in self.watchers.values() if w.live)
+        subs_live = sum(
+            n.subscriptions.snapshot()["live"]
+            for n in self.net.nodes.values()
+        )
+        if subs_live > live_watchers:
+            out.append(
+                {
+                    "invariant": "push-leak",
+                    "detail": f"{subs_live} live subscription entries for "
+                    f"{live_watchers} live watchers at quiesce — dead "
+                    "sessions left registry entries behind",
+                }
+            )
+        # The converged truth, from an archive-grade node (full blocks
+        # from genesis); the generators cap pruning/re-basing at one
+        # host per schedule, so one nearly always exists — without one
+        # the deep replay below has no ground truth and is skipped.
+        ref = next(
+            (
+                n
+                for n in self.net.nodes.values()
+                if n.chain.base_height == 0 and not n.chain.prune_floor
+            ),
+            None,
+        )
+        if ref is None:
+            return out
+        chain = ref.chain
+        tip_h = chain.height
+        account = self.payee.account
+        paid: dict[int, set[bytes]] = {}
+        for h in range(1, tip_h + 1):
+            blk = chain._block_at(chain.main_hash_at(h))
+            ids = {
+                tx.txid()
+                for tx in blk.txs
+                if account in (tx.sender, tx.recipient)
+            }
+            if ids:
+                paid[h] = ids
+        for slot, w in sorted(self.watchers.items()):
+            if not w.live:
+                out.append(
+                    {
+                        "invariant": "push-live",
+                        "detail": f"watcher {slot} died mid-watch: {w.error}",
+                    }
+                )
+                continue
+            if w.tip_height < 0:
+                # Zero events: the watch TOFU-anchored at the converged
+                # tip (a late start racing the settle block), so there
+                # was nothing to push and nothing to judge — its floor
+                # is unset, which also skips the per-height checks.
+                continue
+            if w.tip_height < tip_h:
+                out.append(
+                    {
+                        "invariant": "push-lag",
+                        "detail": f"watcher {slot} stuck at height "
+                        f"{w.tip_height} with the mesh converged at {tip_h}",
+                    }
+                )
+                continue
+            lo = w.floor if w.floor is not None else tip_h
+            for h in range(lo + 1, tip_h + 1):
+                ev = w.by_height.get(h)
+                if ev is None:
+                    out.append(
+                        {
+                            "invariant": "push-gap",
+                            "detail": f"watcher {slot} has no event for "
+                            f"height {h} inside its verified window",
+                        }
+                    )
+                elif ev["block_hash"] != chain.main_hash_at(h):
+                    out.append(
+                        {
+                            "invariant": "push-chain",
+                            "detail": f"watcher {slot}'s last event at "
+                            f"height {h} is not the converged block",
+                        }
+                    )
+                elif ev["filter_header"] != chain.filter_headers.header_at(h):
+                    out.append(
+                        {
+                            "invariant": "push-commit",
+                            "detail": f"watcher {slot}'s filter header at "
+                            f"height {h} contradicts the converged "
+                            "commitment chain",
+                        }
+                    )
+                elif h in paid and (
+                    not ev["matched"] or not paid[h] <= set(ev["txids"])
+                ):
+                    out.append(
+                        {
+                            "invariant": "push-missed",
+                            "detail": f"watcher {slot} missed the wallet's "
+                            f"confirmation at height {h} "
+                            f"(matched={ev['matched']})",
+                        }
+                    )
         return out
 
     def _check_pools(self) -> list[dict]:

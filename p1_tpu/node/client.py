@@ -27,6 +27,7 @@ async def _session(
     difficulty: int,
     retarget=None,
     handshake_timeout: float | None = None,
+    transport=None,
 ):
     """Connect + HELLO-validate against the chain selected by
     ``difficulty`` (+ optional ``RetargetRule`` — part of chain identity);
@@ -38,10 +39,18 @@ async def _session(
     process behind a live listen backlog) must cost a supervised caller
     one stall, not its entire overall timeout.  None keeps the caller's
     outer ``wait_for`` as the only bound (the one-shot clients, whose
-    whole round is already a single short timeout)."""
+    whole round is already a single short timeout).
+
+    ``transport`` is the network seam (node/transport.py): None dials
+    real sockets; a simulator handle runs the SAME client code over
+    in-memory links under the virtual clock — how the chaos plane puts
+    verifying wallets inside its deterministic storms."""
 
     async def _connect():
-        reader, writer = await asyncio.open_connection(host, port)
+        if transport is None:
+            reader, writer = await asyncio.open_connection(host, port)
+        else:
+            reader, writer = await transport.connect(host, port)
         try:
             genesis_hash = make_genesis(difficulty, retarget).block_hash()
             await protocol.write_frame(
@@ -467,6 +476,149 @@ async def get_filters(
     return await asyncio.wait_for(_run(), timeout)
 
 
+class CommitmentViolation(ValueError):
+    """A peer's served filter stream contradicts the filter-header
+    commitment chain — the one client error that means "this peer is
+    lying", not "this peer is slow".  Callers demote the peer (never
+    retry it) and fail over; `p1 watch` maps it to exit code 4, the
+    same verdict `p1 headers` gives a fake header chain."""
+
+
+async def _fheaders_range(reader, writer, start: int, count: int, page: int = 1000):
+    """Fetch ``count`` filter headers ascending from height ``start``
+    over an open session.  Stops early (returns fewer) when the peer's
+    committed span ends — FILTERHEADERS is all-or-nothing per request,
+    so a short reply is an honest refusal, not a partial answer."""
+    out: list[bytes] = []
+    h = start
+    while len(out) < count:
+        await protocol.write_frame(
+            writer,
+            protocol.encode_getfilterheaders(h, min(page, count - len(out))),
+        )
+        while True:
+            mtype, body = await _read_msg(reader, writer)
+            if mtype is MsgType.FILTERHEADERS:
+                got_start, headers = body
+                break
+        if not headers:
+            return out
+        if got_start != h:
+            raise ValueError("FILTERHEADERS reply for a different start height")
+        out.extend(headers)
+        h += len(headers)
+    return out
+
+
+async def get_filter_headers(
+    host: str,
+    port: int,
+    start_height: int,
+    count: int,
+    difficulty: int,
+    timeout: float = 30.0,
+    retarget=None,
+    transport=None,
+) -> list[bytes]:
+    """Fetch the filter-header commitment chain for a height range:
+    32-byte headers ascending from ``start_height``, where
+    ``header[i] = H(filter_hash[i] || header[i-1])`` anchored at the
+    all-zero genesis filter header (chain/filters.py).  Every honest
+    replica derives the identical chain from block bytes alone, so two
+    peers disagreeing on any height is PROOF at least one is lying —
+    the cross-check `filter_scan` and `watch` build their failover on.
+    A shorter-than-asked reply means the peer's committed span ends
+    there (pruned or still syncing) — honest refusal, not an error."""
+
+    async def _run():
+        async with _session(
+            host, port, difficulty, retarget, transport=transport
+        ) as (reader, writer, _):
+            return await _fheaders_range(reader, writer, start_height, count)
+
+    return await asyncio.wait_for(_run(), timeout)
+
+
+async def _pinned_filter_hash(
+    host, port, difficulty, retarget, transport, prev_hash, want_hash
+):
+    """The TRUE filter hash at one height: fetch the block pinned by
+    ``want_hash`` (requested by locator ``prev_hash``), verify the pin
+    and its merkle commitment, and compute the filter locally.  Block
+    bytes that hash to the pinned header ARE the truth — whichever
+    peer serves them cannot influence the result."""
+    from p1_tpu.chain.filters import block_filter, filter_hash
+
+    async with _session(
+        host, port, difficulty, retarget, transport=transport
+    ) as (reader, writer, _):
+        await protocol.write_frame(writer, protocol.encode_getblocks([prev_hash]))
+        while True:
+            mtype, body = await _read_msg(reader, writer)
+            if mtype is MsgType.BLOCKS:
+                break
+        if not body or body[0].block_hash() != want_hash:
+            raise ValueError("peer did not serve the hash-pinned block")
+        if not body[0].merkle_ok():
+            raise ValueError("pinned block fails its merkle commitment")
+        return filter_hash(block_filter(body[0]))
+
+
+async def _adjudicate(
+    mine: list[bytes],
+    other,
+    hashes: list[bytes],
+    upto: int,
+    difficulty: int,
+    retarget,
+    transport,
+) -> str:
+    """Two peers disagree on the filter-header chain at height ``upto``
+    — name the liar.  ``mine`` is the serving peer's full committed
+    chain [0..upto]; ``other`` is (host, port) of the disagreeing peer;
+    ``hashes`` is the hash-pinned header skeleton.  Finds the first
+    diverging height d (everything below is agreed, and the genesis
+    anchor is agreed by construction), fetches the hash-pinned block at
+    d, computes the true filter hash locally, and checks which side's
+    header[d] extends the agreed prefix with the truth.  Returns
+    "self" (serving peer lies), "other" (cross-check peer lies), or
+    "both" (neither side committed the true filter)."""
+    from p1_tpu.chain.filters import (
+        GENESIS_FILTER_HEADER,
+        block_filter,
+        filter_hash,
+        next_filter_header,
+    )
+
+    theirs = await get_filter_headers(
+        *other, 0, upto + 1, difficulty, retarget=retarget, transport=transport
+    )
+    if len(mine) != upto + 1 or len(theirs) != upto + 1:
+        raise ValueError("commitment span vanished during adjudication")
+    d = next(i for i in range(upto + 1) if mine[i] != theirs[i])
+    prev = GENESIS_FILTER_HEADER if d == 0 else mine[d - 1]
+    if d == 0:
+        # Genesis is local knowledge — no fetch needed.
+        fhash_true = filter_hash(block_filter(make_genesis(difficulty, retarget)))
+    else:
+        try:
+            fhash_true = await _pinned_filter_hash(
+                *other, difficulty, retarget, transport, hashes[d - 1], hashes[d]
+            )
+        except (ConnectionError, OSError, ValueError, asyncio.IncompleteReadError):
+            # The cross-check peer won't serve the block; without it the
+            # dispute cannot be settled from this side alone.
+            raise ValueError(
+                "adjudication peer refused the hash-pinned block"
+            ) from None
+    truth = next_filter_header(fhash_true, prev)
+    if truth == mine[d]:
+        return "other"
+    if truth == theirs[d]:
+        return "self"
+    return "both"
+
+
 async def get_snapshot(
     host: str,
     port: int,
@@ -542,6 +694,9 @@ async def filter_scan(
     fetch_blocks: bool = True,
     start_height: int = 1,
     page: int = 500,
+    fallback_peers=(),
+    verify_commitment: bool = True,
+    transport=None,
 ):
     """Light-client sync by filter match (the round-9 serving plane's
     wallet flow): ONE session that
@@ -569,21 +724,43 @@ async def filter_scan(
     pinned to it by hash, and fetched blocks are checked against their
     header's merkle commitment here, so a lying peer can omit service
     but cannot substitute content.
+
+    Commitment verification (``verify_commitment``, v14): every served
+    filter is checked against the peer's own filter-header chain
+    (``header[i] = H(filter_hash[i] || header[i-1])``, genesis-anchored
+    so the whole prefix is verified from local knowledge when the scan
+    starts at height 1).  A peer whose filters contradict its own
+    commitments raises ``CommitmentViolation`` immediately.  With
+    ``fallback_peers``, the committed tip is also cross-checked against
+    an independent replica; a disagreement is adjudicated by fetching
+    the hash-pinned block at the first diverging height and computing
+    the true filter locally — the proven liar is DEMOTED (never asked
+    again this call) and the scan fails over to the next peer, so a
+    wallet behind one dishonest replica still gets every confirmation.
     """
     from p1_tpu.chain.chain import locator_hashes
-    from p1_tpu.chain.filters import matches_any
+    from p1_tpu.chain.filters import (
+        GENESIS_FILTER_HEADER,
+        block_filter,
+        filter_hash,
+        matches_any,
+        next_filter_header,
+    )
 
     items = [
         it.encode("utf-8") if isinstance(it, str) else bytes(it)
         for it in watch_items
     ]
+    demoted: set = set()
 
-    async def _run():
+    async def _scan_one(t_host, t_port, cross_peers):
         genesis = make_genesis(difficulty, retarget)
         headers = [genesis.header]
         hashes = [genesis.block_hash()]
         pos = {hashes[0]: 0}
-        async with _session(host, port, difficulty, retarget) as (
+        async with _session(
+            t_host, t_port, difficulty, retarget, transport=transport
+        ) as (
             reader,
             writer,
             _,
@@ -623,9 +800,13 @@ async def filter_scan(
                     hashes.append(h.block_hash())
                     pos[hashes[-1]] = len(hashes) - 1
 
-            # 2. filter stream + local match.
+            # 2. filter stream + local match, recording each accepted
+            # filter's hash so step 2b can replay the commitment chain.
             matched: list[tuple[int, bytes]] = []
-            h = max(1, start_height)
+            scan_lo = max(1, start_height)
+            fhashes: dict[int, bytes] = {}
+            verified_to = scan_lo - 1
+            h = scan_lo
             while h < len(hashes):
                 await protocol.write_frame(
                     writer,
@@ -636,19 +817,107 @@ async def filter_scan(
                 start, entries = await _reply(MsgType.FILTERS)
                 if not entries:
                     break
+                stop = False
                 for i, (bhash, fbytes) in enumerate(entries):
                     height = start + i
                     if height >= len(hashes):
-                        break  # peer's chain ran ahead of our skeleton
+                        stop = True  # peer's chain ran ahead of our skeleton
+                        break
                     if bhash != hashes[height]:
                         # The peer reorged between the header sync and
                         # this page; the stale tail's filters are for
                         # blocks we did not pin — stop at the divergence
                         # (a fuller client would re-sync headers).
+                        stop = True
                         break
                     if items and matches_any(fbytes, bhash, items):
                         matched.append((height, bhash))
+                    fhashes[height] = filter_hash(fbytes)
+                    verified_to = height
+                if stop:
+                    break
                 h = start + len(entries)
+
+            # 2b. replay the peer's filter-header commitment chain over
+            # the filters it just served.  Starting at height 1 the
+            # anchor is the all-zero genesis header — fully verified
+            # from local knowledge; a deeper start trusts the anchor
+            # unless a fallback corroborates the tip below.
+            if verify_commitment and verified_to >= scan_lo:
+                served = await _fheaders_range(
+                    reader, writer, scan_lo - 1, verified_to - scan_lo + 2
+                )
+                if len(served) == verified_to - scan_lo + 2:
+                    prev = served[0]
+                    if scan_lo == 1:
+                        want_anchor = next_filter_header(
+                            filter_hash(block_filter(genesis)),
+                            GENESIS_FILTER_HEADER,
+                        )
+                        if prev != want_anchor:
+                            raise CommitmentViolation(
+                                f"{t_host}:{t_port} commits a wrong "
+                                "genesis filter header"
+                            )
+                    for off, height in enumerate(
+                        range(scan_lo, verified_to + 1)
+                    ):
+                        expect = next_filter_header(fhashes[height], prev)
+                        if served[off + 1] != expect:
+                            raise CommitmentViolation(
+                                f"{t_host}:{t_port} served a filter at "
+                                f"height {height} that contradicts its "
+                                "own commitment chain"
+                            )
+                        prev = expect
+                    # Cross-check the committed tip against independent
+                    # replicas: honest peers derive the identical chain,
+                    # so any disagreement has exactly one explanation —
+                    # somebody forged a filter — and the hash-pinned
+                    # block at the divergence names them.
+                    for peer in list(cross_peers):
+                        if peer in demoted:
+                            continue
+                        try:
+                            theirs = await get_filter_headers(
+                                *peer,
+                                verified_to,
+                                1,
+                                difficulty,
+                                retarget=retarget,
+                                transport=transport,
+                            )
+                        except (
+                            ConnectionError,
+                            OSError,
+                            ValueError,
+                            asyncio.IncompleteReadError,
+                            asyncio.TimeoutError,
+                            TimeoutError,
+                        ):
+                            continue  # unreachable/short peer ≠ evidence
+                        if not theirs or theirs[0] == prev:
+                            continue
+                        mine = await _fheaders_range(
+                            reader, writer, 0, verified_to + 1
+                        )
+                        verdict = await _adjudicate(
+                            mine,
+                            peer,
+                            hashes,
+                            verified_to,
+                            difficulty,
+                            retarget,
+                            transport,
+                        )
+                        if verdict in ("other", "both"):
+                            demoted.add(peer)
+                        if verdict in ("self", "both"):
+                            raise CommitmentViolation(
+                                f"{t_host}:{t_port} serves forged filters "
+                                f"(proven at cross-check vs "
+                                f"{peer[0]}:{peer[1]})"
+                            )
 
             if not fetch_blocks:
                 return headers, matched
@@ -681,4 +950,318 @@ async def filter_scan(
                     out.append((height, block))
             return headers, out
 
+    async def _run():
+        targets = [(host, port), *(tuple(p) for p in fallback_peers)]
+        last_exc: CommitmentViolation | None = None
+        for i, (t_host, t_port) in enumerate(targets):
+            if (t_host, t_port) in demoted:
+                continue
+            others = [t for j, t in enumerate(targets) if j != i]
+            try:
+                return await _scan_one(t_host, t_port, others)
+            except CommitmentViolation as e:
+                # A proven liar: demote (never re-ask) and fail over to
+                # the next replica with the same watch list.
+                demoted.add((t_host, t_port))
+                last_exc = e
+        raise last_exc if last_exc is not None else CommitmentViolation(
+            "all peers demoted"
+        )
+
     return await asyncio.wait_for(_run(), timeout)
+
+
+async def watch(
+    host: str,
+    port: int,
+    watch_items,
+    difficulty: int,
+    *,
+    retarget=None,
+    cursor: tuple[int, bytes] | None = None,
+    fallback_peers=(),
+    transport=None,
+    handshake_timeout: float = 10.0,
+    cross_check_every: int = 32,
+    rewind_ring: int = 1024,
+    reconnect_delay_s: float = 0.25,
+    max_session_failures: int | None = None,
+):
+    """Live wallet push plane (v14): SUBSCRIBE to a node or replica and
+    yield one verified dict per connected block —
+
+        {"height", "block_hash", "filter_header", "matched",
+         "txids", "peer"}
+
+    ``matched`` is re-derived LOCALLY from the pushed filter (the
+    server's claim is only a hint, as is ``txids`` — a wallet confirms
+    a payment by fetching the block or an SPV proof, both hash-pinned).
+
+    Verify-before-believe, per event: the raw header must link to the
+    previous verified block and carry the chain's proof of work, and the
+    pushed filter must extend the filter-header commitment chain from
+    the last verified cursor (``H(filter_hash || prev)``).  Any
+    contradiction raises/handles ``CommitmentViolation``: the peer is
+    DEMOTED and the watch fails over to the next of ``fallback_peers``,
+    re-subscribing at the last verified cursor so the new replica
+    replays exactly the missed window — zero missed confirmations
+    across a lying or dying replica.
+
+    Degradation handling: a coalesce hole (skipped heights) or an
+    explicit gap notice triggers a cursor re-subscribe on the same
+    session — the server replays the hole as full events.  A server
+    that keeps shedding rotates like a dead one.  Reorgs rewind through
+    a ring of the last ``rewind_ring`` verified blocks; deeper reorgs
+    reset the anchor (the wallet should rescan history — see below).
+
+    Trust scope: with a ``cursor`` (the last (height, filter_header)
+    the CALLER verified, e.g. from a prior ``filter_scan``), everything
+    yielded is anchored to that knowledge.  Without one, the anchor is
+    trust-on-first-use at the serving peer's committed tip and the
+    watch verifies FORWARD from there — historical verification is
+    ``filter_scan``'s job.  ``cross_check_every`` events, the committed
+    tip is compared against an independent fallback; disagreement is
+    adjudicated via the hash-pinned block at the first divergence when
+    the ring still covers it, else resolved conservatively by failing
+    over.  ``max_session_failures`` bounds consecutive dead sessions
+    (None = retry forever; daemons bound the watch by deadline/cancel
+    instead)."""
+    from p1_tpu.chain.filters import (
+        filter_hash,
+        matches_any,
+        next_filter_header,
+    )
+    from p1_tpu.core.header import BlockHeader, meets_target
+
+    items = [
+        it.encode("utf-8") if isinstance(it, str) else bytes(it)
+        for it in watch_items
+    ]
+    if not items:
+        raise ValueError("watch needs at least one watch item")
+
+    targets = [(host, port), *(tuple(p) for p in fallback_peers)]
+    demoted: set = set()
+    anchor = (int(cursor[0]), bytes(cursor[1])) if cursor is not None else None
+    anchor_bhash: bytes | None = None
+    ring: dict[int, tuple[bytes, bytes]] = {}  # height -> (bhash, fheader)
+    ti = 0
+    failures = 0
+    events_seen = 0
+    last_violation: CommitmentViolation | None = None
+    net_errors = (
+        ConnectionError,
+        OSError,
+        asyncio.IncompleteReadError,
+        asyncio.TimeoutError,
+        TimeoutError,
+    )
+
+    async def _cross_check(serving, height, fheader):
+        """Compare our verified committed tip against one independent
+        replica; on disagreement, adjudicate and demote the proven
+        liar.  Raises CommitmentViolation when the SERVING peer loses
+        (or when the divergence predates what this watch verified —
+        conservative: fail over rather than keep riding a suspect)."""
+        for peer in targets:
+            if peer == serving or peer in demoted:
+                continue
+            try:
+                theirs = await get_filter_headers(
+                    *peer, height, 1, difficulty,
+                    retarget=retarget, transport=transport,
+                )
+            except net_errors + (ValueError,):
+                continue  # unreachable/short peer is not evidence
+            if not theirs:
+                continue
+            if theirs[0] == fheader:
+                return  # corroborated
+            try:
+                mine_chain = await get_filter_headers(
+                    *serving, 0, height + 1, difficulty,
+                    retarget=retarget, transport=transport,
+                )
+            except net_errors + (ValueError,):
+                return
+            if len(mine_chain) != height + 1:
+                return
+            cover = {hh: ring[hh][0] for hh in ring}
+            try:
+                verdict = await _adjudicate(
+                    mine_chain, peer, cover, height,
+                    difficulty, retarget, transport,
+                )
+            except KeyError:
+                # First divergence below the ring: cannot fetch the
+                # pinned block to prove who lies — prefer failover.
+                verdict = "self"
+            except net_errors + (ValueError,):
+                continue
+            if verdict in ("other", "both"):
+                demoted.add(peer)
+            if verdict in ("self", "both"):
+                raise CommitmentViolation(
+                    f"{serving[0]}:{serving[1]} filter-header chain "
+                    f"disproven against {peer[0]}:{peer[1]}"
+                )
+            return
+
+    while True:
+        live = [t for t in targets if t not in demoted]
+        if not live:
+            if last_violation is not None:
+                raise last_violation
+            raise ConnectionError("all watch peers demoted")
+        serving = live[ti % len(live)]
+        got_event = False
+        try:
+            async with _session(
+                *serving,
+                difficulty,
+                retarget,
+                handshake_timeout=handshake_timeout,
+                transport=transport,
+            ) as (reader, writer, hello):
+                if anchor is None:
+                    # TOFU anchor at the peer's committed tip — walk
+                    # back from its claimed height to the end of the
+                    # committed span (replica refresh lag is ~0..1).
+                    h = hello.tip_height
+                    while h >= 0 and anchor is None:
+                        got = await _fheaders_range(reader, writer, h, 1)
+                        if got:
+                            anchor = (h, got[0])
+                        else:
+                            h -= 1
+                    if anchor is None:
+                        raise ConnectionError(
+                            "peer commits no filter headers yet"
+                        )
+                await protocol.write_frame(
+                    writer, protocol.encode_subscribe(items, anchor)
+                )
+                bridge_rounds = 0
+                while True:
+                    mtype, ev = await _read_msg(reader, writer)
+                    if mtype is not MsgType.EVENT:
+                        continue
+                    if isinstance(ev, protocol.GapEvent):
+                        # Drop-to-cursor notice: re-subscribe at our
+                        # verified anchor; the server replays the hole
+                        # as full events (no separate bridge protocol).
+                        bridge_rounds += 1
+                        if bridge_rounds > 8:
+                            raise ConnectionError(
+                                "peer keeps shedding this session"
+                            )
+                        await protocol.write_frame(
+                            writer, protocol.encode_subscribe(items, anchor)
+                        )
+                        continue
+                    header = BlockHeader.deserialize(ev.raw_header)
+                    bhash = header.block_hash()
+                    hv = ev.height
+                    if hv <= anchor[0]:
+                        # Reorg: the server walked back.  Rewind to the
+                        # fork point through the verified ring.
+                        ent = ring.get(hv - 1)
+                        if ent is None:
+                            anchor = None
+                            anchor_bhash = None
+                            ring.clear()
+                            raise ConnectionError(
+                                "reorg deeper than the rewind ring"
+                            )
+                        for k in [k for k in ring if k >= hv]:
+                            del ring[k]
+                        anchor = (hv - 1, ent[1])
+                        anchor_bhash = ent[0]
+                    if hv != anchor[0] + 1:
+                        # Coalesce hole: replay it via cursor
+                        # re-subscribe (replaces this session's sub).
+                        bridge_rounds += 1
+                        if bridge_rounds > 8:
+                            raise ConnectionError(
+                                "peer cannot replay the hole"
+                            )
+                        await protocol.write_frame(
+                            writer, protocol.encode_subscribe(items, anchor)
+                        )
+                        continue
+                    # Verify before believing.
+                    if (
+                        anchor_bhash is not None
+                        and header.prev_hash != anchor_bhash
+                    ):
+                        raise CommitmentViolation(
+                            f"{serving[0]}:{serving[1]} pushed a header "
+                            "that does not link to the verified chain"
+                        )
+                    if not meets_target(bhash, header.difficulty) or (
+                        retarget is None and header.difficulty != difficulty
+                    ):
+                        raise CommitmentViolation(
+                            f"{serving[0]}:{serving[1]} pushed a header "
+                            "without the chain's proof of work"
+                        )
+                    expect_fh = next_filter_header(
+                        filter_hash(ev.filter), anchor[1]
+                    )
+                    if expect_fh != ev.filter_header:
+                        raise CommitmentViolation(
+                            f"{serving[0]}:{serving[1]} pushed a filter "
+                            "that contradicts the commitment chain at "
+                            f"height {hv}"
+                        )
+                    local_matched = matches_any(ev.filter, bhash, items)
+                    ring[hv] = (bhash, expect_fh)
+                    if len(ring) > rewind_ring:
+                        del ring[min(ring)]
+                    anchor = (hv, expect_fh)
+                    anchor_bhash = bhash
+                    bridge_rounds = 0
+                    got_event = True
+                    failures = 0
+                    events_seen += 1
+                    if (
+                        cross_check_every
+                        and len(live) > 1
+                        and events_seen % cross_check_every == 0
+                    ):
+                        await _cross_check(serving, hv, expect_fh)
+                    yield {
+                        "height": hv,
+                        "block_hash": bhash,
+                        "filter_header": expect_fh,
+                        "matched": local_matched,
+                        "txids": tuple(ev.txids),
+                        "peer": serving,
+                    }
+        except CommitmentViolation as e:
+            # Proven liar: never ask again, fail over at the verified
+            # cursor — the next replica replays the missed window.
+            demoted.add(serving)
+            last_violation = e
+            ti = 0
+        except net_errors:
+            # Dead/stalled/refusing session — not evidence of lying.
+            # A session that dies before ANY event may mean the cursor
+            # was refused (our anchor reorged away, or sits past a
+            # pruned window): after repeated refusals, rewind the
+            # anchor one verified ring step and try again.
+            if not got_event:
+                failures += 1
+                if (
+                    max_session_failures is not None
+                    and failures >= max_session_failures
+                ):
+                    raise
+                if failures >= 2 and anchor is not None:
+                    lower = [k for k in ring if k < anchor[0]]
+                    if lower:
+                        k = max(lower)
+                        anchor = (k, ring[k][1])
+                        anchor_bhash = ring[k][0]
+            ti += 1
+            await asyncio.sleep(reconnect_delay_s)

@@ -177,6 +177,12 @@ _MSG_CLASS = {
     MsgType.GETSNAPSHOT: CLASS_QUERIES,
     MsgType.GETMETRICS: CLASS_QUERIES,
     MsgType.GETMAINTAIN: CLASS_QUERIES,
+    # The wallet push plane (v14): registering/cancelling a watch and
+    # asking for the filter-header commitment chain are requests that
+    # make us serve — charged like every other GET*.
+    MsgType.SUBSCRIBE: CLASS_QUERIES,
+    MsgType.UNSUBSCRIBE: CLASS_QUERIES,
+    MsgType.GETFILTERHEADERS: CLASS_QUERIES,
 }
 
 #: The OTHER half of the admission contract, spelled out: frames the
@@ -208,6 +214,10 @@ _ADMISSION_EXEMPT = frozenset(
         MsgType.FILTERS,
         MsgType.SNAPSHOT,
         MsgType.MAINTAIN,
+        # Push-plane frames WE emit (EVENT) or asked for
+        # (FILTERHEADERS) — charging them would ration our own pushes.
+        MsgType.EVENT,
+        MsgType.FILTERHEADERS,
     }
 )
 assert (
@@ -237,6 +247,10 @@ _SHED_DROPS = frozenset(
         # health probe and stays up; the full latency dump is capacity
         # an overloaded node may refuse (scrapers retry).
         MsgType.GETMETRICS,
+        # NEW subscriptions shed under overload (a wallet retries any
+        # replica); live ones keep degrading down their own ladder, and
+        # UNSUBSCRIBE stays up because it frees capacity.
+        MsgType.SUBSCRIBE,
     }
 )
 
@@ -278,6 +292,15 @@ _SHED_KEEPS = frozenset(
         # overload unavailable during overload.
         MsgType.GETMAINTAIN,
         MsgType.MAINTAIN,
+        # UNSUBSCRIBE frees capacity; EVENT/FILTERHEADERS are frames we
+        # push or asked for; GETFILTERHEADERS is the commitment-chain
+        # probe a wallet uses to decide whether to TRUST us — shedding
+        # it during overload would make a loaded replica look like a
+        # lying one.
+        MsgType.UNSUBSCRIBE,
+        MsgType.GETFILTERHEADERS,
+        MsgType.EVENT,
+        MsgType.FILTERHEADERS,
     }
 )
 assert (
@@ -700,6 +723,20 @@ class Node:
             clock=self.clock.monotonic,
         )
         self.metrics = NodeMetrics(registry=self.telemetry)
+        #: The wallet push plane (node/subscriptions.py): watch-filter
+        #: subscriptions pushed at every block connect, reading the
+        #: chain through a late-bound getter because start()'s resume
+        #: paths and live re-basing REPLACE self.chain.
+        from p1_tpu.node.subscriptions import (
+            ChainSubSource,
+            SubscriptionManager,
+        )
+
+        self.subscriptions = SubscriptionManager(
+            ChainSubSource(lambda: self.chain),
+            clock=self.clock.monotonic,
+            registry=self.telemetry,
+        )
         #: ``store`` is injectable (tests pass a fault-injecting
         #: ``chain/testing.py`` FaultStore); by default the config path
         #: decides persistence.
@@ -1322,6 +1359,9 @@ class Node:
         background loops — one tail shared by the genesis and snapshot
         resume paths."""
         self._running = True
+        # The resume paths grew the chain with nobody subscribed; the
+        # push plane promises events from NOW, not a replay of boot.
+        self.subscriptions.reset_cursor()
         self._server = await self.transport.listen(
             self._on_inbound, self.config.host, self.config.port
         )
@@ -3256,6 +3296,7 @@ class Node:
         finally:
             if inbound:  # still mid-handshake: release the slot
                 self._handshaking -= 1
+            self.subscriptions.drop(writer)
             self._peers.pop(writer, None)
             writer.close()
         return registered
@@ -3649,6 +3690,58 @@ class Node:
             )
         elif mtype is MsgType.FILTERS:
             pass  # reply frame: meaningful to light clients only
+        elif mtype is MsgType.GETFILTERHEADERS:
+            # The BIP157-analog commitment chain (chain/filters.py): the
+            # proof surface a wallet cross-checks untrusted filter
+            # streams against.  ``range`` refuses (empty reply) rather
+            # than partially answer a span this chain has not committed
+            # — pruned/rebased nodes are honestly short, never wrong.
+            start, count = body
+            await self._send_guarded(
+                peer,
+                protocol.encode_filterheaders(
+                    start,
+                    self.chain.filter_headers.range(
+                        start, min(count, FILTER_BATCH)
+                    ),
+                ),
+            )
+        elif mtype is MsgType.FILTERHEADERS:
+            pass  # reply frame: meaningful to light clients only
+        elif mtype is MsgType.SUBSCRIBE:
+            # Wallet push plane (node/subscriptions.py): register this
+            # session's watch items; an unverifiable resume cursor is
+            # refused by disconnect (unscored — a pruned window or a
+            # wallet that last spoke to a liar is not hostility), which
+            # is the wallet's signal to fail over.
+            cursor, items = body
+            sub_writer = peer.writer
+
+            async def _sub_push(payload: bytes, w=sub_writer) -> None:
+                protocol.write_frame_nowait(w, payload)
+
+            def _sub_buf(w=sub_writer) -> int:
+                transport = w.transport
+                return (
+                    transport.get_write_buffer_size()
+                    if transport is not None
+                    else 0
+                )
+
+            ok = await self.subscriptions.subscribe(
+                sub_writer,
+                items,
+                cursor,
+                send=_sub_push,
+                buffer_size=_sub_buf,
+                close=sub_writer.close,
+            )
+            if not ok:
+                raise _Refused("resume cursor not on the committed chain")
+        elif mtype is MsgType.UNSUBSCRIBE:
+            self.subscriptions.unsubscribe(peer.writer)
+        elif mtype is MsgType.EVENT:
+            pass  # push frame: meaningful to subscribed wallets only
         elif mtype is MsgType.GETSNAPSHOT:
             # Snapshot serving (chain/snapshot.py): manifest or a chunk
             # range of the latest checkpoint state.  Range-capped and
@@ -3987,6 +4080,9 @@ class Node:
                 # filter while its body is hot (incremental-at-connect;
                 # anything LRU-evicted later rebuilds from the store).
                 self.chain.filter_index.add_block(b)
+            # Push plane: notify live subscriptions of the connect (the
+            # no-subscriber case is a cursor fast-forward, not a build).
+            await self.subscriptions.notify()
             if res.tip_changed:
                 if not gossip:
                     # Batch-synced tip movement: queue the one-shot
@@ -4373,6 +4469,15 @@ class Node:
                 "filter_bytes_served": self.metrics.filter_bytes_served,
                 "proof_cache": self.chain.proof_cache.snapshot(),
                 "filter_cache": self.chain.filter_index.snapshot(),
+            },
+            # Wallet push plane (round 21, node/subscriptions.py): live
+            # watch sessions, the degradation ladder's counters
+            # (coalesced/dropped/disconnected — a slow wallet degrades,
+            # the write gauge does not balloon), cursor replays, and
+            # the filter-header commitment chain's span.
+            "subscriptions": {
+                **self.subscriptions.snapshot(),
+                "filter_headers": len(self.chain.filter_headers),
             },
             # Validation fast lane (round 8): the verify-once signature
             # cache (this node's instance — hits are blocks connecting

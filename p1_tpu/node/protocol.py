@@ -217,8 +217,12 @@ _LEN = struct.Struct(">I")
 #: of node/telemetry.py, served by nodes and replicas); v13 the
 #: maintenance plane (GETMAINTAIN/MAINTAIN — `p1 maintain` drives live
 #: re-basing, online prune/compact, and version-bits status on a
-#: running node without restarting it).
-PROTOCOL_VERSION = 13
+#: running node without restarting it); v14 the wallet push plane
+#: (SUBSCRIBE/EVENT/UNSUBSCRIBE — watch-filter subscriptions pushed at
+#: block connect with gap-free resume cursors — plus GETFILTERHEADERS/
+#: FILTERHEADERS, the BIP157-analog filter-header commitment chain a
+#: wallet cross-checks untrusted filter streams against).
+PROTOCOL_VERSION = 14
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -263,6 +267,11 @@ class MsgType(enum.IntEnum):
     METRICS = 30
     GETMAINTAIN = 31
     MAINTAIN = 32
+    SUBSCRIBE = 33
+    EVENT = 34
+    UNSUBSCRIBE = 35
+    GETFILTERHEADERS = 36
+    FILTERHEADERS = 37
 
 
 #: The wire version that introduced each frame type — the version-gate
@@ -308,6 +317,11 @@ MSG_SINCE: dict[MsgType, int] = {
     MsgType.METRICS: 12,
     MsgType.GETMAINTAIN: 13,
     MsgType.MAINTAIN: 13,
+    MsgType.SUBSCRIBE: 14,
+    MsgType.EVENT: 14,
+    MsgType.UNSUBSCRIBE: 14,
+    MsgType.GETFILTERHEADERS: 14,
+    MsgType.FILTERHEADERS: 14,
 }
 assert set(MSG_SINCE) == set(MsgType), "every frame type needs a version row"
 assert all(1 <= v <= PROTOCOL_VERSION for v in MSG_SINCE.values())
@@ -344,6 +358,35 @@ class FeeStats:
     p50: int
     p75: int
     tip_height: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockEvent:
+    """One decoded push-plane EVENT (v14): everything a subscribed
+    wallet needs to verify the notification before believing it — the
+    raw header (PoW + linkage), the filter bytes (re-match locally) and
+    the filter header (check the commitment chain).  ``matched`` and
+    ``txids`` are the server's *claim* about the session's watch set; a
+    trustless client treats them as hints and re-derives both."""
+
+    height: int
+    raw_header: bytes  # 80 bytes, serialized
+    filter_header: bytes  # 32-byte commitment at this height
+    filter: bytes  # the block's compact filter
+    matched: bool  # server's claim: filter matched the watch set
+    txids: tuple[bytes, ...]  # server's claim: confirmed watched txids
+
+
+@dataclasses.dataclass(frozen=True)
+class GapEvent:
+    """A push-plane degradation notice: events for heights
+    ``[start, end]`` were dropped (the slow-consumer drop-to-cursor
+    rung).  The session stays live; the client owes itself a replay of
+    the window — from this server or any other replica, the commitment
+    chain makes them interchangeable."""
+
+    start: int
+    end: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -649,6 +692,100 @@ def encode_filters(start_height: int, entries: list[tuple[bytes, bytes]]) -> byt
         parts.append(_LEN.pack(len(fbytes)))
         parts.append(fbytes)
     return b"".join(parts)
+
+
+def encode_getfilterheaders(start_height: int, count: int) -> bytes:
+    if not 0 <= start_height <= 0xFFFFFFFF:
+        raise ValueError("bad filter-header start height")
+    if not 0 < count <= 0xFFFF:
+        raise ValueError("need 1..65535 filter headers")
+    return bytes([MsgType.GETFILTERHEADERS]) + struct.pack(
+        ">IH", start_height, count
+    )
+
+
+def encode_filterheaders(start_height: int, headers: list[bytes]) -> bytes:
+    """``headers`` are consecutive 32-byte filter-header commitments
+    ascending from ``start_height``; an empty list is the clean refusal
+    (range not committed here — pruned/re-based history)."""
+    if len(headers) > 0xFFFF:
+        raise ValueError("too many filter headers for one frame")
+    for h in headers:
+        if len(h) != 32:
+            raise ValueError("filter header must be 32 bytes")
+    return (
+        bytes([MsgType.FILTERHEADERS])
+        + struct.pack(">IH", start_height, len(headers))
+        + b"".join(headers)
+    )
+
+
+def encode_subscribe(
+    items: list[bytes], cursor: tuple[int, bytes] | None = None
+) -> bytes:
+    """Register (or replace) the session's watch set.  ``cursor`` is the
+    gap-free resume point — the last (height, filter_header) the client
+    VERIFIED; the server replays everything after it before pushing
+    live, and refuses (drops the session) if its committed chain
+    contradicts the cursor — a client would rather fail over than ride
+    a server on the wrong branch."""
+    if not 0 < len(items) <= 0xFFFF:
+        raise ValueError("need 1..65535 watch items")
+    if cursor is None:
+        head = bytes([MsgType.SUBSCRIBE, 0])
+    else:
+        height, fheader = cursor
+        if len(fheader) != 32:
+            raise ValueError("cursor filter header must be 32 bytes")
+        head = (
+            bytes([MsgType.SUBSCRIBE, 1])
+            + struct.pack(">I", height)
+            + fheader
+        )
+    parts = [head, struct.pack(">H", len(items))]
+    for it in items:
+        if not 0 < len(it) <= 255:
+            raise ValueError("watch item must be 1..255 bytes")
+        parts.append(bytes([len(it)]))
+        parts.append(it)
+    return b"".join(parts)
+
+
+def encode_unsubscribe() -> bytes:
+    return bytes([MsgType.UNSUBSCRIBE])
+
+
+def encode_event(ev: BlockEvent) -> bytes:
+    """One block-connect push (EVENT kind 0)."""
+    if len(ev.raw_header) != HEADER_SIZE:
+        raise ValueError("event header must be exactly 80 bytes")
+    if len(ev.filter_header) != 32:
+        raise ValueError("event filter header must be 32 bytes")
+    if len(ev.txids) > 0xFFFF:
+        raise ValueError("too many txids for one EVENT")
+    for txid in ev.txids:
+        if len(txid) != 32:
+            raise ValueError("event txid must be 32 bytes")
+    return b"".join(
+        (
+            bytes([MsgType.EVENT, 0]),
+            struct.pack(">I", ev.height),
+            ev.raw_header,
+            ev.filter_header,
+            _LEN.pack(len(ev.filter)),
+            ev.filter,
+            struct.pack(">BH", int(ev.matched), len(ev.txids)),
+            *ev.txids,
+        )
+    )
+
+
+def encode_event_gap(start: int, end: int) -> bytes:
+    """The drop-to-cursor notice (EVENT kind 1): heights [start, end]
+    were shed for this slow consumer instead of queueing unboundedly."""
+    if end < start:
+        raise ValueError("bad gap range")
+    return bytes([MsgType.EVENT, 1]) + struct.pack(">II", start, end)
 
 
 #: Byte offset of ``tip_height`` inside an encoded found-PROOF payload:
@@ -993,6 +1130,100 @@ def _decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in FILTERS")
         return mtype, (start, entries)
+    if mtype is MsgType.GETFILTERHEADERS:
+        if len(body) != 6:
+            raise ValueError("bad GETFILTERHEADERS")
+        start, count = struct.unpack(">IH", body)
+        if count == 0:
+            raise ValueError("bad GETFILTERHEADERS count")
+        return mtype, (start, count)
+    if mtype is MsgType.FILTERHEADERS:
+        if len(body) < 6:
+            raise ValueError("bad FILTERHEADERS")
+        start, n = struct.unpack_from(">IH", body)
+        if len(body) != 6 + 32 * n:
+            raise ValueError("bad FILTERHEADERS size")
+        return mtype, (
+            start,
+            [body[6 + 32 * i : 6 + 32 * (i + 1)] for i in range(n)],
+        )
+    if mtype is MsgType.SUBSCRIBE:
+        if len(body) < 1:
+            raise ValueError("bad SUBSCRIBE")
+        has_cursor = body[0]
+        if has_cursor not in (0, 1):
+            raise ValueError("bad SUBSCRIBE cursor flag")
+        off = 1
+        cursor = None
+        if has_cursor:
+            if len(body) < off + 36:
+                raise ValueError("truncated SUBSCRIBE cursor")
+            (height,) = struct.unpack_from(">I", body, off)
+            cursor = (height, body[off + 4 : off + 36])
+            off += 36
+        if len(body) < off + 2:
+            raise ValueError("truncated SUBSCRIBE")
+        (n,) = struct.unpack_from(">H", body, off)
+        off += 2
+        if n == 0:
+            raise ValueError("SUBSCRIBE needs at least one watch item")
+        items = []
+        for _ in range(n):
+            if len(body) < off + 1:
+                raise ValueError("truncated SUBSCRIBE item")
+            ilen = body[off]
+            off += 1
+            if ilen == 0 or len(body) < off + ilen:
+                raise ValueError("bad SUBSCRIBE item")
+            items.append(body[off : off + ilen])
+            off += ilen
+        if off != len(body):
+            raise ValueError("trailing bytes in SUBSCRIBE")
+        return mtype, (cursor, items)
+    if mtype is MsgType.UNSUBSCRIBE:
+        if body:
+            raise ValueError("bad UNSUBSCRIBE")
+        return mtype, None
+    if mtype is MsgType.EVENT:
+        if len(body) < 1:
+            raise ValueError("bad EVENT")
+        kind = body[0]
+        if kind == 1:
+            if len(body) != 9:
+                raise ValueError("bad EVENT gap size")
+            start, end = struct.unpack_from(">II", body, 1)
+            if end < start:
+                raise ValueError("bad EVENT gap range")
+            return mtype, GapEvent(start, end)
+        if kind != 0:
+            raise ValueError(f"bad EVENT kind {kind}")
+        off = 1
+        if len(body) < off + 4 + HEADER_SIZE + 32 + _LEN.size:
+            raise ValueError("truncated EVENT")
+        (height,) = struct.unpack_from(">I", body, off)
+        off += 4
+        raw_header = body[off : off + HEADER_SIZE]
+        off += HEADER_SIZE
+        fheader = body[off : off + 32]
+        off += 32
+        (flen,) = _LEN.unpack_from(body, off)
+        off += _LEN.size
+        if len(body) < off + flen + 3:
+            raise ValueError("truncated EVENT filter")
+        fbytes = body[off : off + flen]
+        off += flen
+        matched, ntx = struct.unpack_from(">BH", body, off)
+        off += 3
+        if matched not in (0, 1):
+            raise ValueError("bad EVENT matched flag")
+        if len(body) != off + 32 * ntx:
+            raise ValueError("bad EVENT txid section")
+        txids = tuple(
+            body[off + 32 * i : off + 32 * (i + 1)] for i in range(ntx)
+        )
+        return mtype, BlockEvent(
+            height, raw_header, fheader, fbytes, bool(matched), txids
+        )
     if mtype is MsgType.GETSNAPSHOT:
         if len(body) != 6:
             raise ValueError("bad GETSNAPSHOT")
@@ -1096,6 +1327,18 @@ def _decode(payload: bytes):
 async def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     writer.write(_LEN.pack(len(payload)) + payload)
     await writer.drain()
+
+
+def write_frame_nowait(writer, payload: bytes) -> None:
+    """Buffer one frame without draining — the push plane's send
+    primitive.  A slow consumer grows the transport write buffer
+    instead of blocking the notifier; the subscription ladder
+    (node/subscriptions.py) reads that buffer size and degrades
+    (coalesce → drop-to-cursor → disconnect) long before the hard cap.
+    drain() here would invert that: one stalled wallet at the default
+    64 KiB high-water mark would block every other subscriber's
+    notification."""
+    writer.write(_LEN.pack(len(payload)) + payload)
 
 
 async def read_frame(reader: asyncio.StreamReader) -> bytes:

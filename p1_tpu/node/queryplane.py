@@ -55,7 +55,7 @@ import struct
 import time
 from pathlib import Path
 
-from p1_tpu.chain.filters import FilterIndex
+from p1_tpu.chain.filters import FilterHeaderChain, FilterIndex
 from p1_tpu.chain.proof import ProofCache, build_block_proofs
 from p1_tpu.chain.store import MAGIC, V2_MAGIC, ChainStore
 from p1_tpu.core.block import Block
@@ -63,8 +63,13 @@ from p1_tpu.core.genesis import make_genesis
 from p1_tpu.core.hashutil import sha256d
 from p1_tpu.core.header import HEADER_SIZE
 from p1_tpu.node import protocol
-from p1_tpu.node.governor import CLASS_QUERIES, ResourceGovernor
+from p1_tpu.node.governor import (
+    CLASS_QUERIES,
+    WRITE_QUEUE_MAX,
+    ResourceGovernor,
+)
 from p1_tpu.node.protocol import Hello, MsgType
+from p1_tpu.node.subscriptions import SubscriptionManager, block_items_index
 
 log = logging.getLogger("p1_tpu.queryplane")
 
@@ -156,6 +161,13 @@ class ReplicaView:
         self.genesis = make_genesis(difficulty, retarget)
         self.proof_cache = ProofCache()
         self.filter_index = FilterIndex()
+        #: The replica's own filter-header commitment chain, rebuilt
+        #: from record bytes at attach and advanced per refresh.  It is
+        #: DERIVED, not copied: filters are pure functions of block
+        #: bytes, so this replica's chain matches the writer's — and a
+        #: wallet cross-checking two replicas compares commitments
+        #: neither could forge independently.
+        self.filter_headers = FilterHeaderChain()
         #: Mapped record sources, in record order: [whole file] for the
         #: single-file layout, one per segment (manifest order) for a
         #: segmented store — ``_Entry.off`` packs the source index.
@@ -260,6 +272,9 @@ class ReplicaView:
                 or len(self._main) - 1 != self._entries[self._tip].height
             ):
                 self._rebuild_main()
+            self.filter_headers.sync(
+                self.tip_height, self.hash_at, self.filter_at
+            )
         self.refreshes += 1
         return new
 
@@ -534,6 +549,36 @@ class ReplicaView:
             out.append((bhash, fbytes))
         return out
 
+    # -- subscription source (node/subscriptions.py duck type) -------------
+
+    def hash_at(self, height: int) -> bytes | None:
+        if 0 <= height < len(self._main):
+            return self._main[height]
+        return None
+
+    def raw_header_at(self, height: int) -> bytes | None:
+        return self.raw_header(height)
+
+    def filter_at(self, height: int) -> bytes | None:
+        bhash = self.hash_at(height)
+        if bhash is None:
+            return None
+        return self.filter_index.get_or_build(
+            bhash, lambda bh: self.read_block(bh)
+        )
+
+    def fheader_at(self, height: int) -> bytes | None:
+        return self.filter_headers.header_at(height)
+
+    def block_items_at(self, height: int):
+        bhash = self.hash_at(height)
+        if bhash is None:
+            return None
+        block = self.read_block(bhash)
+        if block is None:
+            return None
+        return block_items_index(block)
+
     def proof_payload(self, txid: bytes) -> bytes:
         """The wire PROOF reply for ``txid`` at this view's tip — same
         cache economics as the node's ``_proof_payload``."""
@@ -609,6 +654,13 @@ class QueryPlaneServer:
         from p1_tpu.node.telemetry import MetricsRegistry
 
         self.telemetry = MetricsRegistry()
+        #: The wallet push plane (node/subscriptions.py): watch-filter
+        #: subscriptions notified from the refresh loop at each new
+        #: record batch, degrading slow consumers down the
+        #: coalesce → drop-to-cursor → disconnect ladder.
+        self.subscriptions = SubscriptionManager(
+            view, registry=self.telemetry
+        )
         self.instance_nonce = secrets.randbits(64) | 1
         self._server: asyncio.Server | None = None
         self._sessions: set[asyncio.Task] = set()
@@ -619,6 +671,10 @@ class QueryPlaneServer:
         self.admission_dropped = 0
         self.sessions_refused = 0
         self.sessions_total = 0
+        #: Sessions disconnected at the hard write-queue cap — the same
+        #: squat guard node sessions have: a subscriber (or a client
+        #: that keeps asking without reading) cannot pin replica memory.
+        self.sessions_dropped_squat = 0
         #: Rolling per-second query counts for the QPS figure (last 60 s).
         self._qps_window: collections.deque[tuple[int, int]] = (
             collections.deque(maxlen=60)
@@ -648,6 +704,7 @@ class QueryPlaneServer:
 
     async def stop(self) -> None:
         self._running = False
+        self.subscriptions.close_all()
         if self._refresh_task is not None:
             self._refresh_task.cancel()
             await asyncio.gather(self._refresh_task, return_exceptions=True)
@@ -684,7 +741,8 @@ class QueryPlaneServer:
         while self._running:
             await asyncio.sleep(self.refresh_interval_s)
             try:
-                self.view.refresh()
+                if self.view.refresh():
+                    await self.subscriptions.notify()
             except (OSError, ValueError) as e:
                 # A transient read fault or a mid-run store replacement
                 # with something unreadable: keep serving the view we
@@ -724,6 +782,9 @@ class QueryPlaneServer:
             "sessions": len(self._sessions),
             "sessions_total": self.sessions_total,
             "sessions_refused": self.sessions_refused,
+            "sessions_dropped_squat": self.sessions_dropped_squat,
+            "filter_headers": len(self.view.filter_headers),
+            "subscriptions": self.subscriptions.snapshot(),
             "queries": {
                 "served": dict(self.queries_served),
                 "total": sum(self.queries_served.values()),
@@ -752,7 +813,23 @@ class QueryPlaneServer:
         task = asyncio.current_task()
         self._sessions.add(task)
         self.sessions_total += 1
+        sid = self.sessions_total
+        subscribed = False
         budget = self.governor.budget()
+
+        async def push(payload: bytes) -> None:
+            # Pushes never drain: the transport buffer is the bounded
+            # subscription queue, read back by the ladder below.
+            protocol.write_frame_nowait(writer, payload)
+
+        def buffer_size() -> int:
+            transport = writer.transport
+            return (
+                transport.get_write_buffer_size()
+                if transport is not None
+                else 0
+            )
+
         try:
             await protocol.write_frame(writer, self._hello())
             payload = await asyncio.wait_for(
@@ -764,8 +841,12 @@ class QueryPlaneServer:
             if hello.genesis_hash != self.view.genesis.block_hash():
                 raise protocol.ChainMismatch("genesis mismatch")
             while self._running:
+                # A subscribed session is legitimately silent for as
+                # long as blocks are quiet — the idle deadline applies
+                # only to the request/reply shape.
                 payload = await asyncio.wait_for(
-                    protocol.read_frame(reader), timeout=self.idle_timeout_s
+                    protocol.read_frame(reader),
+                    timeout=None if subscribed else self.idle_timeout_s,
                 )
                 mtype, body = protocol.decode(payload)
                 if mtype in _QUERY_TYPES and not self.governor.admit(
@@ -773,10 +854,40 @@ class QueryPlaneServer:
                 ):
                     self.admission_dropped += 1
                     continue
+                if mtype is MsgType.SUBSCRIBE:
+                    cursor, items = body
+                    self._count_query(mtype)
+                    ok = await self.subscriptions.subscribe(
+                        sid,
+                        items,
+                        cursor,
+                        send=push,
+                        buffer_size=buffer_size,
+                        close=writer.close,
+                    )
+                    if not ok:
+                        # Unverifiable resume cursor (pruned window or a
+                        # wallet that last spoke to a liar): refusing by
+                        # disconnect is the failover signal.
+                        raise protocol.ProtocolError(
+                            "resume cursor not on the committed chain"
+                        )
+                    subscribed = True
+                    continue
+                if mtype is MsgType.UNSUBSCRIBE:
+                    self._count_query(mtype)
+                    self.subscriptions.unsubscribe(sid)
+                    subscribed = False
+                    continue
                 with self.telemetry.span("query.request_s"):
                     reply = self._answer(mtype, body)
                     if reply is not None:
                         self._count_query(mtype)
+                        if buffer_size() > WRITE_QUEUE_MAX:
+                            # Asking while never reading: same hard-cap
+                            # disconnect as a squatting node peer.
+                            self.sessions_dropped_squat += 1
+                            break
                         await protocol.write_frame(writer, reply)
         except (
             asyncio.IncompleteReadError,
@@ -788,6 +899,7 @@ class QueryPlaneServer:
         ):
             pass  # replica sessions end quietly; clients just reconnect
         finally:
+            self.subscriptions.drop(sid)
             self._sessions.discard(task)
             writer.close()
 
@@ -806,6 +918,12 @@ class QueryPlaneServer:
         if mtype is MsgType.GETBLOCKS:
             return protocol.encode_blocks_raw(
                 v.blocks_after(body, SYNC_BATCH, SYNC_BYTES)
+            )
+        if mtype is MsgType.GETFILTERHEADERS:
+            start, count = body
+            return protocol.encode_filterheaders(
+                start,
+                v.filter_headers.range(start, min(count, FILTER_BATCH)),
             )
         if mtype is MsgType.GETSTATUS:
             return protocol.encode_status(self.status())
@@ -829,10 +947,13 @@ _QUERY_TYPES = frozenset(
     {
         MsgType.GETHEADERS,
         MsgType.GETFILTERS,
+        MsgType.GETFILTERHEADERS,
         MsgType.GETPROOF,
         MsgType.GETBLOCKS,
         MsgType.GETSTATUS,
         MsgType.GETMETRICS,
+        MsgType.SUBSCRIBE,
+        MsgType.UNSUBSCRIBE,
     }
 )
 

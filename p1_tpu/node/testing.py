@@ -178,6 +178,12 @@ class FloodPlan:
     #: read the socket — the victim's transport buffer grows until its
     #: write-queue cap drops us (or its memory does not survive).
     squat: bool = False
+    #: Hammer the wallet push plane: re-register a rotating SUBSCRIBE
+    #: watch set every frame (each replaces the session's subscription
+    #: — admission + registry work with zero lasting footprint), capped
+    #: by one unverifiable resume cursor the victim answers by dropping
+    #: the session, so the reconnect loop then pressures accept too.
+    subscribe: bool = False
     #: Frames per burst between event-loop yields.
     burst: int = 32
     #: Sleep between bursts (0 = as fast as the loop allows).
@@ -271,6 +277,14 @@ class GreedyPeer:
             ]
         if plan.squat:
             out += [protocol.encode_getblocks([self.blocks[0].block_hash()])]
+        if plan.subscribe:
+            out += [
+                protocol.encode_subscribe([b"flood-item-%d" % i])
+                for i in range(4)
+            ]
+            out += [
+                protocol.encode_subscribe([b"flood-item-x"], (1, b"\x55" * 32))
+            ]
         assert out, "empty FloodPlan"
         return out
 

@@ -332,6 +332,32 @@ class TestInterprocedural:
         findings = list(RULES["wire-contract"].check_package(idx))
         assert [f.key for f in findings] == ["GETMETRICS:shed"], findings
 
+    def test_wire_contract_guards_the_subscription_plane(self):
+        """Round-21 mutation controls: the push-plane messages are held
+        in the admission and shed registries by the gate, not by
+        convention.  Each mutation drops one membership from the PARSED
+        node.py and must fail at exactly that member:aspect."""
+        src = (PKG_ROOT / "node" / "node.py").read_text()
+        cases = [
+            # _MSG_CLASS charge entry -> unclassified traffic
+            ("MsgType.SUBSCRIBE: CLASS_QUERIES,", "SUBSCRIBE:admission"),
+            # _ADMISSION_EXEMPT is the first set-style EVENT mention
+            ("MsgType.EVENT,", "EVENT:admission"),
+            # _SHED_DROPS is the first set-style SUBSCRIBE mention
+            ("MsgType.SUBSCRIBE,", "SUBSCRIBE:shed"),
+            # _SHED_KEEPS is the first set-style UNSUBSCRIBE mention
+            ("MsgType.UNSUBSCRIBE,", "UNSUBSCRIBE:shed"),
+        ]
+        for needle, expect in cases:
+            idx = self._package_index()
+            mutated = src.replace(needle, "", 1)
+            assert mutated != src, needle
+            idx.trees["node/node.py"] = ast.parse(
+                mutated, filename="node/node.py"
+            )
+            findings = list(RULES["wire-contract"].check_package(idx))
+            assert [f.key for f in findings] == [expect], (needle, findings)
+
     def test_transitive_blocking_grants_read_as_the_roadmap2_work_list(self):
         """Acceptance: every transitive-blocking grant names a concrete
         offload decision (a stage or an explicit on/off-loop verdict) —

@@ -753,3 +753,124 @@ class TestMaintenanceChaos:
         for family in ("seal_sidecar_crash", "rebase", "online_prune",
                        "online_compact_crash"):
             assert family in ops, f"{family} absent from 8 soak seeds"
+
+
+@pytest.mark.chaos
+class TestSubscriptionChaos:
+    """Round-21 tentpole (c): the wallet push plane under chaos.  The
+    generated corpus must carry the subscription ops (so the sweeps
+    exercise watchers organically), crafted schedules must prove the
+    two headline behaviors — a watcher rides out the death of its
+    serving replica by failing over with a resume cursor, and a
+    SUBSCRIBE flood neither wedges the victim nor starves an honest
+    watcher — and the mute-push injectable bug proves the push-missed
+    invariant has teeth."""
+
+    @staticmethod
+    def _ev_clock():
+        t = [0.0]
+
+        def ev(**kw):
+            t[0] += 0.8
+            return {"at": round(t[0], 3), **kw}
+
+        return ev
+
+    def test_generated_corpus_carries_subscription_ops(self):
+        ops: set[str] = set()
+        for seed in range(40):
+            for ev in chaos.generate_schedule(seed, 5, 10):
+                ops.add(ev["op"])
+        for op in ("watch_start", "watch_stop", "sub_flood"):
+            assert op in ops, f"{op} never generated in 40 seeds"
+
+    def test_crafted_watcher_survives_serving_node_crash_mid_push(self):
+        """The tentpole failover contract, end to end on SimNet: a
+        wallet watches node 1, node 1 dies abruptly mid-stream, blocks
+        keep paying the watched account on the survivors — the watch
+        must fail over (resume cursor, commitment-verified) and arrive
+        at quiesce gap-free, chain-true, and with every payment seen
+        (the push-gap/push-chain/push-commit/push-missed suite)."""
+        ev = self._ev_clock()
+        events = (
+            [ev(op="mine", node=0) for _ in range(2)]
+            + [ev(op="watch_start", node=1, watcher=0)]
+            + [ev(op="tx", amount=2, fee=1), ev(op="mine", node=0)]
+            + [ev(op="crash", node=1)]
+            + [ev(op="tx", amount=1, fee=1), ev(op="mine", node=0)]
+            + [ev(op="tx", amount=3, fee=0), ev(op="mine", node=2)]
+            + [ev(op="recover", node=1)]
+            + [ev(op="mine", node=0)]
+        )
+        report = chaos.run_chaos(0, nodes=3, events=events)
+        assert report["ok"], report["violations"]
+        assert report["watchers"] == 1
+        # The watch saw the whole window despite its replica dying:
+        # payment block, the two blocks mined while node 1 was down,
+        # and the settle block.
+        assert report["watch_events"] >= 4
+
+    def test_crafted_sub_flood_is_survived_and_cleared(self):
+        """A SUBSCRIBE flood (rotating watch sets + one unverifiable
+        resume cursor per frame burst) against the node an honest
+        watcher is riding: admission control must shed it without
+        wedging the victim or the watcher, and `calm` + quiesce must
+        find zero leaked sessions (push-leak)."""
+        ev = self._ev_clock()
+        events = (
+            [ev(op="mine", node=0) for _ in range(2)]
+            + [ev(op="watch_start", node=0, watcher=0)]
+            + [ev(op="sub_flood", node=0)]
+            + [ev(op="tx", amount=2, fee=1), ev(op="mine", node=1)]
+            + [ev(op="calm")]
+            + [ev(op="tx", amount=1, fee=1), ev(op="mine", node=0)]
+        )
+        report = chaos.run_chaos(0, nodes=3, events=events)
+        assert report["ok"], report["violations"]
+        assert report["watch_events"] >= 3
+
+    def test_mute_push_bug_is_caught(self):
+        """The watcher invariants have teeth: `mute-push` strips the
+        match payload from delivered events (a push plane that
+        "notifies" without telling the wallet it was paid) and the
+        push-missed invariant must convict; the identical clean run
+        must be green."""
+        ev = self._ev_clock()
+        events = (
+            [ev(op="mine", node=0) for _ in range(2)]
+            + [ev(op="watch_start", node=1, watcher=0)]
+            + [ev(op="tx", amount=2, fee=1), ev(op="mine", node=0)]
+            + [ev(op="tx", amount=1, fee=1), ev(op="mine", node=0)]
+        )
+        bad = chaos.run_chaos(1, nodes=3, events=events,
+                              inject_bug="mute-push")
+        assert not bad["ok"]
+        assert {v["invariant"] for v in bad["violations"]} == {"push-missed"}
+        good = chaos.run_chaos(1, nodes=3, events=events)
+        assert good["ok"], good["violations"]
+        assert good["watch_events"] >= 3
+
+    def test_soak_schedule_carries_subscription_churn(self):
+        """generate_soak_schedule's `subs` cluster kind: recurring
+        subscribe/push/unsubscribe cycles across a virtual week, every
+        watch_start paired with a watch_stop inside its envelope and a
+        block inside the window so each cycle carries a real push."""
+        total = 0
+        for seed in range(8):
+            events = chaos.generate_soak_schedule(
+                seed=seed, n_nodes=5, horizon_vs=7 * chaos.DAY_VS,
+                fault_clusters=28, blocks=336,
+            )
+            ops = [e["op"] for e in events]
+            assert ops.count("watch_start") == ops.count("watch_stop")
+            starts = [e for e in events if e["op"] == "watch_start"]
+            stops = [e for e in events if e["op"] == "watch_stop"]
+            for a, b in zip(starts, stops):
+                assert a["at"] < b["at"]
+                # The envelope carries at least one block to push.
+                assert any(
+                    e["op"] == "mine" and a["at"] < e["at"] < b["at"]
+                    for e in events
+                )
+            total += len(starts)
+        assert total >= 1, "subs clusters absent from 8 soak seeds"

@@ -1054,6 +1054,140 @@ class TestServe:
             proc.terminate()
             proc.wait(timeout=30)
 
+
+class TestWatchCLI:
+    """`p1 watch` — one JSON line per verified push event, deadline and
+    max-events as clean exits (0), dead peers as exit 1."""
+
+    def test_help_smoke(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "p1_tpu", "watch", "--help"],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0
+        assert "--fallback" in proc.stdout and "--deadline" in proc.stdout
+
+    def test_watch_e2e_mining_node_events_then_exit(self, tmp_path):
+        """Submit-free SLO shape over two real processes: a mining node
+        pushes events, `p1 watch <miner account>` verifies and prints
+        them, then exits 0 at --max-events.  Every line is a matched
+        event (each block pays the miner) with contiguous heights."""
+        node_log = open(tmp_path / "node.log", "w")
+        node = subprocess.Popen(
+            [
+                sys.executable, "-m", "p1_tpu", "node",
+                "--difficulty", "12", "--backend", "cpu",
+                "--chunk", "16384", "--port", "0",
+                "--miner-id", "watch-cli-acct", "--deadline", "stdin",
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=node_log,
+            text=True,
+            cwd="/root/repo",
+        )
+        try:
+            port = None
+            for line in node.stdout:
+                line = line.strip()
+                if line.startswith("{"):
+                    port = str(json.loads(line)["ready"])
+                    break
+            assert port, "node never printed its ready line"
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "watch",
+                    "watch-cli-acct", "--difficulty", "12",
+                    "--port", port, "--deadline", "90",
+                    "--max-events", "3",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=110,
+                cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            lines = [
+                json.loads(l) for l in proc.stdout.strip().splitlines()
+            ]
+            assert len(lines) == 3
+            heights = [l["height"] for l in lines]
+            assert heights == list(range(heights[0], heights[0] + 3))
+            for l in lines:
+                assert l["matched"] and l["txids"]
+                assert len(l["block"]) == 64  # hex block hash
+                assert len(l["filter_header"]) == 64
+                assert l["peer"].endswith(f":{port}")
+        finally:
+            try:
+                node.communicate(input="0\n", timeout=30)
+            except subprocess.TimeoutExpired:
+                node.kill()
+            node_log.close()
+
+    def test_watch_deadline_is_a_clean_exit(self, tmp_path):
+        """Against a static replica nothing ever connects, so the watch
+        idles at its TOFU anchor until --deadline — exit 0, no output
+        (the `p1 serve` deadline contract)."""
+        from p1_tpu.chain import ChainStore
+        from p1_tpu.node.testing import make_blocks
+
+        store = tmp_path / "chain.dat"
+        s = ChainStore(store)
+        try:
+            for block in make_blocks(4, difficulty=12)[1:]:
+                s.append(block)
+        finally:
+            s.close()
+        srv = subprocess.Popen(
+            [
+                sys.executable, "-m", "p1_tpu", "serve",
+                "--store", str(store), "--difficulty", "12",
+                "--port", "0", "--deadline", "60",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            cwd="/root/repo",
+        )
+        try:
+            ready = json.loads(srv.stdout.readline())
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "p1_tpu", "watch", "nobody",
+                    "--difficulty", "12", "--port", str(ready["port"]),
+                    "--deadline", "3",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=110,
+                cwd="/root/repo",
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            assert proc.stdout.strip() == ""
+        finally:
+            srv.terminate()
+            srv.wait(timeout=30)
+
+    def test_watch_dead_peer_exits_1(self):
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "p1_tpu", "watch", "nobody",
+                "--difficulty", "12", "--port", "1",
+                "--max-session-failures", "1",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=110,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 1
+        assert "watch failed" in proc.stderr
+
+
 class TestSnapshotCLI:
     """`p1 snapshot create/verify/info` — the established exit-code
     contract (0 clean / 1 salvageable / 2 unrecoverable) + help smoke."""

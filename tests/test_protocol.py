@@ -81,6 +81,45 @@ class TestRoundTrips:
         mtype, got = protocol.decode(protocol.encode_account(state))
         assert mtype is MsgType.ACCOUNT and got == state
 
+    def test_filter_headers_round_trip(self):
+        mtype, got = protocol.decode(protocol.encode_getfilterheaders(7, 100))
+        assert mtype is MsgType.GETFILTERHEADERS and got == (7, 100)
+        headers = [bytes([i]) * 32 for i in range(3)]
+        mtype, got = protocol.decode(protocol.encode_filterheaders(7, headers))
+        assert mtype is MsgType.FILTERHEADERS and got == (7, headers)
+        # The clean refusal: empty list survives the trip.
+        mtype, got = protocol.decode(protocol.encode_filterheaders(9, []))
+        assert mtype is MsgType.FILTERHEADERS and got == (9, [])
+
+    def test_subscribe_round_trip(self):
+        items = [b"alice", b"\x01" * 32]
+        mtype, got = protocol.decode(protocol.encode_subscribe(items))
+        assert mtype is MsgType.SUBSCRIBE and got == (None, items)
+        cursor = (12, b"\xfe" * 32)
+        mtype, got = protocol.decode(protocol.encode_subscribe(items, cursor))
+        assert mtype is MsgType.SUBSCRIBE and got == (cursor, items)
+        mtype, got = protocol.decode(protocol.encode_unsubscribe())
+        assert mtype is MsgType.UNSUBSCRIBE and got is None
+
+    def test_event_round_trip(self):
+        ev = protocol.BlockEvent(
+            height=5,
+            raw_header=_block().header.serialize(),
+            filter_header=b"\xaa" * 32,
+            filter=b"\x01\x02\x03",
+            matched=True,
+            txids=(b"\x0b" * 32, b"\x0c" * 32),
+        )
+        mtype, got = protocol.decode(protocol.encode_event(ev))
+        assert mtype is MsgType.EVENT and got == ev
+        # Non-matched events carry no txids (the shared frame).
+        plain = protocol.BlockEvent(6, ev.raw_header, ev.filter_header, b"", False, ())
+        mtype, got = protocol.decode(protocol.encode_event(plain))
+        assert mtype is MsgType.EVENT and got == plain
+        mtype, got = protocol.decode(protocol.encode_event_gap(3, 9))
+        assert mtype is MsgType.EVENT
+        assert got == protocol.GapEvent(3, 9)
+
     def test_mempool(self):
         txs = [Transaction("a", "b", 1, f, f) for f in range(3)]
         payload = protocol.encode_mempool([t.serialize() for t in txs], more=True)
@@ -111,6 +150,25 @@ class TestMalformed:
             bytes([MsgType.ACCOUNT]) + b"\x02ab" + b"\x00" * 10,  # short state
             bytes([MsgType.MEMPOOL]) + b"\x00",  # short header
             bytes([MsgType.MEMPOOL]) + b"\x00\x00\x00\x00\x00\x01",  # count lies
+            bytes([MsgType.GETFILTERHEADERS]) + b"\x00",  # short range
+            bytes([MsgType.GETFILTERHEADERS])
+            + b"\x00\x00\x00\x00\x00\x00",  # zero count
+            bytes([MsgType.FILTERHEADERS]) + b"\x00" * 3,  # short header
+            bytes([MsgType.FILTERHEADERS])
+            + b"\x00\x00\x00\x00\x00\x02"
+            + b"\x00" * 32,  # count lies
+            bytes([MsgType.SUBSCRIBE]),  # no cursor flag
+            bytes([MsgType.SUBSCRIBE, 2]),  # unknown cursor flag
+            bytes([MsgType.SUBSCRIBE, 0]) + b"\x00\x00",  # zero items
+            bytes([MsgType.SUBSCRIBE, 0]) + b"\x00\x01\x00\x05ab",  # len lies
+            bytes([MsgType.SUBSCRIBE, 1]) + b"\x00" * 10,  # short cursor
+            bytes([MsgType.UNSUBSCRIBE]) + b"\x00",  # trailing byte
+            bytes([MsgType.EVENT]),  # no kind
+            bytes([MsgType.EVENT, 2]),  # unknown kind
+            bytes([MsgType.EVENT, 0]) + b"\x00" * 20,  # truncated block event
+            bytes([MsgType.EVENT, 1]) + b"\x00" * 4,  # truncated gap
+            bytes([MsgType.EVENT, 1])
+            + b"\x00\x00\x00\x05\x00\x00\x00\x03",  # end < start
         ],
     )
     def test_rejected(self, payload):
@@ -165,6 +223,21 @@ class TestMalformed:
                 b"\x08" * 32,
                 [Transaction("a", "b", 1, f, f).serialize() for f in range(2)],
             ),
+            protocol.encode_getfilterheaders(3, 50),
+            protocol.encode_filterheaders(3, [bytes([i]) * 32 for i in range(2)]),
+            protocol.encode_subscribe([b"alice"], (4, b"\x0d" * 32)),
+            protocol.encode_unsubscribe(),
+            protocol.encode_event(
+                protocol.BlockEvent(
+                    5,
+                    _block().header.serialize(),
+                    b"\x0e" * 32,
+                    b"\x01\x02",
+                    True,
+                    (b"\x0f" * 32,),
+                )
+            ),
+            protocol.encode_event_gap(2, 6),
             protocol.encode_proof(None),
             protocol.encode_proof(
                 TxProof(

@@ -38,7 +38,9 @@ from p1_tpu.core.header import BlockHeader
 from p1_tpu.core.tx import Transaction
 from p1_tpu.node import Node, protocol
 from p1_tpu.node.client import (
+    CommitmentViolation,
     filter_scan,
+    get_filter_headers,
     get_filters,
     get_headers,
     get_proof,
@@ -854,6 +856,222 @@ class TestReplica:
         run(scenario())
 
 
+# -- the commitment chain on the wire (round 21) --------------------------
+
+
+def _paid_heights(chain, item: bytes) -> set:
+    return {
+        h
+        for h in range(1, chain.height + 1)
+        if any(
+            tx.recipient.encode() == item or tx.sender.encode() == item
+            for tx in chain.get(chain.main_hash_at(h)).txs
+        )
+    }
+
+
+def _watch_target(chain, floor: int = 3):
+    """A watched account the fixture pays at height >= ``floor`` (the
+    tx mix varies with the hash seed; the tested property must not)."""
+    for label in ("bob", "carol", "dave", "alice"):
+        item = account(label).encode()
+        paid = _paid_heights(chain, item)
+        if paid and max(paid) >= floor:
+            return item, paid
+    raise AssertionError("fixture pays nobody late enough")
+
+
+class TestCommitmentChainServing:
+    def test_served_filter_headers_equal_local_derivation(self):
+        """GETFILTERHEADERS against a live node: the served chain is
+        exactly H(filter_hash || prev) over the node's own blocks,
+        genesis-anchored — and a span past the committed tip is an
+        honest refusal (short/empty), never a partial lie."""
+
+        async def scenario():
+            node = Node(_config())
+            await node.start()
+            try:
+                await fund(node, "alice", blocks=3)
+                tip = node.chain.height
+                served = await get_filter_headers(
+                    "127.0.0.1", node.port, 0, tip + 1, DIFF
+                )
+                assert len(served) == tip + 1
+                prev = fmod.GENESIS_FILTER_HEADER
+                for h in range(tip + 1):
+                    fbytes = node.chain.block_filter(
+                        node.chain.main_hash_at(h)
+                    )
+                    prev = fmod.next_filter_header(
+                        fmod.filter_hash(fbytes), prev
+                    )
+                    assert served[h] == prev
+                # Honest refusal past the tip.
+                assert (
+                    await get_filter_headers(
+                        "127.0.0.1", node.port, tip + 1, 5, DIFF
+                    )
+                    == []
+                )
+                # A span only PARTLY committed is refused whole too —
+                # all-or-nothing per request, never a partial answer.
+                assert (
+                    await get_filter_headers(
+                        "127.0.0.1", node.port, tip, 2, DIFF
+                    )
+                    == []
+                )
+                exact = await get_filter_headers(
+                    "127.0.0.1", node.port, tip, 1, DIFF
+                )
+                assert exact == [served[tip]]
+            finally:
+                await node.stop()
+
+        run(scenario())
+
+    def test_mid_scan_reorg_drops_stale_matches(self, tmp_path):
+        """Satellite: the peer reorgs between the header sync and the
+        filter page — served filters above the fork carry block hashes
+        the skeleton never pinned.  The scan must stop at the
+        divergence and drop the stale tail's matches.  Control arm
+        first: the unforged replica DOES serve those tail matches, and
+        the forged tail's filters are built to match the watch item —
+        so a scan that believed unpinned filters would have reported
+        them (the assertion cannot pass vacuously)."""
+        store = tmp_path / "chain.dat"
+        chain = build_chain(8, difficulty=1)
+        item, paid = _watch_target(chain)
+        k = max(paid)  # forge from the last paid height up
+        save_chain(chain, store)
+
+        async def scenario():
+            srv = await serve_replica(store, 1, refresh_interval_s=60.0)
+            try:
+                _, control = await filter_scan(
+                    "127.0.0.1", srv.port, [item], 1, fetch_blocks=False
+                )
+                control_heights = {h for h, _ in control}
+                assert paid <= control_heights  # zero false negatives
+                assert k in control_heights  # the tail match exists
+
+                real_range = srv.view.filters_range
+
+                def reorged_range(start, count):
+                    out = []
+                    for i, (bhash, f) in enumerate(real_range(start, count)):
+                        h = start + i
+                        if h >= k:
+                            fake = bytes([h & 0xFF]) * 32
+                            out.append(
+                                (fake, fmod.encode_filter(fake, {item}))
+                            )
+                        else:
+                            out.append((bhash, f))
+                    return out
+
+                srv.view.filters_range = reorged_range
+                headers, matches = await filter_scan(
+                    "127.0.0.1", srv.port, [item], 1, fetch_blocks=False
+                )
+                got = {h for h, _ in matches}
+                assert got == {h for h in control_heights if h < k}
+                # The pinned prefix is still commitment-verified and the
+                # skeleton is intact — a partial answer, not a wreck.
+                assert len(headers) == chain.height + 1
+            finally:
+                await srv.stop()
+
+        run(scenario())
+
+    def test_incoherent_forger_is_caught_by_its_own_commitments(
+        self, tmp_path
+    ):
+        """Forged filters WITHOUT a recomputed commitment chain: the
+        scan replays H(filter_hash || prev) over the served stream and
+        the peer's own fheaders disprove it — CommitmentViolation with
+        no second peer needed."""
+        store = tmp_path / "chain.dat"
+        chain = build_chain(5, difficulty=1)
+        save_chain(chain, store)
+
+        async def scenario():
+            srv = await serve_replica(store, 1, refresh_interval_s=60.0)
+            try:
+                real_range = srv.view.filters_range
+
+                def forged(start, count):
+                    return [
+                        (bhash, fmod.encode_filter(bhash, {b"swapped"}))
+                        if start + i >= 3
+                        else (bhash, f)
+                        for i, (bhash, f) in enumerate(
+                            real_range(start, count)
+                        )
+                    ]
+
+                srv.view.filters_range = forged
+                with pytest.raises(CommitmentViolation):
+                    await filter_scan(
+                        "127.0.0.1", srv.port, [b"whatever"], 1,
+                        fetch_blocks=False,
+                    )
+            finally:
+                await srv.stop()
+
+        run(scenario())
+
+    def test_coherent_forger_demoted_scan_fails_over(self, tmp_path):
+        """The stronger liar recomputes its whole commitment chain over
+        forged filters (self-consistent, locally unfalsifiable).  With
+        one honest fallback the cross-check disagrees, the hash-pinned
+        block at the divergence names the liar, and the scan fails over
+        — returning every confirmation the liar tried to hide."""
+        store = tmp_path / "chain.dat"
+        chain = build_chain(8, difficulty=1)
+        item, paid = _watch_target(chain)
+        k = max(paid)
+        save_chain(chain, store)
+
+        async def scenario():
+            liar = await serve_replica(store, 1, refresh_interval_s=60.0)
+            honest = await serve_replica(store, 1, refresh_interval_s=60.0)
+            try:
+                # Recompute the liar's committed chain over forged
+                # filters from k up — linkage verifies, content lies.
+                entries = liar.view.filter_headers._entries
+                forged = {}
+                prev = entries[k - 1][1]
+                for h in range(k, len(entries)):
+                    bhash = entries[h][0]
+                    fake = fmod.encode_filter(bhash, {b"elsewhere"})
+                    forged[h] = fake
+                    prev = fmod.next_filter_header(
+                        fmod.filter_hash(fake), prev
+                    )
+                    entries[h] = (bhash, prev)
+                real_range = liar.view.filters_range
+                liar.view.filters_range = lambda start, count: [
+                    (bhash, forged.get(start + i, f))
+                    for i, (bhash, f) in enumerate(real_range(start, count))
+                ]
+
+                headers, matches = await filter_scan(
+                    "127.0.0.1", liar.port, [item], 1,
+                    fallback_peers=[("127.0.0.1", honest.port)],
+                )
+                got = {h for h, _ in matches}
+                assert paid <= got  # k's hidden confirmation included
+                for h, block in matches:
+                    assert block.block_hash() == chain.main_hash_at(h)
+            finally:
+                await liar.stop()
+                await honest.stop()
+
+        run(scenario())
+
+
 # -- soaks ----------------------------------------------------------------
 
 
@@ -970,6 +1188,7 @@ class TestImportHealthExtension:
         for name in (
             "p1_tpu.chain.filters",
             "p1_tpu.node.queryplane",
+            "p1_tpu.node.subscriptions",
         ):
             importlib.import_module(name)
 

@@ -290,6 +290,19 @@ STATUS_KEYS = [
     "storage.recoveries",
     "storage.retries",
     "storage.segmented",
+    "subscriptions",
+    "subscriptions.cursor_rejects",
+    "subscriptions.disconnects_error",
+    "subscriptions.disconnects_hard",
+    "subscriptions.events_coalesced",
+    "subscriptions.events_dropped",
+    "subscriptions.events_pushed",
+    "subscriptions.filter_headers",
+    "subscriptions.gap_events",
+    "subscriptions.live",
+    "subscriptions.queue_depth_bytes",
+    "subscriptions.replayed",
+    "subscriptions.subscribed_total",
     "sync",
     "sync.cblock_fetch_stalls",
     "sync.demotions",
