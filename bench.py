@@ -375,6 +375,43 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Fleet provisioning (round 22): snapshot cold-start seconds for a
+    # `p1 serve --bootstrap` replica and the kill-one-replica notify
+    # p95 (benchmarks/wallet_plane.py bench_fleet_quick — 3 replicas x
+    # 24 spread sessions, most-loaded replica killed mid-push).  LOWER
+    # is better for both; fleet_missed must be 0 regardless of load
+    # (a missed confirmation is a bug, not a regression).
+    from p1_tpu.hashx.perf_record import (
+        FLEET_DEGRADED_FACTOR,
+        RECORDED_FLEET_COLD_START_S,
+        RECORDED_FLEET_NOTIFY_P95_MS,
+    )
+
+    try:
+        from benchmarks.wallet_plane import bench_fleet_quick
+
+        fp = bench_fleet_quick()
+        extra["fleet_cold_start_s"] = fp["fleet_cold_start_s"]
+        extra["fleet_notify_p95_ms"] = fp["fleet_notify_p95_ms"]
+        extra["fleet_failovers"] = fp["fleet_failovers"]
+        extra["fleet_missed"] = fp["fleet_missed"]
+        extra["fleet_cold_start_vs_recorded"] = round(
+            fp["fleet_cold_start_s"] / RECORDED_FLEET_COLD_START_S, 2
+        )
+        extra["fleet_notify_vs_recorded"] = round(
+            fp["fleet_notify_p95_ms"] / RECORDED_FLEET_NOTIFY_P95_MS, 2
+        )
+        if (
+            fp["fleet_missed"] > 0
+            or fp["fleet_cold_start_s"]
+            > FLEET_DEGRADED_FACTOR * RECORDED_FLEET_COLD_START_S
+            or fp["fleet_notify_p95_ms"]
+            > FLEET_DEGRADED_FACTOR * RECORDED_FLEET_NOTIFY_P95_MS
+        ):
+            extra["fleet_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Deterministic network simulator (round 10): node-seconds of
     # simulated mesh per wall second on a quick 100-node partition-heal
     # (benchmarks/netsim_scale.py scales linearly enough that the small

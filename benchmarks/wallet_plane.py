@@ -24,6 +24,16 @@ Measurements:
   `client.watch` session subscribed to the recipient account; wall
   time from send_tx() to the verified matched EVENT arriving (submit,
   mine/confirm, filter build, push, client-side commitment check).
+- **fleet_*** (round 22) — the fleet-provisioning figures:
+  ``fleet_cold_start_s`` is `p1 serve --bootstrap`'s
+  decide-to-serving-ready wall time (snapshot-based, bounded by blocks
+  above the base — not chain length), and the ``bench_fleet`` family
+  is the kill-one-replica proof at wall-clock scale: N replicas x many
+  ReplicaSet-spread sessions on one store, the most-loaded replica
+  killed mid-push, per-event notify p95 split before/after the kill,
+  failovers, peak ``subs.queue_depth_bytes`` on survivors, and
+  ``fleet_missed`` (sessions whose stream went non-contiguous or
+  unmatched — the acceptance bar is 0).
 
 JSON: {"metric": "wallet_subs", "value": ..., "notify_p95_ms": ...}
 — one line, measured, no estimates (the bench.py contract).
@@ -233,6 +243,227 @@ def bench_quick(subs: int = 20_000, measure_blocks: int = 8) -> dict:
     return bench_subs(subs=subs, warm_blocks=2, measure_blocks=measure_blocks)
 
 
+def bench_cold_start(
+    chain_blocks: int = 60,
+    difficulty: int = 12,
+    snapshot_interval: int = 16,
+) -> dict:
+    """Replica cold-start figure (round 22): wall seconds from `p1
+    serve --bootstrap <node>` deciding to join until its store is
+    serving-ready — PoW-verified header skeleton, chunk-verified
+    snapshot pinned to it, adopted filter headers, bodies above the
+    base (node/provision.py bootstrap_store).  The point of the figure:
+    it is bounded by blocks ABOVE the snapshot base, not by chain
+    length — an IBD is bounded by chain length."""
+    import tempfile
+
+    from p1_tpu.chain.store import ChainStore
+    from p1_tpu.config import NodeConfig
+    from p1_tpu.node.node import Node
+    from p1_tpu.node.provision import bootstrap_store
+    from p1_tpu.node.testing import make_blocks
+
+    blocks = make_blocks(chain_blocks, difficulty, miner_id="fleet-src")
+
+    async def _run() -> dict:
+        with tempfile.TemporaryDirectory() as d:
+            src = str(Path(d) / "node.dat")
+            st = ChainStore(src, fsync=False)
+            try:
+                for b in blocks[1:]:
+                    st.append(b)
+                st.sync()
+            finally:
+                st.close()
+            node = Node(
+                NodeConfig(
+                    host="127.0.0.1",
+                    port=0,
+                    difficulty=difficulty,
+                    mine=False,
+                    store_path=src,
+                    snapshot_interval=snapshot_interval,
+                )
+            )
+            await node.start()
+            try:
+                report = await bootstrap_store(
+                    str(Path(d) / "replica.dat"),
+                    [("127.0.0.1", node.port)],
+                    difficulty,
+                )
+            finally:
+                await node.stop()
+            return {
+                "fleet_cold_start_s": report["cold_start_s"],
+                "fleet_cold_start_base": report["base"],
+                "fleet_cold_start_tip": report["tip"],
+                "fleet_cold_start_blocks_fetched": report["blocks_fetched"],
+            }
+
+    return asyncio.run(_run())
+
+
+def bench_fleet(
+    replicas: int = 3,
+    sessions: int = 48,
+    blocks: int = 12,
+    kill_at: int = 6,
+    difficulty: int = 12,
+    interval_s: float = 0.2,
+) -> dict:
+    """The kill-one-replica figure (round 22): ``replicas`` replica
+    workers on ONE chain store, ``sessions`` wallet watch sessions
+    spread across them by ReplicaSet policy (distinct spread keys), a
+    writer appending one block per ``interval_s`` — and the most-loaded
+    replica killed mid-push at height ``kill_at``.  Measured: per-event
+    notify latency (append-to-verified-arrival) p95 overall and split
+    before/after the kill (the "p95 stays flat" claim), total
+    failovers, peak ``subs.queue_depth_bytes`` on the survivors, and
+    missed confirmations (every block pays the watched account, so a
+    session's stream must stay contiguous and fully matched — missed ==
+    0 is the acceptance bar, not a statistic)."""
+    import tempfile
+
+    from p1_tpu.chain.store import ChainStore
+    from p1_tpu.node.client import ReplicaSet, watch
+    from p1_tpu.node.queryplane import serve_replica
+    from p1_tpu.node.testing import make_blocks
+
+    WARM = 2
+    chain_blocks = make_blocks(blocks, difficulty, miner_id="fleet-acct")
+
+    async def _run() -> dict:
+        with tempfile.TemporaryDirectory() as d:
+            store_path = str(Path(d) / "fleet.dat")
+            store = ChainStore(store_path, fsync=False)
+            for h in range(1, WARM + 1):
+                store.append(chain_blocks[h], h)
+            store.sync()
+
+            srvs = [
+                await serve_replica(
+                    store_path, difficulty, refresh_interval_s=0.02
+                )
+                for _ in range(replicas)
+            ]
+            targets = [("127.0.0.1", s.port) for s in srvs]
+            sets = [
+                ReplicaSet(targets, spread_key=k) for k in range(sessions)
+            ]
+            arrivals: list[dict[int, float]] = [{} for _ in range(sessions)]
+            streams: list[list] = [[] for _ in range(sessions)]
+
+            async def _session(k: int) -> None:
+                try:
+                    async for ev in watch(
+                        "127.0.0.1", srvs[0].port, ["fleet-acct"],
+                        difficulty, replica_set=sets[k],
+                        cross_check_every=0, reconnect_delay_s=0.05,
+                        max_session_failures=None,
+                    ):
+                        arrivals[k][ev["height"]] = time.perf_counter()
+                        streams[k].append(ev)
+                except asyncio.CancelledError:
+                    raise
+
+            tasks = [
+                asyncio.create_task(_session(k)) for k in range(sessions)
+            ]
+            # All ears before the measured appends.
+            for _ in range(600):
+                if sum(len(s.subscriptions) for s in srvs) >= sessions:
+                    break
+                await asyncio.sleep(0.02)
+
+            appended_at: dict[int, float] = {}
+            killed = None
+            queue_peak = 0
+            for h in range(WARM + 1, blocks + 1):
+                store.append(chain_blocks[h], h)
+                store.sync()
+                appended_at[h] = time.perf_counter()
+                if h == kill_at:
+                    # The directed kill: the replica carrying the most
+                    # active sessions, mid-push.
+                    tally = {}
+                    for s in sets:
+                        if s.active is not None:
+                            tally[s.active] = tally.get(s.active, 0) + 1
+                    victim = max(sorted(tally), key=lambda t: tally[t])
+                    killed = targets.index(victim)
+                    await srvs[killed].stop()
+                await asyncio.sleep(interval_s)
+                queue_peak = max(
+                    queue_peak,
+                    *(
+                        s.subscriptions.queue_depth_bytes
+                        for i, s in enumerate(srvs)
+                        if i != killed
+                    ),
+                )
+            # Every session must reach the final height (failover done).
+            for _ in range(600):
+                if all(blocks in a for a in arrivals):
+                    break
+                await asyncio.sleep(0.05)
+            for task in tasks:
+                task.cancel()
+            for task in tasks:
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            for i, s in enumerate(srvs):
+                if i != killed:
+                    await s.stop()
+            store.close()
+
+            pre, post = [], []
+            for a in arrivals:
+                for h, t in a.items():
+                    if h in appended_at:
+                        (pre if h <= kill_at else post).append(
+                            (t - appended_at[h]) * 1000.0
+                        )
+            def _p95(xs):
+                if not xs:
+                    return None
+                xs = sorted(xs)
+                return round(xs[min(len(xs) - 1, int(0.95 * len(xs)))], 2)
+            missed = 0
+            for s in streams:
+                hs = [ev["height"] for ev in s]
+                if hs != list(range(hs[0], hs[0] + len(hs))) or not all(
+                    ev["matched"] for ev in s
+                ):
+                    missed += 1
+            return {
+                "fleet_replicas": replicas,
+                "fleet_sessions": sessions,
+                "fleet_killed_replica": killed,
+                "fleet_failovers": sum(s.failovers for s in sets),
+                "fleet_missed": missed,
+                "fleet_notify_p95_ms": _p95(pre + post),
+                "fleet_notify_p95_pre_kill_ms": _p95(pre),
+                "fleet_notify_p95_post_kill_ms": _p95(post),
+                "fleet_queue_depth_bytes_peak": queue_peak,
+            }
+
+    return asyncio.run(_run())
+
+
+def bench_fleet_quick(replicas: int = 3, sessions: int = 24) -> dict:
+    """The bench.py hook: a small kill-one-replica run plus the
+    cold-start figure — fast enough for the headline bench, shaped
+    exactly like the acceptance run."""
+    out = bench_fleet(
+        replicas=replicas, sessions=sessions, blocks=10, kill_at=5
+    )
+    out.update(bench_cold_start(chain_blocks=48))
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--subs", type=int, default=100_000)
@@ -243,7 +474,38 @@ def main() -> None:
         action="store_true",
         help="skip the real-socket submit->confirm->push measurement",
     )
+    ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="run the kill-one-replica fleet figure instead of the "
+        "single-node push plane",
+    )
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument(
+        "--sessions",
+        type=int,
+        default=48,
+        help="wallet sessions spread across the fleet (--fleet)",
+    )
     args = ap.parse_args()
+
+    if args.fleet:
+        res = bench_fleet(replicas=args.replicas, sessions=args.sessions)
+        res.update(bench_cold_start())
+        import os
+
+        print(
+            json.dumps(
+                {
+                    "metric": "fleet_notify_p95_ms",
+                    "value": res["fleet_notify_p95_ms"],
+                    "unit": "ms",
+                    "cpu_count": os.cpu_count(),
+                    **res,
+                }
+            )
+        )
+        return
 
     res = bench_subs(
         subs=args.subs, measure_blocks=args.blocks, txs=args.txs

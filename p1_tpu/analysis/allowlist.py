@@ -50,6 +50,11 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
             "asyncio.sleep": "light-client backoff sleeps ride the "
             "caller's loop (virtual under netsim)",
         },
+        "node/provision.py": {
+            "asyncio.sleep": "UpstreamSync poll interval rides the "
+            "caller's loop (virtual under netsim); bootstrap runs "
+            "before the replica serves, outside any injected Clock",
+        },
         # -- the simulator itself: sleeps are virtual here, and
         #    time.monotonic guards REAL wall budgets (SimWallTimeout)
         #    plus the scenario reports' wall_s — deliberate host reads.
@@ -184,6 +189,14 @@ GRANTS: dict[str, dict[str, dict[str, str]]] = {
             "worker startup before any session exists; steady-state "
             "refreshes only stat/remap the tail — stays on-loop by "
             "design",
+        },
+        "node/provision.py": {
+            "bootstrap_store->open": "startup-only: cold start runs "
+            "BEFORE the replica serves its first frame — no session "
+            "exists to stall, and the store appends/syncs already "
+            "ride asyncio.to_thread; the residual on-loop IO is the "
+            "bootbase sidecar write and snapshot spool, once per "
+            "bootstrap by design",
         },
     },
     # -- escaped-state (round 16): await-state folded one call level.

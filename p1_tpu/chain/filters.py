@@ -406,6 +406,18 @@ class FilterHeaderChain:
             return []
         return [h for _, h in self._entries[start : start + count]]
 
+    def seed(self, entries: list[tuple[bytes, bytes]]) -> None:
+        """Adopt a ``(block_hash, filter_header)`` prefix wholesale — the
+        snapshot-bootstrapped replica's case (node/provision.py): the
+        bodies below the snapshot base are not on disk, so the prefix
+        cannot be recomputed locally; it is adopted from the bootstrap
+        peer under the same trust model as the assumed snapshot itself
+        (any forgery diverges from every honest server at the first
+        adopted height, which is exactly what the wallet cross-check and
+        hash-pinned adjudication catch).  Replaces the whole chain;
+        ``sync()`` then extends from the adopted tip using real bodies."""
+        self._entries = [(bytes(bh), bytes(fh)) for bh, fh in entries]
+
     def sync(self, tip_height: int, hash_at, filter_at) -> list[int]:
         """Advance (or repair) the chain against a source of truth;
         returns the heights whose commitments are new or changed — the

@@ -539,10 +539,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=9444)
     p.add_argument(
         "--fallback",
+        action="append",
         nargs="*",
         default=[],
-        help="host:port replicas to fail over to when the primary dies "
-        "or is caught lying (also the cross-check sources)",
+        metavar="HOST:PORT",
+        help="replica to fail over to when the active target dies or is "
+        "caught lying (also a cross-check source); repeatable, and each "
+        "use also accepts a space-separated list",
+    )
+    p.add_argument(
+        "--fallback-file",
+        default=None,
+        metavar="PATH",
+        help="file of host:port replicas, one per line (# comments and "
+        "blank lines ignored) — the fleet roster an orchestrator "
+        "rewrites as replicas join and leave",
     )
     p.add_argument(
         "--deadline",
@@ -727,6 +738,18 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=0.25,
         help="seconds between tail rescans for blocks the node appended",
+    )
+    p.add_argument(
+        "--bootstrap",
+        action="append",
+        default=[],
+        metavar="HOST:PORT",
+        help="cold-start the store from this full node before serving: "
+        "PoW-verified header skeleton, chunk-verified snapshot, adopted "
+        "filter headers, then bodies above the base — seconds, not an "
+        "IBD; repeatable (extra peers are failovers and the cross-check "
+        "source); the worker then keeps pulling new blocks from the "
+        "bootstrap peers while it serves",
     )
     p.add_argument(
         "--deadline",
@@ -1712,6 +1735,26 @@ def cmd_watch(args) -> int:
 
     items = [args.account, *(_item(s) for s in args.item)]
 
+    from pathlib import Path
+
+    # --fallback is repeatable and each use takes a list; --fallback-file
+    # adds a host:port-per-line roster.  Order is preserved (flag order,
+    # then file order) and duplicates collapse — the ReplicaSet inside
+    # client.watch treats the order as the tie-break preference.
+    specs: list[str] = []
+    for group in args.fallback:
+        specs.extend(group if isinstance(group, list) else [group])
+    if args.fallback_file is not None:
+        try:
+            for line in Path(args.fallback_file).read_text().splitlines():
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    specs.append(line)
+        except OSError as e:
+            print(f"watch failed: --fallback-file: {e}", file=sys.stderr)
+            return 2
+    fallbacks = list(dict.fromkeys(_addr(s) for s in specs))
+
     async def _run() -> int:
         gen = watch(
             args.host,
@@ -1719,7 +1762,7 @@ def cmd_watch(args) -> int:
             items,
             args.difficulty,
             retarget=rule,
-            fallback_peers=[_addr(s) for s in args.fallback],
+            fallback_peers=fallbacks,
             cross_check_every=args.cross_check_every,
             max_session_failures=args.max_session_failures,
         )
@@ -1737,6 +1780,8 @@ def cmd_watch(args) -> int:
                             if ev["matched"]
                             else [],
                             "peer": f"{ev['peer'][0]}:{ev['peer'][1]}",
+                            "target": f"{ev['peer'][0]}:{ev['peer'][1]}",
+                            "failovers": ev["failovers"],
                         }
                     ),
                     flush=True,
@@ -1943,6 +1988,7 @@ def cmd_serve(args) -> int:
     scales with cores.  Prints one JSON line per worker with the bound
     port once serving."""
     import os
+    import signal
 
     from p1_tpu.node.queryplane import serve_replica
 
@@ -1954,7 +2000,34 @@ def cmd_serve(args) -> int:
         print("--workers must be >= 1", file=sys.stderr)
         return 2
 
-    def _worker() -> int:
+    def _addr(spec: str) -> tuple[str, int]:
+        host, _, port = spec.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+
+    upstreams = [_addr(s) for s in args.bootstrap]
+    if upstreams:
+        # Cold start BEFORE any worker serves (and before SO_REUSEPORT
+        # forks — exactly one process writes the store): PoW-verified
+        # skeleton, chunk-verified snapshot pinned to it, adopted filter
+        # headers, bodies above the base.  node/provision.py.
+        from p1_tpu.node.provision import BootstrapError, bootstrap_store
+
+        try:
+            report = asyncio.run(
+                bootstrap_store(
+                    args.store,
+                    upstreams,
+                    args.difficulty,
+                    retarget=retarget,
+                    progress=lambda m: print(f"bootstrap: {m}", file=sys.stderr),
+                )
+            )
+        except (BootstrapError, ConnectionError, OSError, ValueError) as e:
+            print(f"serve failed: bootstrap: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps({"config": "bootstrap", **report}), flush=True)
+
+    def _worker(primary: bool = True) -> int:
         async def _run() -> int:
             try:
                 srv = await serve_replica(
@@ -1969,6 +2042,23 @@ def cmd_serve(args) -> int:
             except (OSError, ValueError) as e:
                 print(f"serve failed: {e}", file=sys.stderr)
                 return 1
+            sync = None
+            if upstreams and primary:
+                # Only the primary worker writes the store; siblings see
+                # the appends through their own refresh loops.
+                from p1_tpu.chain.store import ChainStore
+                from p1_tpu.node.provision import UpstreamSync
+
+                sync_store = ChainStore(args.store, fsync=False)
+                sync = UpstreamSync(
+                    sync_store,
+                    srv.view,
+                    upstreams,
+                    args.difficulty,
+                    retarget=retarget,
+                    poll_interval_s=max(args.refresh_interval, 0.25),
+                )
+                sync.start()
             print(
                 json.dumps(
                     {
@@ -1976,21 +2066,43 @@ def cmd_serve(args) -> int:
                         "port": srv.port,
                         "height": srv.view.tip_height,
                         "records": srv.view.records,
+                        "assumed_base": srv.view.assumed_base,
                         "pid": os.getpid(),
                     }
                 ),
                 flush=True,
             )
+            # Graceful drain on SIGTERM: stop accepting, push every live
+            # session a final cursor marker, then exit 0 — a wallet sees
+            # an ordinary gap event and fails over mid-stream, not a
+            # dead socket it must time out on.
+            term = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            try:
+                loop.add_signal_handler(signal.SIGTERM, term.set)
+            except (NotImplementedError, RuntimeError):
+                pass  # non-unix / nested loop: deadline still works
             try:
                 if args.deadline is not None:
-                    await asyncio.sleep(args.deadline)
+                    await asyncio.wait_for(term.wait(), args.deadline)
                 else:
-                    while True:
-                        await asyncio.sleep(3600)
+                    await term.wait()
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
             except asyncio.CancelledError:
                 pass
             finally:
-                await srv.stop()
+                if sync is not None:
+                    await sync.stop()
+                    sync_store.close()
+                drained = await srv.drain()
+                print(
+                    json.dumps(
+                        {"config": "drain", "sessions": drained,
+                         "pid": os.getpid()}
+                    ),
+                    flush=True,
+                )
             return 0
 
         try:
@@ -2003,7 +2115,9 @@ def cmd_serve(args) -> int:
         import multiprocessing
 
         for _ in range(args.workers - 1):
-            proc = multiprocessing.Process(target=_worker, daemon=True)
+            proc = multiprocessing.Process(
+                target=_worker, args=(False,), daemon=True
+            )
             proc.start()
             procs.append(proc)
     try:

@@ -180,7 +180,16 @@ def generate_schedule(
     - ``sub_flood`` — a GreedyPeer hammering the subscription plane
       (SUBSCRIBE churn plus unverifiable resume cursors): the
       degradation ladder and admission tables must shed it without
-      harming honest watchers.
+      harming honest watchers;
+    - ``replica_kill`` / ``replica_join`` — the fleet-provisioning
+      family (round 22): ``replica_kill`` crashes the node a live
+      watcher's ReplicaSet is actively riding (the directed
+      kill-one-replica, resolved at runtime from the wallet's own
+      ``active`` pointer; the scheduled node is the fallback victim
+      when no watcher is live), and ``replica_join`` spawns an honest
+      snapshot-bootstrapped joiner AND rebalances every live watcher's
+      ReplicaSet onto it (``update_targets``) — wallets must fail over
+      and re-spread with ZERO missed confirmations either way.
     """
     rng = random.Random((seed << 3) ^ 0xC4A05)
     joiners: set[int] = set()
@@ -233,6 +242,12 @@ def generate_schedule(
             ops.append(("watch_stop", 0.5))
         if hostiles < 2:
             ops.append(("sub_flood", 0.5))
+        # Fleet provisioning (round 22): kill the replica a wallet is
+        # riding, and join a fresh one into live ReplicaSets.
+        if watchers and len(crashed) < max(1, n_nodes - 2):
+            ops.append(("replica_kill", 0.75))
+        if len(joiners) < MAX_JOINERS:
+            ops.append(("replica_join", 0.75))
         # Segmented-store plane (round 18).  ``seg_roll`` forces a live
         # node's active segment to seal mid-mesh; ``prune`` discards a
         # live node's deep body segments while it serves (at most one
@@ -339,6 +354,19 @@ def generate_schedule(
             ev["watcher"] = slot
             ev["node"] = rng.randrange(n_nodes)
             watchers.add(slot)
+        elif op == "replica_kill":
+            # The true victim (a live watcher's active target) is only
+            # knowable at runtime; ``node`` is the fallback victim and
+            # the conservative bookkeeping entry — whoever actually
+            # dies, later events on dead targets degrade to no-ops.
+            victims = [i for i in range(n_nodes) if i not in crashed]
+            ev["node"] = rng.choice(victims)
+            crashed.add(ev["node"])
+        elif op == "replica_join":
+            slot = n_nodes + len(joiners)
+            ev["node"] = slot
+            ev["peers"] = sorted(rng.sample(range(n_nodes), min(2, n_nodes)))
+            joiners.add(slot)
         elif op == "watch_stop":
             ev["watcher"] = rng.choice(sorted(watchers))
             watchers.discard(ev["watcher"])
@@ -861,6 +889,8 @@ class _Watcher:
     def __init__(
         self, net, serial, primary, fallbacks, item, difficulty, mute=False
     ):
+        from p1_tpu.node.client import ReplicaSet
+
         self.net = net
         self.serial = serial
         self.primary = primary
@@ -870,6 +900,13 @@ class _Watcher:
         self.item = item
         self.difficulty = difficulty
         self.mute = mute
+        # The wallet-side fleet policy (round 22): health-scored target
+        # selection with live rebalancing.  spread_key=0 keeps the
+        # schedule's named primary as the first dial (all targets start
+        # tied, join order breaks the tie), so schedule semantics read
+        # the same as the old rotation — the policy differences show up
+        # under faults, which is where they belong.
+        self.rs = ReplicaSet(self.targets, spread_key=0)
         self.events: list[dict] = []
         self.by_height: dict[int, dict] = {}  # height -> LAST event there
         self.floor: int | None = None
@@ -877,6 +914,14 @@ class _Watcher:
         self.error: str | None = None
         self._last_h: int | None = None
         self._task: asyncio.Task | None = None
+
+    def add_target(self, host: str) -> None:
+        """A freshly provisioned replica joined the serving set: fold it
+        into the live watch's ReplicaSet (op ``replica_join``)."""
+        t = (host, NODE_PORT)
+        if t not in self.targets:
+            self.targets.append(t)
+            self.rs.update_targets(self.targets)
 
     @property
     def live(self) -> bool:
@@ -918,7 +963,7 @@ class _Watcher:
                 NODE_PORT,
                 [self.item],
                 self.difficulty,
-                fallback_peers=self.targets[1:],
+                replica_set=self.rs,
                 transport=transport,
                 cross_check_every=0,
                 reconnect_delay_s=0.5,
@@ -1350,6 +1395,10 @@ class _ChaosRunner:
             self.actors.append(gp)
         elif op == "watch_start":
             await self._watch_start(ev)
+        elif op == "replica_kill":
+            await self._replica_kill(ev)
+        elif op == "replica_join":
+            await self._replica_join(ev)
         elif op == "watch_stop":
             w = self.watchers.pop(ev["watcher"], None)
             if w is not None:
@@ -1400,6 +1449,47 @@ class _ChaosRunner:
         self.watchers[slot] = w
         self._record("watch_start", primary, slot)
         await w.start()
+
+    async def _replica_kill(self, ev: dict) -> None:
+        """The directed kill-one-replica (op ``replica_kill``): crash
+        the node a live watcher's ReplicaSet is actively riding —
+        mid-push, which is exactly when the wallet-side failover must
+        replay the cursor gap-free.  Falls back to the scheduled node
+        when no watcher is live (subsets stay runnable)."""
+        victim = None
+        for slot in sorted(self.watchers):
+            w = self.watchers[slot]
+            if not w.live or w.rs.active is None:
+                continue
+            host = w.rs.active[0]
+            if host in self.net.nodes:
+                victim = host
+                break
+        if victim is None:
+            victim = self._alive(ev["node"])
+        if victim is None or victim not in self.net.nodes:
+            return
+        self._record("replica_kill", victim)
+        await self.net.crash_node(victim, torn=0)
+        self.counts["crashes"] += 1
+
+    async def _replica_join(self, ev: dict) -> None:
+        """Fleet growth (op ``replica_join``): an honest snapshot-
+        bootstrapped joiner enters the mesh (the same supervised
+        GETSNAPSHOT cold start ``p1 serve --bootstrap`` runs), and
+        every LIVE watcher's ReplicaSet rebalances onto it — the next
+        failover may land on the newcomer, which must serve the same
+        commitment chain as everyone else or be demoted."""
+        host = self.hosts[ev["node"]]
+        await self._snap_join(ev)
+        if host not in self.net.nodes:
+            return  # join refused (slot taken, no peers): no rebalance
+        folded = 0
+        for w in self.watchers.values():
+            if w.live:
+                w.add_target(host)
+                folded += 1
+        self._record("replica_join", host, folded)
 
     async def _snap_join(self, ev: dict, fault: str | None = None) -> None:
         """Spawn one snapshot-syncing joiner (op ``snap_join``), or one

@@ -971,6 +971,200 @@ async def filter_scan(
     return await asyncio.wait_for(_run(), timeout)
 
 
+class ReplicaSet:
+    """Wallet-side target selection over a replica fleet — the policy
+    that replaces ``watch``'s static fallback tuple (ROADMAP item 2's
+    fleet half).
+
+    The set holds an ordered list of replica addresses plus an optional
+    ``full_node`` of last resort, and scores every target from the
+    signals the watch loop already produces: dead/stalled sessions
+    (``note_stall``), EVENTGAP shedding (``note_gap``), verified events
+    (``note_event``), cross-check corroborations (``note_agreement``),
+    and proven commitment violations (``note_violation`` — permanent
+    demotion, same contract as ``watch``'s demoted set).  ``pick()``
+    returns the healthiest live replica, preferring targets whose
+    filter-header chains have agreed at cross-checks, and sheds to the
+    full node ONLY when every replica is demoted or mid-outage
+    (``SHED_AFTER`` consecutive dead sessions) — read capacity stays on
+    the replica tier unless the tier is actually gone.
+
+    ``spread_key`` rotates tie-breaks so a fleet of wallets started
+    with distinct keys (e.g. a session serial) spreads its
+    subscriptions across replicas instead of dog-piling the first
+    address.  ``update_targets`` rebalances live: a replica that died
+    leaves (its health forgotten), a freshly provisioned one joins cold
+    and, being unscored, is immediately eligible — the elastic-fleet
+    seam the chaos ``replica_join`` op drives.
+
+    Everything here is deterministic (no clock, no randomness): the
+    same signal sequence always selects the same targets, which is what
+    lets the chaos plane put ReplicaSet-driven wallets inside the
+    trace-digest contract."""
+
+    #: Consecutive dead/stalled sessions after which a replica counts
+    #: as mid-outage for the shed-to-full-node decision.
+    SHED_AFTER = 2
+
+    def __init__(self, replicas, *, full_node=None, spread_key: int = 0):
+        self.full_node = tuple(full_node) if full_node is not None else None
+        self.spread_key = int(spread_key)
+        self.demoted: set[tuple] = set()
+        self.failovers = 0
+        self.rebalances = 0
+        self.active: tuple | None = None
+        self._order: list[tuple] = []
+        self._health: dict[tuple, dict] = {}
+        self.update_targets(replicas)
+        self.rebalances = 0  # construction is not a rebalance
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    @staticmethod
+    def _fresh() -> dict:
+        return {
+            "stalls": 0,  # dead/stalled sessions, cumulative
+            "gaps": 0,  # EVENTGAP shed notices
+            "events": 0,  # verified events served
+            "agreements": 0,  # cross-check corroborations
+            "streak": 0,  # CONSECUTIVE stalls since the last event
+        }
+
+    def _h(self, target) -> dict:
+        t = tuple(target)
+        h = self._health.get(t)
+        if h is None:
+            h = self._health[t] = self._fresh()
+        return h
+
+    # -- policy signals ------------------------------------------------
+
+    def note_stall(self, target) -> None:
+        h = self._h(target)
+        h["stalls"] += 1
+        h["streak"] += 1
+
+    def note_gap(self, target) -> None:
+        self._h(target)["gaps"] += 1
+
+    def note_event(self, target) -> None:
+        h = self._h(target)
+        h["events"] += 1
+        h["streak"] = 0
+
+    def note_agreement(self, target) -> None:
+        self._h(target)["agreements"] += 1
+
+    def note_violation(self, target) -> None:
+        """Proven commitment violation: permanent demotion."""
+        self.demoted.add(tuple(target))
+
+    # -- membership ----------------------------------------------------
+
+    def update_targets(self, replicas) -> tuple[list, list]:
+        """Rebalance to a new replica list (a replica died, a fresh one
+        joined): returns ``(joined, left)``.  Health carries over for
+        replicas that persist; leavers are forgotten entirely (a
+        re-provisioned address starts cold), and demotions are NOT
+        forgotten — a liar that rejoins under the same address stays
+        demoted."""
+        new = list(dict.fromkeys(tuple(p) for p in replicas))
+        seen = set(new)
+        left = [t for t in self._order if t not in seen]
+        joined = [t for t in new if t not in self._health]
+        self._order = new
+        for t in joined:
+            self._health[t] = self._fresh()
+        for t in left:
+            self._health.pop(t, None)
+        if joined or left:
+            self.rebalances += 1
+        if (
+            self.active is not None
+            and self.active not in seen
+            and self.active != self.full_node
+        ):
+            self.active = None
+        return joined, left
+
+    def peers(self) -> list[tuple]:
+        """Every target (replicas, then the full node) — the universe
+        the watch cross-check corroborates against."""
+        out = list(self._order)
+        if self.full_node is not None and self.full_node not in out:
+            out.append(self.full_node)
+        return out
+
+    def live(self) -> list[tuple]:
+        return [t for t in self.peers() if t not in self.demoted]
+
+    # -- selection -----------------------------------------------------
+
+    def _score(self, target) -> float:
+        """Lower is better.  The consecutive-stall streak dominates
+        (a replica mid-outage must lose to any healthy one fast);
+        cumulative stalls and shed gaps drag; agreement at cross-checks
+        and served events earn bounded preference (bounded so a
+        long-lived favorite cannot become unsheddable)."""
+        h = self._h(target)
+        return (
+            4.0 * h["streak"]
+            + 1.0 * h["stalls"]
+            + 0.5 * h["gaps"]
+            - 2.0 * min(h["agreements"], 8)
+            - 0.05 * min(h["events"], 32)
+        )
+
+    def pick(self) -> tuple | None:
+        """The target the next session should dial: the healthiest live
+        replica (ties broken by ``spread_key``-rotated join order), the
+        full node when the replica tier is exhausted, None when every
+        target is demoted (the caller raises)."""
+        replicas = [t for t in self._order if t not in self.demoted]
+        node_ok = (
+            self.full_node is not None and self.full_node not in self.demoted
+        )
+        if not replicas:
+            return self.full_node if node_ok else None
+        if node_ok and all(
+            self._h(t)["streak"] >= self.SHED_AFTER for t in replicas
+        ):
+            return self.full_node
+        n = len(self._order)
+        return min(
+            replicas,
+            key=lambda t: (
+                self._score(t),
+                (self._order.index(t) - self.spread_key) % n,
+            ),
+        )
+
+    def mark_active(self, target) -> None:
+        """Record the target a session is now riding; counts a failover
+        whenever it differs from the previous one."""
+        t = tuple(target)
+        if self.active is not None and self.active != t:
+            self.failovers += 1
+        self.active = t
+
+    def snapshot(self) -> dict:
+        """The replica-health/selection surface (`p1 watch` JSON,
+        OBSERVABILITY.md catalog)."""
+        return {
+            "replicas": len(self._order),
+            "demoted": len(self.demoted),
+            "failovers": self.failovers,
+            "rebalances": self.rebalances,
+            "active": (
+                f"{self.active[0]}:{self.active[1]}" if self.active else None
+            ),
+            "health": {
+                f"{h}:{p}": dict(v) for (h, p), v in self._health.items()
+            },
+        }
+
+
 async def watch(
     host: str,
     port: int,
@@ -980,6 +1174,7 @@ async def watch(
     retarget=None,
     cursor: tuple[int, bytes] | None = None,
     fallback_peers=(),
+    replica_set: ReplicaSet | None = None,
     transport=None,
     handshake_timeout: float = 10.0,
     cross_check_every: int = 32,
@@ -1025,7 +1220,16 @@ async def watch(
     the ring still covers it, else resolved conservatively by failing
     over.  ``max_session_failures`` bounds consecutive dead sessions
     (None = retry forever; daemons bound the watch by deadline/cancel
-    instead)."""
+    instead).
+
+    Target selection: a ``replica_set`` (``ReplicaSet``) makes the
+    fleet policy explicit — health-scored selection, agreement
+    preference, shed-to-full-node, live rebalancing via
+    ``update_targets`` — and ``host``/``port`` are then ignored for
+    dialing (the set picks).  Without one, an internal set over
+    ``[(host, port), *fallback_peers]`` reproduces the classic
+    rotate-on-failure order (all targets start tied, so join order
+    breaks ties exactly like the old round-robin)."""
     from p1_tpu.chain.filters import (
         filter_hash,
         matches_any,
@@ -1040,12 +1244,16 @@ async def watch(
     if not items:
         raise ValueError("watch needs at least one watch item")
 
-    targets = [(host, port), *(tuple(p) for p in fallback_peers)]
-    demoted: set = set()
+    if replica_set is not None and fallback_peers:
+        raise ValueError("pass either replica_set or fallback_peers")
+    rs = (
+        replica_set
+        if replica_set is not None
+        else ReplicaSet([(host, port), *(tuple(p) for p in fallback_peers)])
+    )
     anchor = (int(cursor[0]), bytes(cursor[1])) if cursor is not None else None
     anchor_bhash: bytes | None = None
     ring: dict[int, tuple[bytes, bytes]] = {}  # height -> (bhash, fheader)
-    ti = 0
     failures = 0
     events_seen = 0
     last_violation: CommitmentViolation | None = None
@@ -1063,8 +1271,8 @@ async def watch(
         liar.  Raises CommitmentViolation when the SERVING peer loses
         (or when the divergence predates what this watch verified —
         conservative: fail over rather than keep riding a suspect)."""
-        for peer in targets:
-            if peer == serving or peer in demoted:
+        for peer in rs.peers():
+            if peer == serving or peer in rs.demoted:
                 continue
             try:
                 theirs = await get_filter_headers(
@@ -1076,7 +1284,11 @@ async def watch(
             if not theirs:
                 continue
             if theirs[0] == fheader:
-                return  # corroborated
+                # Corroborated: both chains agree — the agreement
+                # preference the selection policy feeds on.
+                rs.note_agreement(serving)
+                rs.note_agreement(peer)
+                return
             try:
                 mine_chain = await get_filter_headers(
                     *serving, 0, height + 1, difficulty,
@@ -1099,21 +1311,23 @@ async def watch(
             except net_errors + (ValueError,):
                 continue
             if verdict in ("other", "both"):
-                demoted.add(peer)
+                rs.note_violation(peer)
             if verdict in ("self", "both"):
                 raise CommitmentViolation(
                     f"{serving[0]}:{serving[1]} filter-header chain "
                     f"disproven against {peer[0]}:{peer[1]}"
                 )
+            else:
+                rs.note_agreement(serving)
             return
 
     while True:
-        live = [t for t in targets if t not in demoted]
-        if not live:
+        serving = rs.pick()
+        if serving is None:
             if last_violation is not None:
                 raise last_violation
             raise ConnectionError("all watch peers demoted")
-        serving = live[ti % len(live)]
+        rs.mark_active(serving)
         got_event = False
         try:
             async with _session(
@@ -1150,6 +1364,10 @@ async def watch(
                         # Drop-to-cursor notice: re-subscribe at our
                         # verified anchor; the server replays the hole
                         # as full events (no separate bridge protocol).
+                        # (A draining replica sends one of these as its
+                        # goodbye, then refuses the re-subscribe — the
+                        # net error below fails over cursor-intact.)
+                        rs.note_gap(serving)
                         bridge_rounds += 1
                         if bridge_rounds > 8:
                             raise ConnectionError(
@@ -1224,9 +1442,10 @@ async def watch(
                     got_event = True
                     failures = 0
                     events_seen += 1
+                    rs.note_event(serving)
                     if (
                         cross_check_every
-                        and len(live) > 1
+                        and len(rs.live()) > 1
                         and events_seen % cross_check_every == 0
                     ):
                         await _cross_check(serving, hv, expect_fh)
@@ -1237,19 +1456,20 @@ async def watch(
                         "matched": local_matched,
                         "txids": tuple(ev.txids),
                         "peer": serving,
+                        "failovers": rs.failovers,
                     }
         except CommitmentViolation as e:
             # Proven liar: never ask again, fail over at the verified
             # cursor — the next replica replays the missed window.
-            demoted.add(serving)
+            rs.note_violation(serving)
             last_violation = e
-            ti = 0
         except net_errors:
             # Dead/stalled/refusing session — not evidence of lying.
             # A session that dies before ANY event may mean the cursor
             # was refused (our anchor reorged away, or sits past a
             # pruned window): after repeated refusals, rewind the
             # anchor one verified ring step and try again.
+            rs.note_stall(serving)
             if not got_event:
                 failures += 1
                 if (
@@ -1263,5 +1483,4 @@ async def watch(
                         k = max(lower)
                         anchor = (k, ring[k][1])
                         anchor_bhash = ring[k][0]
-            ti += 1
             await asyncio.sleep(reconnect_delay_s)

@@ -184,10 +184,45 @@ class ReplicaView:
         self._tx_index: dict[bytes, bytes | list[bytes]] = {}
         self._main: list[bytes] = []
         self._tip: bytes = b""
+        #: Snapshot-bootstrap base (node/provision.py): when a
+        #: ``.bootbase`` sidecar sits next to the store, heights
+        #: ``1..assumed_base`` are ADOPTED — PoW-verified headers and
+        #: peer-served filter headers without bodies on disk (the
+        #: snapshot carries the state, not the history).  Queries below
+        #: the base refuse bodies/filters honestly, exactly like a
+        #: pruned archive; 0 = ordinary full store.
+        self.assumed_base = 0
+        self._boot_headers: list[bytes] = []  # heights 1..base
+        self._boot_fheaders: list[bytes] = []  # heights 0..base
+        self._load_bootbase()
         self._reset_index()
         self.refresh()
 
     # -- attach / rescan ---------------------------------------------------
+
+    def _load_bootbase(self) -> None:
+        """Read the ``.bootbase`` sidecar (if any) a snapshot bootstrap
+        left next to the store, and verify its adopted header prefix
+        actually links from OUR genesis — a sidecar written against a
+        different chain (or torn mid-write, which read_bootbase already
+        rejects) must fail the attach, not serve a phantom history."""
+        from p1_tpu.node.provision import read_bootbase
+
+        bb = read_bootbase(self.path)
+        if bb is None:
+            return
+        base, headers, fheaders = bb
+        prev = self.genesis.block_hash()
+        for hdr in headers:
+            if hdr[4:36] != prev:
+                raise ValueError(
+                    f"{self.path}: bootbase sidecar does not link from"
+                    " this chain's genesis"
+                )
+            prev = sha256d(hdr)
+        self.assumed_base = base
+        self._boot_headers = headers
+        self._boot_fheaders = fheaders
 
     def _reset_index(self) -> None:
         ghash = self.genesis.block_hash()
@@ -201,6 +236,39 @@ class ReplicaView:
         self._main = [ghash]
         self._tip = ghash
         self.records = 0
+        if self.assumed_base:
+            self._seed_bootbase()
+
+    def _seed_bootbase(self) -> None:
+        """Seed the adopted prefix (heights ``1..assumed_base``) into a
+        fresh index — called from every ``_reset_index`` so full rescans
+        (inode replaced, layout change) re-adopt the base before the
+        store's body records (all above the base) re-connect to it.
+        Adopted entries carry ``off=0`` with height > 0: the existing
+        raw_record contract already reads that as "no bytes anywhere",
+        which IS the honest body refusal below the base."""
+        work = self._entries[self._tip].work
+        prev_hash = self._tip
+        for h, hdr in enumerate(self._boot_headers, start=1):
+            bhash = sha256d(hdr)
+            work += 1 << _header_difficulty(hdr)
+            self._entries[bhash] = _Entry(h, work, hdr[4:36], 0, 0)
+            self._main.append(bhash)
+            prev_hash = bhash
+        self._tip = prev_hash
+        # Adopt the peer-served commitment prefix wholesale: filters
+        # below the base cannot be recomputed (no bodies), and sync()
+        # extends above it from real record bytes.  Only when shorter —
+        # a live rescan must not wipe commitments already derived.
+        if len(self.filter_headers) <= self.assumed_base:
+            self.filter_headers.seed(
+                list(
+                    zip(
+                        self._main[: self.assumed_base + 1],
+                        self._boot_fheaders,
+                    )
+                )
+            )
 
     def close(self) -> None:
         for src in self._srcs:
@@ -506,7 +574,16 @@ class ReplicaView:
             return None
         entry = self._entries[self._main[height]]
         if entry.off == 0:
-            return self.genesis.header.serialize()
+            if height == 0:
+                return self.genesis.header.serialize()
+            if height <= self.assumed_base:
+                # Adopted bootbase header: on main at height > 0 with no
+                # record bytes, the only entries with off 0 are the
+                # seeded prefix — serve the header the bootstrap
+                # PoW-verified (a bootstrapped replica can feed another
+                # replica's header sync).
+                return self._boot_headers[height - 1]
+            return None
         return self._slice(entry.off, HEADER_SIZE)
 
     def _start_after(self, locator: list[bytes]) -> int:
@@ -532,6 +609,11 @@ class ReplicaView:
         out, total = [], 0
         for h in range(start, end):
             raw = self.raw_record(self._main[h])
+            if raw is None:
+                # Adopted bootbase height: the body was never on this
+                # disk.  Stop — a short (or empty) reply is the same
+                # honest refusal a pruned archive gives.
+                break
             total += len(raw) + 4
             if out and total > max_bytes:
                 break
@@ -542,6 +624,8 @@ class ReplicaView:
         """(block hash, filter) pairs for main heights [start, start+count)."""
         out = []
         for h in range(start, min(start + count, len(self._main))):
+            if 0 < h <= self.assumed_base:
+                break  # bodyless adopted height: refuse, never guess
             bhash = self._main[h]
             fbytes = self.filter_index.get_or_build(
                 bhash, lambda bh: self.read_block(bh)
@@ -560,6 +644,8 @@ class ReplicaView:
         return self.raw_header(height)
 
     def filter_at(self, height: int) -> bytes | None:
+        if 0 < height <= self.assumed_base:
+            return None  # bodyless adopted height (bootbase)
         bhash = self.hash_at(height)
         if bhash is None:
             return None
@@ -702,6 +788,20 @@ class QueryPlaneServer:
             self.view.tip_height,
         )
 
+    async def drain(self) -> int:
+        """Graceful replica drain (`p1 serve` on SIGTERM): stop
+        accepting new sessions FIRST, push a final EVENTGAP resume
+        cursor to every live subscriber so wallets fail over instantly
+        instead of waiting out a dead socket, then stop.  Returns how
+        many subscribers were drained."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        drained = await self.subscriptions.drain()
+        await self.stop()
+        return drained
+
     async def stop(self) -> None:
         self._running = False
         self.subscriptions.close_all()
@@ -779,6 +879,7 @@ class QueryPlaneServer:
             "records": v.records,
             "refreshes": v.refreshes,
             "rescans": v.rescans,
+            "assumed_base": v.assumed_base,
             "sessions": len(self._sessions),
             "sessions_total": self.sessions_total,
             "sessions_refused": self.sessions_refused,

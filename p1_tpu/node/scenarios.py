@@ -1954,6 +1954,160 @@ def soak(
     )
 
 
+def fleet_failover(
+    replicas: int = 3,
+    sessions: int = 6,
+    chain_blocks: int = 4,
+    post_blocks: int = 3,
+    seed: int = 0,
+    difficulty: int = 8,
+    wall_limit_s: float | None = 240.0,
+) -> dict:
+    """The kill-one-replica proof (round 22), deterministic form: N
+    serving replicas on one chain, ``sessions`` wallet watchers whose
+    ReplicaSets spread subscriptions across them (distinct
+    ``spread_key`` per session), one replica killed MID-PUSH — every
+    wallet must fail over at its verified cursor and end the run with a
+    gap-free, fully matched confirmation stream: zero missed
+    confirmations, by construction of the invariant, not by luck.
+
+    ``ok`` requires: subscriptions actually spread (>= 2 distinct
+    active targets before the kill with >= 2 live replicas), at least
+    one session failed over, every session's height stream is
+    contiguous with every event matched (each block pays the watched
+    wallet), and the mesh converged with the ledger conserved.  The
+    wall-clock fleet figure (notify p95 under kill, queue depth) is
+    ``benchmarks/wallet_plane.py``'s job — this scenario pins the
+    CORRECTNESS half in virtual time, replayable by seed."""
+    from p1_tpu.node import client
+
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    WALLET = "fleet-wallet"
+
+    async def main():
+        rng = random.Random(seed ^ 0xF1EE7)
+        for i in range(replicas):
+            # Every node mines to the watched wallet: any block from
+            # any survivor is a confirmation the watchers must see.
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 2)],
+                miner_id=WALLET,
+            )
+        hosts = list(net.nodes)
+        assert await net.run_until(
+            net.links_up, 60, step=0.1, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        for _ in range(chain_blocks):
+            await net.mine_on(net.nodes[hosts[0]], spacing_s=1.0)
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == chain_blocks,
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged pre-watch"
+
+        targets = [(h, NODE_PORT) for h in hosts]
+        sets = [
+            client.ReplicaSet(targets, spread_key=k) for k in range(sessions)
+        ]
+        streams: list[list[dict]] = [[] for _ in range(sessions)]
+        errors: list[str | None] = [None] * sessions
+
+        async def _watch(k: int) -> None:
+            transport = net.net.host(f"77.9.0.{k}")
+            try:
+                async for ev in client.watch(
+                    hosts[0], NODE_PORT, [WALLET], difficulty,
+                    replica_set=sets[k], transport=transport,
+                    cross_check_every=0, reconnect_delay_s=0.5,
+                    max_session_failures=None,
+                ):
+                    streams[k].append(ev)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — judged in the report
+                errors[k] = f"{type(e).__name__}: {e}"
+
+        tasks = [asyncio.create_task(_watch(k)) for k in range(sessions)]
+        # All ears first (a subscription that lands after the block
+        # anchors at the NEW tip and owes nothing for it), then one
+        # block that every session must see pushed.
+        assert await net.run_until(
+            lambda: sum(
+                n.subscriptions.snapshot()["live"]
+                for n in net.nodes.values()
+            ) >= sessions,
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        ), "sessions never all subscribed"
+        await net.mine_on(net.nodes[hosts[0]], spacing_s=1.0)
+        assert await net.run_until(
+            lambda: all(streams[k] for k in range(sessions)),
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        ), "not every session saw the pre-kill block"
+
+        actives = [s.active for s in sets if s.active is not None]
+        spread = len(set(actives))
+        # The directed kill: the replica carrying the most sessions.
+        tally: dict[str, int] = {}
+        for a in actives:
+            tally[a[0]] = tally.get(a[0], 0) + 1
+        victim = max(sorted(tally), key=lambda h: tally[h])
+        riders = tally[victim]
+        await net.crash_node(victim, torn=0)
+        survivor = next(h for h in hosts if h != victim)
+        for _ in range(post_blocks):
+            await net.mine_on(net.nodes[survivor], spacing_s=1.0)
+        final_h = net.nodes[survivor].chain.height
+        settled = await net.run_until(
+            lambda: all(
+                streams[k] and streams[k][-1]["height"] >= final_h
+                for k in range(sessions)
+            ),
+            300, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        for task in tasks:
+            task.cancel()
+        for task in tasks:
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+        gap_free = all(
+            [ev["height"] for ev in s]
+            == list(range(s[0]["height"], s[0]["height"] + len(s)))
+            for s in streams if s
+        )
+        all_matched = all(ev["matched"] for s in streams for ev in s)
+        failovers = sum(s.failovers for s in sets)
+        report = _report(
+            net, "fleet-failover", t0,
+            repro_flags=f"--replicas {replicas} --sessions {sessions}",
+            replicas=replicas,
+            sessions=sessions,
+            victim=victim,
+            victim_riders=riders,
+            spread=spread,
+            failovers=failovers,
+            gap_free=gap_free,
+            all_matched=all_matched,
+            missed_confirmations=0 if (gap_free and all_matched) else 1,
+            errors=[e for e in errors if e],
+        )
+        report["ok"] = bool(
+            settled
+            and gap_free
+            and all_matched
+            and not any(errors)
+            and failovers >= riders >= 1
+            and (spread >= 2 or replicas < 2 or sessions < 2)
+            and report["ledger_conserved"]
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
 SCENARIOS = {
     "partition-heal": partition_heal,
     "flash-crowd": flash_crowd,
@@ -1967,6 +2121,7 @@ SCENARIOS = {
     "retarget-shock": retarget_shock,
     "snapshot-cartel": snapshot_cartel,
     "version-activation": version_activation,
+    "fleet-failover": fleet_failover,
     "soak": soak,
 }
 

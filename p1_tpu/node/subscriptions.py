@@ -140,6 +140,7 @@ class SubscriptionManager:
         self.disconnects_error = 0
         self.cursor_rejects = 0
         self.subscribed_total = 0
+        self.drained_total = 0
         self.queue_depth_bytes = 0
         # History before this manager existed was never promised to
         # anyone — start the cursor at the source's current tip.
@@ -224,6 +225,32 @@ class SubscriptionManager:
                 pass
         self._subs.clear()
         self._gauge_live()
+
+    async def drain(self) -> int:
+        """Graceful shutdown (`p1 serve` on SIGTERM): push one final
+        EVENTGAP carrying the next-to-come height to every live
+        subscriber, then close them all; returns how many were drained.
+        The wallet reads the gap as "this window will not arrive here —
+        replay it elsewhere": its (height, filter_header) resume cursor
+        stays exactly where its last verified event left it, so failover
+        after a drain is gap-free by the same argument as failover after
+        a crash, minus the dead-socket wait."""
+        nxt = self._next_height
+        drained = 0
+        for sub in list(self._subs.values()):
+            try:
+                await sub.send(encode_event_gap(nxt, nxt))
+            except Exception:
+                pass
+            try:
+                sub.close()
+            except Exception:
+                pass
+            drained += 1
+        self._subs.clear()
+        self.drained_total += drained
+        self._gauge_live()
+        return drained
 
     # -- notification -------------------------------------------------
 
@@ -369,6 +396,7 @@ class SubscriptionManager:
             "disconnects_hard": self.disconnects_hard,
             "disconnects_error": self.disconnects_error,
             "cursor_rejects": self.cursor_rejects,
+            "drained_total": self.drained_total,
             "queue_depth_bytes": self.queue_depth_bytes,
         }
 

@@ -294,6 +294,7 @@ STATUS_KEYS = [
     "subscriptions.cursor_rejects",
     "subscriptions.disconnects_error",
     "subscriptions.disconnects_hard",
+    "subscriptions.drained_total",
     "subscriptions.events_coalesced",
     "subscriptions.events_dropped",
     "subscriptions.events_pushed",
