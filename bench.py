@@ -467,6 +467,50 @@ def main() -> None:
     except ImportError:
         pass  # installed as a bare package without the benchmarks/ tree
 
+    # Relay bandwidth budget (round 23): tx-plane bytes per delivered
+    # tx and submit-to-everywhere p95 on the reconciliation arm of a
+    # quick flood-vs-recon A/B over shaped 64 kbps uplinks
+    # (benchmarks/netsim_scale.py bench_relay; the 16-node acceptance
+    # run is `p1 sim relay-budget`).  Both figures are virtual-time
+    # deterministic — drift past the band is a protocol regression
+    # (duplicate serves, capacity under-estimates, demotion floods),
+    # not host noise.  LOWER is better for both.
+    from p1_tpu.hashx.perf_record import (
+        RECORDED_RELAY_BYTES_PER_TX,
+        RECORDED_TX_PROP_P95_MS,
+        RELAY_DEGRADED_FACTOR,
+    )
+
+    try:
+        from benchmarks.netsim_scale import bench_relay
+
+        rl = bench_relay(
+            nodes=10,
+            senders=4,
+            txs_per_sender=24,
+            storm_vs=10.0,
+            min_reduction=3.0,
+        )
+        extra["relay_bytes_per_tx"] = rl["relay_bytes_per_tx"]
+        extra["tx_prop_p95_ms"] = rl["tx_prop_p95_ms"]
+        extra["relay_reduction"] = rl["reduction"]
+        extra["relay_ok"] = rl["ok"]
+        extra["relay_bytes_vs_recorded"] = round(
+            rl["relay_bytes_per_tx"] / RECORDED_RELAY_BYTES_PER_TX, 2
+        )
+        extra["tx_prop_vs_recorded"] = round(
+            rl["tx_prop_p95_ms"] / RECORDED_TX_PROP_P95_MS, 2
+        )
+        if (
+            rl["relay_bytes_per_tx"]
+            > RELAY_DEGRADED_FACTOR * RECORDED_RELAY_BYTES_PER_TX
+            or rl["tx_prop_p95_ms"]
+            > RELAY_DEGRADED_FACTOR * RECORDED_TX_PROP_P95_MS
+        ):
+            extra["relay_degraded"] = True
+    except ImportError:
+        pass  # installed as a bare package without the benchmarks/ tree
+
     # Chaos plane (round 11): combined-fault schedules per wall second
     # (benchmarks/chaos_rate.py) against the ONE recorded constant
     # (perf_record.py RECORDED_CHAOS_RATE), same convention as above.

@@ -71,6 +71,35 @@ def bench_far_field(
     }
 
 
+def bench_relay(nodes: int = 16, seed: int = 0, **kw) -> dict:
+    """One relay-budget A/B run (flood arm vs reconciliation arm over
+    the same shaped mesh — node/scenarios.py ``relay_budget``).  The
+    two figures ``bench.py`` pins: ``relay_bytes_per_tx`` (recon arm,
+    tx-plane bytes per delivered tx-node pair) and ``tx_prop_p95_ms``
+    (recon arm, submit-to-everywhere p95).  ``reduction`` is the
+    flood/recon byte ratio — the tentpole's ≥5x budget, judged by the
+    scenario's own ``ok`` so the benchmark can't pass a run the
+    acceptance gate would fail.  Extra kwargs pass through to the
+    scenario: bench.py's quick probe shrinks the mesh and storm (and
+    relaxes ``min_reduction`` to a 3x guard band — reduction grows
+    with mesh size, and the quick mesh is smaller than the 16-node
+    acceptance run this function defaults to)."""
+    from p1_tpu.node.scenarios import relay_budget
+
+    report = relay_budget(nodes=nodes, seed=seed, **kw)
+    return {
+        "nodes": nodes,
+        "ok": report["ok"],
+        "wall_s": report["wall_s"],
+        "total_txs": report["total_txs"],
+        "flood_bytes_per_tx": report["flood"]["bytes_per_tx"],
+        "flood_p95_ms": report["flood"]["propagation"]["p95_ms"],
+        "relay_bytes_per_tx": report["recon"]["bytes_per_tx"],
+        "tx_prop_p95_ms": report["recon"]["propagation"]["p95_ms"],
+        "reduction": report["reduction"],
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--nodes", type=int, default=200)
@@ -88,8 +117,18 @@ def main() -> None:
         "shards; >1 = one OS process per shard) — the round-17 "
         "docs/PERF.md row; digests must agree across the ladder",
     )
+    parser.add_argument(
+        "--relay",
+        action="store_true",
+        help="run the 16-node relay-budget A/B (flood vs "
+        "reconciliation over shaped uplinks) — the round-23 "
+        "docs/PERF.md row and bench.py's relay_bytes_per_tx / "
+        "tx_prop_p95_ms source",
+    )
     args = parser.parse_args()
-    if args.far:
+    if args.relay:
+        print(json.dumps(bench_relay(seed=args.seed)))
+    elif args.far:
         digests = set()
         for shards in (1, 2, 4):
             row = bench_far_field(shards=shards, seed=args.seed)

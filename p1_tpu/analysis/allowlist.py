@@ -23,6 +23,11 @@ still bind future edits:
   (de)serialization are pure functions of bytes, and granting the
   module a clock seam it does not need would only invite one
   (tests/test_simlint.py pins this by name).
+- node/reconcile.py entered coverage with ZERO grants (round 23) and
+  must stay that way: the sketch codec is pure GF(2^32) arithmetic
+  over bytes — no clock, no rng, no loop — and every consumer-side
+  timing decision (round cadence, stall aging, demotion windows)
+  lives in node/node.py where the existing grants already cover it.
 
 The four rules with no entries below — lost-task, unseeded-rng,
 set-iteration, await-state — currently hold over the WHOLE package

@@ -1,15 +1,20 @@
 """wire-contract: the frame registry is exhaustively classified.
 
-The protocol surface is 30 frame types across wire v12, and every one
-must thread SIX independent tables/switches, written in three files:
+The protocol surface is 34 frame types across wire v15, and every one
+must thread SEVEN independent tables/switches, written in three files:
 an encoder (node/protocol.py ``encode_*``), a decoder arm
 (``_decode``), a ``_dispatch`` arm (node/node.py), an admission
 classification (``_MSG_CLASS`` charge class or the explicit
 ``_ADMISSION_EXEMPT`` free list — node/governor.py's token buckets
 only see what the table names), a SHED keep/drop decision
-(``_SHED_DROPS`` / ``_SHED_KEEPS``), and a version gate
+(``_SHED_DROPS`` / ``_SHED_KEEPS``), a version gate
 (``MSG_SINCE``: the wire version that introduced it, ≤
-``PROTOCOL_VERSION``).  The historical failure class is real: rounds
+``PROTOCOL_VERSION``), and — round 23 — a relay-byte accounting
+family (``_RELAY_ACCOUNTING``: which ``relay.bytes.*`` counter the
+frame's egress lands in; an unaccounted frame is bandwidth the
+propagation budget can't see, which is exactly the blind spot a
+bandwidth-scale relay exists to close).  The historical failure class
+is real: rounds
 9–12 each added frame pairs, and "the new frame forgot its
 shed/admission classification" survives review precisely because the
 omission is INVISIBLE — an unclassified frame silently rides the
@@ -25,7 +30,8 @@ the fix starts from the declaration.  Aspects: ``encoder``,
 ``decoder``, ``dispatch``, ``admission`` (missing from both tables,
 or — ``admission-both`` — named in both), ``shed`` /``shed-both``,
 ``version`` / ``version-future`` (``MSG_SINCE`` entry missing, or
-claiming a version newer than ``PROTOCOL_VERSION``).
+claiming a version newer than ``PROTOCOL_VERSION``), and ``relay``
+(no ``_RELAY_ACCOUNTING`` family).
 
 Grants here should be RARE and temporary (a frame mid-introduction
 across a stacked PR); the steady state is zero.  The import-time
@@ -77,12 +83,14 @@ class WireContractRule(Rule):
         exempt: set[str] = set()
         shed_drops: set[str] = set()
         shed_keeps: set[str] = set()
+        relay_acct: set[str] = set()
         msg_since: dict[str, tuple[int | None, int]] = {}  # name -> (ver, line)
         have = {
             "_MSG_CLASS": False,
             "_ADMISSION_EXEMPT": False,
             "_SHED_DROPS": False,
             "_SHED_KEEPS": False,
+            "_RELAY_ACCOUNTING": False,
             "MSG_SINCE": False,
             "_decode": False,
             "_dispatch": False,
@@ -114,8 +122,20 @@ class WireContractRule(Rule):
                     elif node.name == "_dispatch":
                         have["_dispatch"] = True
                         dispatch |= _msgtype_refs(node)
-                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
-                    tgt = node.targets[0]
+                elif (
+                    isinstance(node, ast.Assign) and len(node.targets) == 1
+                ) or (
+                    isinstance(node, ast.AnnAssign) and node.value is not None
+                ):
+                    # Annotated module-level tables (``X: dict = {...}``)
+                    # register the same as bare assignments — the relay
+                    # table ships annotated, and a rule that only read
+                    # ast.Assign would silently go inert on it.
+                    tgt = (
+                        node.target
+                        if isinstance(node, ast.AnnAssign)
+                        else node.targets[0]
+                    )
                     if not isinstance(tgt, ast.Name):
                         continue
                     if tgt.id == "_MSG_CLASS":
@@ -130,6 +150,9 @@ class WireContractRule(Rule):
                     elif tgt.id == "_SHED_KEEPS":
                         have["_SHED_KEEPS"] = True
                         shed_keeps |= _msgtype_refs(node.value)
+                    elif tgt.id == "_RELAY_ACCOUNTING":
+                        have["_RELAY_ACCOUNTING"] = True
+                        relay_acct |= _msgtype_refs(node.value)
                     elif tgt.id == "MSG_SINCE":
                         have["MSG_SINCE"] = True
                         self._read_since(node.value, msg_since)
@@ -205,6 +228,14 @@ class WireContractRule(Rule):
                         f"MsgType.{m} is in _SHED_DROPS AND _SHED_KEEPS "
                         "— pick one",
                     )
+            if have["_RELAY_ACCOUNTING"] and m not in relay_acct:
+                yield finding(
+                    m,
+                    "relay",
+                    f"MsgType.{m} has no _RELAY_ACCOUNTING family — "
+                    "its egress is invisible to the relay.bytes.* "
+                    "propagation budget",
+                )
             if have["MSG_SINCE"]:
                 since = msg_since.get(m)
                 if since is None:

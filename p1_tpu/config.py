@@ -211,6 +211,29 @@ class NodeConfig:
     #: reports to line up — policy coordination, never consensus.
     vb_window: int = 8
     vb_threshold: int = 6
+    #: Set-reconciliation tx relay (node/reconcile.py, the Erlay
+    #: analog, round 23).  Off by default: flood relay stays the
+    #: baseline behavior and every pre-recon sim trace is untouched.
+    #: When on, accepted transactions queue into per-peer pending
+    #: windows and periodic sketch rounds exchange only the symmetric
+    #: difference; flood remains the fallback (decode failure, demoted
+    #: poisoners, non-recon peers) and block announces always flood.
+    #: Local relay policy, never consensus — but a deployment named
+    #: ``txrecon`` in ``deployments`` additionally gates activation on
+    #: the version-bits plane reaching ACTIVE, so a mesh can roll the
+    #: feature out by miner signaling with stragglers staying correct.
+    recon_gossip: bool = False
+    #: Seconds between reconciliation rounds (one outbound peer per
+    #: tick, round-robin).  Bounds tx propagation latency over
+    #: reconciled links at roughly diameter * interval in the worst
+    #: case; the flood spine below keeps the common case flood-fast.
+    recon_interval_s: float = 1.0
+    #: Low-latency flood spine: relay each accepted tx by ordinary
+    #: flood to this many outbound reconciling peers (dial order, so
+    #: the spine is deterministic) and reconcile the rest.  Erlay's
+    #: shape: flooding a few links spans the mesh fast; sketches carry
+    #: the redundant copies that were the bandwidth bill.
+    recon_flood_degree: int = 1
 
     def retarget_rule(self):
         """The chain's ``RetargetRule``, or None for fixed difficulty."""

@@ -244,6 +244,12 @@ class Mempool:
         so a hit IS the block's transaction)."""
         return self._txs.get(txid)
 
+    def txids(self) -> tuple:
+        """Every pending txid, insertion-ordered — the reconciliation
+        plane's full-pool enumeration (node/reconcile.py short IDs are
+        computed per peer over exactly this set)."""
+        return tuple(self._txs)
+
     def add(self, tx: Transaction) -> bool:
         """Admit ``tx``; False if coinbase, already known, outbid, or full.
 

@@ -28,6 +28,126 @@ def new_stats() -> dict:
     }
 
 
+async def sketch_poisoner(
+    host, port, difficulty, deadline, retarget, stats: dict,
+    transport=None,
+) -> None:
+    """A recon-plane adversary (round 23): a LISTENING peer that
+    completes an honest handshake with a real node nonce — so victims
+    that dial it treat the link as reconciliation-capable — then poisons
+    every reconciliation primitive it touches:
+
+    - answers each REQRECON with a garbage sketch (random bytes of a
+      plausible length), so the victim's decode fails every round;
+    - initiates its own REQRECON spam, burning responder sketch serves;
+    - closes the victim's sketches with RECONCILDIFF frames full of
+      fabricated short ids the victim will chase (bounded by its GETTX
+      one-shot) and sprays GETTX for ids nothing maps to (bounded by
+      the responder's pool-scan cap).
+
+    A separate actor from ``byzantine_actor`` ON PURPOSE: that actor's
+    seeded ``rng.choice`` attack schedule is pinned by existing scenario
+    traces, and extending its tuple would silently re-roll every one.
+
+    The honest invariant it exists to prove (asserted by the scenario,
+    not here): relay cannot be stalled — the victim burns a few failed
+    rounds, demotes the link to plain flood (``recon_demotions``), and
+    every honest transaction still propagates mesh-wide.  Runs until
+    ``deadline`` on the transport's wall clock."""
+    import random
+
+    from p1_tpu.core.genesis import make_genesis
+    from p1_tpu.node import protocol
+    from p1_tpu.node.protocol import Hello, MsgType
+
+    transport = transport if transport is not None else SOCKET_TRANSPORT
+    clock = transport.clock
+    rng = random.Random(0x5EED ^ port)
+    gh = make_genesis(difficulty, retarget).block_hash()
+    nonce = rng.getrandbits(64) | 1  # a "real node", per the handshake
+
+    def bump(name: str) -> None:
+        stats["attacks"][name] = stats["attacks"].get(name, 0) + 1
+
+    async def session(reader, writer) -> None:
+        try:
+            await protocol.write_frame(
+                writer, protocol.encode_hello(Hello(gh, 0, port, nonce))
+            )
+            await asyncio.wait_for(protocol.read_frame(reader), 10)
+            last_spam = clock.wall()
+            while clock.wall() < deadline:
+                payload = await asyncio.wait_for(
+                    protocol.read_frame(reader),
+                    timeout=max(0.1, deadline - clock.wall()),
+                )
+                if not payload:
+                    continue
+                if payload[0] == MsgType.REQRECON:
+                    # A garbage sketch of a believable size: syndrome
+                    # words drawn uniformly decode to None with
+                    # overwhelming probability — every round the victim
+                    # initiates on this link fails.
+                    words = rng.randrange(2, 34)
+                    await protocol.write_frame(
+                        writer,
+                        protocol.encode_sketch(
+                            rng.randrange(1, 512),
+                            rng.randbytes(4 * words),
+                        ),
+                    )
+                    bump("garbage_sketch")
+                elif payload[0] == MsgType.SKETCH:
+                    # Our own spam round came back: claim success with
+                    # fabricated "theirs" ids the victim will chase.
+                    await protocol.write_frame(
+                        writer,
+                        protocol.encode_recondiff(
+                            True,
+                            tuple(
+                                rng.randrange(1, 1 << 32) for _ in range(32)
+                            ),
+                        ),
+                    )
+                    bump("fake_diff")
+                if clock.wall() - last_spam >= 0.5:
+                    last_spam = clock.wall()
+                    await protocol.write_frame(
+                        writer,
+                        protocol.encode_reqrecon(rng.randrange(0, 4096)),
+                    )
+                    bump("reqrecon_spam")
+                    await protocol.write_frame(
+                        writer,
+                        protocol.encode_gettx(
+                            tuple(
+                                rng.randrange(1, 1 << 32) for _ in range(64)
+                            )
+                        ),
+                    )
+                    bump("gettx_spray")
+        except (
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            ValueError,
+        ):
+            pass  # victim hung up or the clock ran out: session over
+        finally:
+            writer.close()
+
+    # The session coroutine doubles as the accept callback: both the
+    # socket transport (asyncio.start_server) and the simulator wrap it
+    # in a task per inbound connection.
+    listener = await transport.listen(session, host, port)
+    try:
+        while clock.wall() < deadline:
+            await asyncio.sleep(0.25)
+    finally:
+        listener.close()
+
+
 async def byzantine_actor(
     actor: int, ports, difficulty, deadline, retarget, stats: dict,
     transport=None,

@@ -789,6 +789,7 @@ def run_chaos(
     keep_trace: bool = False,
     rss_bound_mb: float | None = None,
     pipeline_workers: int = 0,
+    recon: bool = False,
 ) -> dict:
     """Run one chaos schedule end to end and return the report.
 
@@ -825,6 +826,7 @@ def run_chaos(
                 keep_trace=keep_trace,
                 rss_bound_mb=rss_bound_mb,
                 pipeline_workers=pipeline_workers,
+                recon=recon,
             )
     t0 = time.monotonic()
     net = SimNet(
@@ -844,7 +846,7 @@ def run_chaos(
     )
     runner = _ChaosRunner(
         net, nodes, difficulty, inject_bug, settle_vs, wall_limit_s,
-        rss_bound_mb=rss_bound_mb,
+        rss_bound_mb=rss_bound_mb, recon=recon,
     )
     report = net.run(runner.main(events))
     report["seed"] = seed
@@ -993,7 +995,7 @@ class _ChaosRunner:
     """One schedule's execution state (hosts, wallets, live actors)."""
 
     def __init__(self, net, n_nodes, difficulty, inject_bug, settle_vs,
-                 wall_limit_s, rss_bound_mb=None):
+                 wall_limit_s, rss_bound_mb=None, recon=False):
         from p1_tpu.core.keys import Keypair
 
         self.net = net
@@ -1026,6 +1028,11 @@ class _ChaosRunner:
         self.slowed: set[str] = set()
         self.partitioned = False
         self.rss_bound_mb = rss_bound_mb
+        #: Round 23: run the whole mesh with set-reconciliation tx
+        #: gossip on (no deployment table — recon from block 0).
+        #: OPT-IN so the seed-stable trace-digest corpus keeps its
+        #: recorded hashes; the recon sweep pins its own.
+        self.recon = recon
         #: Leak-gauge snapshots taken by ``probe`` events (the soak
         #: schedule places one at the midpoint and one at the horizon);
         #: the quiesce leak invariants compare the last two.
@@ -1535,6 +1542,7 @@ class _ChaosRunner:
             snapshot_sync=True,
             snapshot_min_lead=2,
             snapshot_interval=SNAPSHOT_INTERVAL,
+            recon_gossip=self.recon,
         )
 
     def _restore_link(self, host: str) -> None:
@@ -1586,6 +1594,7 @@ class _ChaosRunner:
                 name=host,
                 peers=peers,
                 snapshot_interval=SNAPSHOT_INTERVAL,
+                recon_gossip=self.recon,
                 **kwargs,
             )
         assert await net.run_until(

@@ -230,6 +230,11 @@ class _SimLink:
         if self._closed or self._dead or not data:
             return
         net = self._net
+        # Per-link byte accounting (round 23): directed host-pair totals
+        # for the relay bandwidth budget.  Pure observation — never
+        # touches ``_record``, so trace digests are unchanged by it.
+        key = (self.src[0], self.dst[0])
+        net.link_bytes[key] = net.link_bytes.get(key, 0) + len(data)
         p = self.profile
         now = net.clock.now
         delay = p.latency_s
@@ -238,6 +243,15 @@ class _SimLink:
         if p.loss:
             while net._rng.random() < p.loss:
                 delay += _RETX_PENALTY * max(p.latency_s, 1e-3)
+        ebps = net.host_egress.get(self.src[0], 0.0)
+        if ebps:
+            # The shared uplink: all of this host's connections contend
+            # for one serializer, so a node that floods N copies of a tx
+            # pays N serializations back to back.
+            estart = max(now, net._egress_clear.get(self.src[0], 0.0))
+            now = net._egress_clear[self.src[0]] = (
+                estart + 8.0 * len(data) / ebps
+            )
         if p.bandwidth_bps:
             start = max(now, self._clear_at)
             self._clear_at = start + 8.0 * len(data) / p.bandwidth_bps
@@ -444,6 +458,21 @@ class SimTransport:
         self.events = 0
         self.trace: list[tuple] | None = [] if keep_trace else None
         self._tasks: set[asyncio.Task] = set()
+        #: (src_host, dst_host) -> bytes put on that directed link, every
+        #: payload chunk counted at ``_SimLink.send`` (round 23's
+        #: per-link accounting).  Observation only: reading or resetting
+        #: it never perturbs the trace digest.
+        self.link_bytes: dict[tuple[str, str], int] = {}
+        #: host -> uplink bits/s.  Opt-in per-HOST egress shaping (round
+        #: 23): every chunk the host sends — on ANY connection —
+        #: serializes through one shared uplink before the per-link
+        #: profile applies, which is the physical constraint the relay
+        #: bandwidth budget is about (a flooding node pays its degree on
+        #: ONE access link, not on ``degree`` independent ones).  Empty
+        #: (the default) means infinite uplinks: existing scenarios and
+        #: their pinned trace digests are untouched.
+        self.host_egress: dict[str, float] = {}
+        self._egress_clear: dict[str, float] = {}
 
     # -- topology ----------------------------------------------------------
 

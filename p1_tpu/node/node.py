@@ -33,7 +33,7 @@ from p1_tpu.chain.store import fsync_dir
 from p1_tpu.chain import snapshot as chain_snapshot
 from p1_tpu.chain.snapshot import SnapshotError
 from p1_tpu.chain.validate import ValidationError, preverify_signatures
-from p1_tpu.chain.versionbits import Deployment, VersionBits
+from p1_tpu.chain.versionbits import Deployment, VBState, VersionBits
 from p1_tpu.config import NodeConfig
 from p1_tpu.core import keys
 from p1_tpu.core.block import Block, merkle_root
@@ -43,6 +43,7 @@ from p1_tpu.core.tx import Transaction
 from p1_tpu.mempool import Mempool
 from p1_tpu.miner import Miner
 from p1_tpu.node import protocol
+from p1_tpu.node import reconcile
 from p1_tpu.node.governor import (
     CLASS_BLOCKS,
     CLASS_QUERIES,
@@ -111,6 +112,21 @@ MEMPOOL_SYNC_TXS = 2000
 MEMPOOL_SYNC_BYTES = 2 << 20
 RECONNECT_DELAY_S = 0.5
 GOSSIP_SEND_TIMEOUT_S = 5.0
+#: Set-reconciliation relay (round 23, Erlay analog).  Per-peer bound on
+#: txids queued for the next reconciliation round — overflow floods the
+#: oldest entries instead of dropping them (flood is the pressure valve,
+#: reconciliation the optimisation, never the other way around).
+RECON_PENDING_MAX = 4096
+#: Consecutive failed/stalled rounds before a peer is demoted off the
+#: recon plane back to plain flooding, and for how long.  Demotion is
+#: per-peer and self-healing: a poisoned or broken peer costs us its own
+#: link's efficiency, never relay liveness.
+RECON_DEMOTE_FAILURES = 3
+RECON_DEMOTE_S = 60.0
+#: Cap on mempool entries scanned to serve one GETTX fallback fetch when
+#: the short-id is no longer in the recon window — bounds the work a
+#: hostile GETTX spray can demand.
+RECON_GETTX_SCAN_MAX = 4096
 #: Misbehavior scoring: a host that commits this many protocol violations
 #: (malformed frames, wrong chain/version, bad handshake) within the
 #: window is refused at accept time for the ban duration.  Violations are
@@ -183,6 +199,14 @@ _MSG_CLASS = {
     MsgType.SUBSCRIBE: CLASS_QUERIES,
     MsgType.UNSUBSCRIBE: CLASS_QUERIES,
     MsgType.GETFILTERHEADERS: CLASS_QUERIES,
+    # The reconciliation plane (v15): opening a round, closing it, and
+    # the short-ID fetch all make us compute (a sketch) or serve (TX
+    # pushes) — charged like every other request.  The capacity clamp
+    # in node/reconcile.py bounds what any single admitted frame can
+    # cost; admission bounds how often a peer may present one.
+    MsgType.REQRECON: CLASS_QUERIES,
+    MsgType.RECONCILDIFF: CLASS_QUERIES,
+    MsgType.GETTX: CLASS_QUERIES,
 }
 
 #: The OTHER half of the admission contract, spelled out: frames the
@@ -218,6 +242,10 @@ _ADMISSION_EXEMPT = frozenset(
         # (FILTERHEADERS) — charging them would ration our own pushes.
         MsgType.EVENT,
         MsgType.FILTERHEADERS,
+        # The sketch reply to OUR REQRECON — solicited like MEMPOOL; an
+        # unsolicited one is dropped by the dispatch arm (and scored
+        # toward flood demotion), so exemption buys an attacker nothing.
+        MsgType.SKETCH,
     }
 )
 assert (
@@ -251,6 +279,17 @@ _SHED_DROPS = frozenset(
         # replica); live ones keep degrading down their own ladder, and
         # UNSUBSCRIBE stays up because it frees capacity.
         MsgType.SUBSCRIBE,
+        # The whole reconciliation exchange is tx-plane capacity, shed
+        # with TX/MEMPOOL: a dropped round degrades to flood (or a
+        # retry next interval), never to a lost transaction — the same
+        # recoverability argument as the pool itself.  SKETCH is a
+        # solicited reply, but unlike BLOCKS the round it answers has
+        # its own stall fallback, so shedding it cannot wedge a
+        # supervisor.
+        MsgType.REQRECON,
+        MsgType.SKETCH,
+        MsgType.RECONCILDIFF,
+        MsgType.GETTX,
     }
 )
 
@@ -307,6 +346,71 @@ assert (
     _SHED_DROPS | _SHED_KEEPS == set(MsgType)
     and not _SHED_DROPS & _SHED_KEEPS
 ), "every frame type needs exactly one SHED classification"
+
+#: Relay-byte accounting families (round 23).  Every frame type maps to
+#: the bandwidth plane its bytes spend, and every SENT frame is counted
+#: at the one send choke point (``_Peer.send``) into a per-msgtype
+#: ``relay.bytes.<name>`` telemetry counter plus this family label.
+#: The families are what the relay A/B budget reasons over: ``tx`` +
+#: ``recon`` together form the tx-relay plane that set reconciliation
+#: exists to shrink; ``block`` announces stay flooded by design and are
+#: budgeted separately; ``serve``/``push``/``control``/``handshake``
+#: are demand-driven, not relay overhead.  Exhaustive like the
+#: admission/SHED tables — the assert below and the wire-contract lint
+#: rule fail any frame type whose bytes would otherwise silently vanish
+#: from the bandwidth budget.
+_RELAY_ACCOUNTING: dict = {
+    MsgType.HELLO: "handshake",
+    MsgType.BLOCK: "block",
+    MsgType.CBLOCK: "block",
+    MsgType.GETBLOCKS: "block",
+    MsgType.BLOCKS: "block",
+    MsgType.GETBLOCKTXN: "block",
+    MsgType.BLOCKTXN: "block",
+    MsgType.GETHEADERS: "block",
+    MsgType.HEADERS: "block",
+    MsgType.TX: "tx",
+    MsgType.GETMEMPOOL: "tx",
+    MsgType.MEMPOOL: "tx",
+    MsgType.REQRECON: "recon",
+    MsgType.SKETCH: "recon",
+    MsgType.RECONCILDIFF: "recon",
+    MsgType.GETTX: "recon",
+    MsgType.GETACCOUNT: "serve",
+    MsgType.ACCOUNT: "serve",
+    MsgType.GETPROOF: "serve",
+    MsgType.PROOF: "serve",
+    MsgType.GETFEES: "serve",
+    MsgType.FEES: "serve",
+    MsgType.GETFILTERS: "serve",
+    MsgType.FILTERS: "serve",
+    MsgType.GETSNAPSHOT: "serve",
+    MsgType.SNAPSHOT: "serve",
+    MsgType.GETFILTERHEADERS: "serve",
+    MsgType.FILTERHEADERS: "serve",
+    MsgType.SUBSCRIBE: "push",
+    MsgType.EVENT: "push",
+    MsgType.UNSUBSCRIBE: "push",
+    MsgType.GETADDR: "control",
+    MsgType.ADDR: "control",
+    MsgType.PING: "control",
+    MsgType.PONG: "control",
+    MsgType.GETSTATUS: "control",
+    MsgType.STATUS: "control",
+    MsgType.GETMETRICS: "control",
+    MsgType.METRICS: "control",
+    MsgType.GETMAINTAIN: "control",
+    MsgType.MAINTAIN: "control",
+}
+assert set(_RELAY_ACCOUNTING) == set(MsgType) and all(
+    _RELAY_ACCOUNTING.values()
+), "every frame type needs a relay-byte accounting family"
+
+#: msgtype byte -> telemetry counter name, precomputed so the hot send
+#: path never formats a string.
+_RELAY_COUNTER_NAME = {
+    int(m): "relay.bytes." + m.name.lower() for m in MsgType
+}
 
 
 #: NodeMetrics counter fields, in their historical (dataclass) order.
@@ -367,6 +471,16 @@ _METRIC_COUNTERS = (
     "compaction_records_dropped",
     "snapshot_incremental_builds",
     "snapshot_chunks_reused",
+    # Set-reconciliation tx relay (round 23, Erlay analog): rounds we
+    # initiated, sketches we served as responder, rounds that decoded,
+    # rounds that fell back to flood/paging, peers demoted off the
+    # recon plane, and individual txs delivered via reconciliation.
+    "recon_rounds",
+    "recon_sketches_served",
+    "recon_success",
+    "recon_fallbacks",
+    "recon_demotions",
+    "txs_reconciled",
 )
 #: Float-valued point-in-time fields (mining timing).
 _METRIC_GAUGES = ("mine_elapsed_s", "last_block_time_s")
@@ -385,7 +499,7 @@ class NodeMetrics:
     AttributeError — a typo must not silently mint a counter.
     """
 
-    __slots__ = ("registry", "propagation_delays_s")
+    __slots__ = ("registry", "propagation_delays_s", "relay_counters")
 
     def __init__(self, registry=None):
         from p1_tpu.node.telemetry import MetricsRegistry
@@ -403,6 +517,10 @@ class NodeMetrics:
         object.__setattr__(
             self, "propagation_delays_s", collections.deque(maxlen=1024)
         )
+        #: msgtype byte -> registry Counter for ``relay.bytes.<name>``,
+        #: populated lazily on first send of each frame type so the
+        #: registry only carries rows for traffic that actually flowed.
+        object.__setattr__(self, "relay_counters", {})
         for name in _METRIC_COUNTERS:
             self.registry.counter(name)
         for name in _METRIC_GAUGES:
@@ -429,6 +547,37 @@ class NodeMetrics:
             g.value = value
             return
         raise AttributeError(name)
+
+    def count_relay(self, mtype_byte: int, nbytes: int) -> None:
+        """Attribute ``nbytes`` of sent wire traffic to its frame type.
+
+        Called from the one send choke point (``_Peer.send``) so the
+        per-msgtype ``relay.bytes.*`` counters and the exhaustive
+        ``_RELAY_ACCOUNTING`` family table together account for every
+        byte the node puts on the wire (plus the 4-byte length prefix,
+        matching ``bytes_sent``).  Unknown bytes are ignored rather
+        than raising — the send path must never die on a frame the
+        decoder would reject anyway.
+        """
+        registry = object.__getattribute__(self, "registry")
+        counters = object.__getattribute__(self, "relay_counters")
+        c = counters.get(mtype_byte)
+        if c is None:
+            name = _RELAY_COUNTER_NAME.get(mtype_byte)
+            if name is None:
+                return
+            c = registry.counter(name)
+            counters[mtype_byte] = c
+        c.value += nbytes
+
+    def relay_bytes(self) -> dict:
+        """{family: bytes_sent} over ``_RELAY_ACCOUNTING`` families."""
+        counters = object.__getattribute__(self, "relay_counters")
+        out: dict = {}
+        for mtype_byte, c in counters.items():
+            family = _RELAY_ACCOUNTING[MsgType(mtype_byte)]
+            out[family] = out.get(family, 0) + c.value
+        return out
 
     @property
     def hashes_per_sec(self) -> float:
@@ -529,6 +678,50 @@ class _Peer:
         #: connection and its gossip, it just sorts last when the node
         #: picks who to re-ask (supervision.py's design note).
         self.sync_demerits = 0
+        # --- Set-reconciliation relay state (round 23, Erlay analog) ---
+        #: Pairwise short-id salt, derived from the two instance nonces
+        #: at HELLO (node/reconcile.py ``pair_salt``).  None until the
+        #: handshake ran, or for tooling clients (nonce 0) — a peer
+        #: without a salt is simply flooded to, like every peer before
+        #: this round.
+        self.recon_salt: bytes | None = None
+        #: short_id -> txid of txs queued for the NEXT reconciliation
+        #: round on this link instead of being flooded (insertion
+        #: ordered; bounded by RECON_PENDING_MAX with flood as the
+        #: overflow valve).
+        self.recon_pending: dict[int, bytes] = {}
+        #: Responder side: the short_id -> txid set we sketched in our
+        #: last SKETCH reply, held until the initiator's RECONCILDIFF
+        #: closes the round (serves their diff / GETTX fetches from it).
+        self.recon_window: dict[int, bytes] = {}
+        #: True when recon_window was sketched for a FULL-pool round
+        #: (initial mempool sync) — failure must not flood whole pools.
+        self.recon_window_full = False
+        #: The serve station: short_id -> txid of the last CLOSED round
+        #: (either role), kept so the peer's deferred GETTX resolves
+        #: without a pool scan.  Replaced whole each close — never
+        #: merged — so it cannot grow past one round's size.
+        self.recon_served: dict[int, bytes] = {}
+        #: Initiator side: the short_id -> txid set frozen into the
+        #: round in flight, and whether that round is a full-pool sync.
+        self.recon_round: dict[int, bytes] = {}
+        self.recon_round_full = False
+        #: When our REQRECON went out and no usable SKETCH has landed
+        #: (None = no round in flight).  Aged entries count as failed
+        #: rounds — a silent responder must not wedge the plane.
+        self.recon_inflight_since: float | None = None
+        #: Short ids the peer announced in RECONCILDIFF that we have not
+        #: yet received as TX pushes; the next tick GETTXes leftovers.
+        self.recon_expect: set[int] = set()
+        #: Consecutive failed/stalled rounds; reaching
+        #: RECON_DEMOTE_FAILURES demotes the peer to flood until
+        #: ``recon_demoted_until`` passes.
+        self.recon_failures = 0
+        self.recon_demoted_until = 0.0
+        #: One-shot: the initial mempool sync should run as a full-pool
+        #: reconciliation round on the next tick (set where the classic
+        #: path would have sent GETMEMPOOL).
+        self.recon_full_pending = False
         #: Remote host (peername IP), for per-HOST accounting such as the
         #: ADDR budget — per-connection state would reset on reconnect.
         self.host: str | None = (
@@ -547,6 +740,10 @@ class _Peer:
         # UNDERcount under peer stalls, never an overcount.
         if self.metrics is not None:
             self.metrics.bytes_sent += len(payload) + 4
+            if payload:
+                # Per-msgtype relay-byte attribution (round 23): same
+                # choke point, same +4 framing overhead as bytes_sent.
+                self.metrics.count_relay(payload[0], len(payload) + 4)
 
 
 class Node:
@@ -677,6 +874,26 @@ class Node:
             window=config.vb_window,
             threshold=config.vb_threshold,
         )
+        #: Optional version-bits gate for the reconciliation relay: when
+        #: the deployment table carries a "txrecon" row, recon rounds are
+        #: initiated only once it reaches ACTIVE — the mixed-version
+        #: mesh upgrades link by link as miners signal, flood remaining
+        #: the shared dialect throughout (PR 17's evolution contract).
+        self._recon_deployment = next(
+            (d for d in self.versionbits.deployments if d.name == "txrecon"),
+            None,
+        )
+        #: Round-robin cursor over outbound recon-active peers — one
+        #: reconciliation initiation per tick, not a thundering herd.
+        self._recon_rotate = 0
+        #: Same shape for the GETTX chase: one link's announced-but-
+        #: undelivered ids fetched per tick (the dedup pacing).
+        self._recon_chase_rotate = 0
+        #: txid -> monotonic arrival stamp for accepted txs (bounded,
+        #: insertion-ordered).  Pure observation for the propagation
+        #: budget (scenarios read it to compute relay p95); never feeds
+        #: back into relay decisions.
+        self.tx_seen_at: dict[bytes, float] = {}
         #: Name of the maintenance operation currently running, or None.
         #: One at a time: rebase/prune/compact each assume the store
         #: segment set is not shifting under them, and serializing here
@@ -1380,6 +1597,14 @@ class Node:
             # every multi-round fetch (0 disables, e.g. single-peer
             # tooling rigs that want no background re-requests).
             self._tasks.append(asyncio.create_task(self._supervision_loop()))
+        # Set-reconciliation heartbeat (round 23), spawned UNCONDITIONALLY:
+        # round initiation is gated per tick (operator switch + "txrecon"
+        # deployment state), but the tick's bookkeeping half — aging out
+        # silent rounds and GETTX-chasing diff ids a recon-ON peer
+        # announced to us — must run even on a recon-off node, or a
+        # straggler that answers sketches could book announced ids and
+        # never fetch them.
+        self._tasks.append(asyncio.create_task(self._recon_loop()))
         if (
             self.config.mem_watermark_bytes > 0
             or self.config.body_cache_blocks > 0
@@ -2534,11 +2759,24 @@ class Node:
         hysteresis can actually come back down when the pressure goes
         away."""
         write_buf = 0
+        recon_entries = 0
         for peer in self._peers.values():
             transport = peer.writer.transport
             if transport is not None and not transport.is_closing():
                 write_buf += transport.get_write_buffer_size()
+            # Recon relay maps (round 23): bounded per peer, but bounded
+            # is not free at MAX_PEERS x RECON_PENDING_MAX — ~36 bytes
+            # per short-id->txid entry (int key + 32-byte txid).
+            recon_entries += (
+                len(peer.recon_pending)
+                + len(peer.recon_window)
+                + len(peer.recon_round)
+                + len(peer.recon_served)
+                + len(peer.recon_expect)
+            )
         return (
+            36 * recon_entries
+            +
             self.chain.resident_body_bytes
             + getattr(self.mempool, "bytes_pending", 0)
             + write_buf
@@ -2829,6 +3067,21 @@ class Node:
         """Issue a supervised mempool (page) request to ``peer``."""
         peer.mempool_requested = True
         peer.mempool_inflight_since = self.clock.monotonic()
+        if (
+            cursor is None
+            and self._recon_enabled()
+            and self._recon_peer_active(peer, self.clock.monotonic())
+        ):
+            # Initial pool sync rides the reconciliation plane when the
+            # link supports it: the next tick runs a FULL-pool round
+            # (both sides sketch everything they have), so two mostly-
+            # overlapping pools cost one sketch exchange instead of
+            # re-shipping the whole pool page by page.  The in-flight
+            # stamp above keeps ``_check_mempool_sync`` as the safety
+            # net either way, and a failed round falls back to classic
+            # cursor paging (never a whole-pool flood).
+            peer.recon_full_pending = True
+            return
         await self._send_guarded(peer, protocol.encode_getmempool(cursor))
 
     def _pick_sync_peer(self, exclude: _Peer | None = None) -> _Peer | None:
@@ -3153,6 +3406,16 @@ class Node:
             self.log.info("peer %s connected (their height %d)", label, hello.tip_height)
             peer.hello_height = hello.tip_height
             peer.is_node = bool(hello.nonce)  # 0 = one-shot tooling client
+            if hello.nonce:
+                # Pairwise short-id salt for set reconciliation: both
+                # ends derive the identical value from the sorted nonce
+                # pair, so sketches agree without any extra negotiation.
+                # Derived unconditionally (even with recon disabled): a
+                # recon-off node still ANSWERS REQRECON with a sketch of
+                # what it has, keeping straggler meshes correct.
+                peer.recon_salt = reconcile.pair_salt(
+                    self.instance_nonce, hello.nonce
+                )
             if hello.listen_port:
                 # The peer's claimed reachable address: its socket host +
                 # the listen port it advertised.  NOT promoted to tried —
@@ -3331,6 +3594,16 @@ class Node:
                 # Not the peer's fault we refused its page: don't let the
                 # in-flight marker age into a stall demerit.
                 peer.mempool_inflight_since = None
+            elif mtype is MsgType.SKETCH:
+                # Same courtesy for a shed sketch reply: close the round
+                # without a demerit, re-queueing what it carried so the
+                # txs retry once the pressure clears (no fallback flood
+                # — under SHED the tx plane is being shed wholesale).
+                peer.recon_round.update(peer.recon_pending)
+                peer.recon_pending = peer.recon_round
+                peer.recon_round = {}
+                peer.recon_round_full = False
+                peer.recon_inflight_since = None
             self.governor.shed_drop()
             return
         cls = _MSG_CLASS.get(mtype)
@@ -3560,18 +3833,45 @@ class Node:
             )
             for tx in txs:
                 await self._handle_tx(tx, origin=peer)
-            if more and txs:
+            if more:
                 # Continue from the largest key received, and only if it
                 # strictly advances — key-ordering is (-fee, txid), so a
                 # responder replaying old keys can't spin the sync.
                 from p1_tpu.mempool import sync_key
 
-                last = max(txs, key=lambda t: sync_key(t.fee, t.txid()))
-                cursor = (last.fee, last.txid())
+                cursor = None
+                if txs:
+                    last = max(txs, key=lambda t: sync_key(t.fee, t.txid()))
+                    cursor = (last.fee, last.txid())
                 prev = peer.mempool_cursor
-                if prev is None or sync_key(*cursor) > sync_key(*prev):
+                if cursor is not None and (
+                    prev is None or sync_key(*cursor) > sync_key(*prev)
+                ):
                     peer.mempool_cursor = cursor
                     await self._request_mempool(peer, cursor)
+                else:
+                    # "More coming" with an empty or non-advancing tail:
+                    # chatty uselessness, and before round 23 it simply
+                    # ENDED the sync silently — a hostile responder
+                    # could park a node's pool sync forever at zero
+                    # cost.  It now reads as the stall it is: demote and
+                    # re-solicit from one other idle peer, same recovery
+                    # as the in-flight deadline path.
+                    self.metrics.mempool_sync_stalls += 1
+                    peer.sync_demerits += 1
+                    self.metrics.sync_demotions += 1
+                    self.log.warning(
+                        "mempool sync with %s stopped advancing — asking "
+                        "another peer",
+                        peer.label,
+                    )
+                    other = self._pick_sync_peer(exclude=peer)
+                    if (
+                        other is not None
+                        and other is not peer
+                        and other.mempool_inflight_since is None
+                    ):
+                        await self._request_mempool(other)
         elif mtype is MsgType.GETACCOUNT:
             # Wallet/CLI query: consensus state at OUR tip plus the next
             # usable seq net of our pending pool (p1 tx auto-seq).
@@ -3794,6 +4094,115 @@ class Node:
             pass  # arrival already reset the session's idle probe
         elif mtype in (MsgType.ACCOUNT, MsgType.PROOF):
             pass  # reply frames: meaningful to querying clients only
+        elif mtype is MsgType.REQRECON:
+            # Responder half of a reconciliation round: freeze our queue
+            # for this link (merging any window a vanished initiator
+            # left behind) and serve a sketch sized for the estimated
+            # difference.  Served even when recon is locally disabled —
+            # a sketch of what we have is one small frame and keeps
+            # straggler links correct; no salt (tooling client) means
+            # there is nothing coherent to sketch, so the frame is
+            # ignored and the asker's stall fallback covers it.
+            if peer.recon_salt is not None:
+                full, remote_size = body
+                window = peer.recon_window
+                window.update(peer.recon_pending)
+                peer.recon_pending.clear()
+                if full:
+                    for txid in self.mempool.txids():
+                        window.setdefault(
+                            reconcile.short_id(peer.recon_salt, txid), txid
+                        )
+                peer.recon_window_full = full
+                cap = reconcile.estimate_capacity(len(window), remote_size)
+                self.metrics.recon_sketches_served += 1
+                await self._send_guarded(
+                    peer,
+                    protocol.encode_sketch(
+                        len(window), reconcile.sketch(window, cap)
+                    ),
+                )
+        elif mtype is MsgType.SKETCH:
+            # Initiator half: XOR our round's sketch against the peer's
+            # at ITS capacity and decode the symmetric difference.
+            # Admission-exempt but self-guarding: without a round in
+            # flight the frame is unsolicited and ignored.
+            if peer.recon_inflight_since is not None:
+                _remote_size, sk = body
+                ours = reconcile.sketch(
+                    peer.recon_round, reconcile.capacity_of(sk)
+                )
+                diff = reconcile.decode(reconcile.combine(ours, sk))
+                if diff is None:
+                    await self._recon_fallback(peer)
+                else:
+                    await self._recon_close(peer, diff)
+        elif mtype is MsgType.RECONCILDIFF:
+            # The initiator closed the round.  Success carries the WHOLE
+            # symmetric difference as an announcement: ids we recognize
+            # are ours (the peer will GETTX them — the window stays
+            # alive as the serve station), ids we don't are the peer's
+            # (book them; our next heartbeat GETTXs whatever no other
+            # link delivered first).  Failure floods the window (every
+            # queued tx still propagates, at flood cost) — except for a
+            # full-pool round, where the initiator's classic-paging
+            # fallback pulls what it needs instead of us flooding a
+            # whole pool.
+            success, sids = body
+            window = peer.recon_window
+            peer.recon_window = {}
+            was_full = peer.recon_window_full
+            peer.recon_window_full = False
+            if success:
+                # The window becomes the serve station for the peer's
+                # deferred GETTX; ids we recognize nowhere are the
+                # peer's half of the diff.  "Nowhere" must include the
+                # round we just retired (``recon_served`` before the
+                # swap): a tx consumed into the previous round lives in
+                # no other per-link structure, and booking it would
+                # fetch a copy we already hold.
+                served = peer.recon_served
+                peer.recon_served = window
+                for sid in sids:
+                    if (
+                        sid not in window
+                        and sid not in peer.recon_pending
+                        and sid not in served
+                    ):
+                        peer.recon_expect.add(sid)
+            elif not was_full:
+                for txid in window.values():
+                    tx = self.mempool.get(txid)
+                    if tx is not None:
+                        await self._gossip_peers(
+                            [peer], protocol.encode_tx(tx)
+                        )
+        elif mtype is MsgType.GETTX:
+            # Explicit fetch of short ids a RECONCILDIFF promised.  The
+            # window/queue resolve most; the rest fall to a BOUNDED pool
+            # scan (the short id is salted per link, so there is no
+            # precomputed index — the cap prices a hostile GETTX spray).
+            if peer.recon_salt is not None:
+                lookup = dict(peer.recon_served)
+                lookup.update(peer.recon_window)
+                lookup.update(peer.recon_pending)
+                missing = {sid for sid in body if sid not in lookup}
+                if missing:
+                    for n, txid in enumerate(self.mempool.txids()):
+                        if not missing or n >= RECON_GETTX_SCAN_MAX:
+                            break
+                        sid = reconcile.short_id(peer.recon_salt, txid)
+                        if sid in missing:
+                            missing.discard(sid)
+                            lookup[sid] = txid
+                for sid in body:
+                    tx = self.mempool.get(lookup.get(sid, b""))
+                    if tx is not None:
+                        # The one place reconciled txs cross the wire:
+                        # every push is an explicit fetch of something
+                        # the peer verified it still lacks.
+                        self.metrics.txs_reconciled += 1
+                        await self._gossip_peers([peer], protocol.encode_tx(tx))
         elif mtype is MsgType.HELLO:
             pass  # late HELLO: ignore
         if query_t0 is not None:
@@ -3864,10 +4273,17 @@ class Node:
         megabytes of unread replies (the soft write-queue bound): there
         is no point queuing a push behind a backlog, and the skipped
         peer heals through ordinary locator sync."""
+        return await self._gossip_peers(
+            [p for p in self._peers.values() if p is not skip], payload
+        )
+
+    async def _gossip_peers(self, peers, payload: bytes) -> int:
+        """The shared fan-out half of ``_gossip``: apply the write-queue
+        back-pressure skip to an explicit peer list and send to the
+        survivors concurrently.  The reconciliation relay reuses it to
+        flood a SUBSET of peers (the flood spine, fallback floods)."""
         targets = []
-        for p in self._peers.values():
-            if p is skip:
-                continue
+        for p in peers:
             transport = p.writer.transport
             if (
                 transport is not None
@@ -3881,6 +4297,296 @@ class Node:
                 *(self._send_guarded(p, payload) for p in targets)
             )
         return len(targets)
+
+    # -- set-reconciliation tx relay (round 23, Erlay analog) ------------
+    #
+    # Flooding ships every tx to every link: per-node relay bandwidth
+    # grows with the CONNECTIVITY of the mesh, not its size.  The recon
+    # plane replaces most of that with per-link set reconciliation
+    # (node/reconcile.py): announcements queue per link as 4-byte short
+    # ids, and a periodic sketch exchange transfers only the symmetric
+    # DIFFERENCE of the two queues — O(what the peer is missing), no
+    # matter how much the sets overlap.  A small flood spine
+    # (``recon_flood_degree`` outbound links per node) keeps worst-case
+    # latency at flood speed; reconciliation sweeps the remaining links.
+    # Flood stays the universal fallback — decode failure, stalled
+    # responder, demoted or pre-RECONCILE peer all degrade to exactly
+    # the pre-round-23 behavior, so reconciliation is only ever an
+    # optimisation, never a liveness dependency.
+
+    def _recon_enabled(self) -> bool:
+        """Whether THIS node queues txs for reconciliation and initiates
+        rounds.  ``config.recon_gossip`` is the operator switch; a
+        "txrecon" version-bits deployment (when the table carries one)
+        additionally gates on miner-signalled activation, so a mixed-
+        version mesh upgrades by signal with flood as the shared
+        dialect throughout (PR 17's evolution contract).  Recon-off
+        nodes still ANSWER REQRECON — serving a sketch of what we have
+        costs one small frame and keeps straggler links correct."""
+        if not self.config.recon_gossip:
+            return False
+        dep = self._recon_deployment
+        if dep is None:
+            return True
+        return (
+            self.versionbits.state_for_next(
+                self.chain, self.chain.tip_hash, dep
+            )
+            is VBState.ACTIVE
+        )
+
+    def _recon_peer_active(self, peer: _Peer, now: float) -> bool:
+        """Is this link on the reconciliation plane right now?  Needs a
+        pairwise salt (real node, handshake done) and no standing
+        demotion."""
+        return (
+            peer.recon_salt is not None
+            and peer.is_node
+            and peer.recon_demoted_until <= now
+        )
+
+    def _recon_fail(self, peer: _Peer) -> None:
+        """Count one failed/stalled round; demote the link to plain
+        flooding after RECON_DEMOTE_FAILURES in a row.  Demotion is the
+        anti-poisoning story: a peer serving garbage sketches (or none)
+        costs us a few wasted frames and then only ITS link's
+        efficiency — honest relay continues via flood regardless."""
+        peer.recon_failures += 1
+        self.metrics.recon_fallbacks += 1
+        if peer.recon_failures >= RECON_DEMOTE_FAILURES:
+            peer.recon_failures = 0
+            peer.recon_demoted_until = self.clock.monotonic() + RECON_DEMOTE_S
+            peer.sync_demerits += 1
+            self.metrics.recon_demotions += 1
+            self.log.warning(
+                "peer %s demoted off recon plane for %.0fs",
+                peer.label,
+                RECON_DEMOTE_S,
+            )
+
+    async def _relay_tx(
+        self, tx: Transaction, txid: bytes, skip: _Peer | None = None
+    ) -> None:
+        """Relay one accepted tx: flood the spine, queue the rest.
+
+        Per link, in ``_peers`` insertion order: peers off the recon
+        plane are flooded exactly as before; the first
+        ``recon_flood_degree`` OUTBOUND and first ``recon_flood_degree``
+        INBOUND recon links also get the flood push — the low-latency
+        spine, symmetric on purpose: with the dial-earlier topologies
+        this repo builds, an outbound-only spine is a DAG pointing at
+        the oldest nodes and a tx could only climb back against it at
+        reconciliation cadence.  An attacker occupying an inbound spine
+        slot merely RECEIVES txs early (it controls nothing about our
+        relay to anyone else).  Every other recon link gets the tx
+        queued as a short id for its next reconciliation round.  Queue
+        overflow floods the oldest entry instead of dropping it — flood
+        is the pressure valve, reconciliation the optimisation, never
+        the reverse."""
+        payload = protocol.encode_tx(tx)
+        if not self._recon_enabled():
+            await self._gossip(payload, skip=skip)
+            return
+        now = self.clock.monotonic()
+        flood = []
+        spine_out = spine_in = max(0, self.config.recon_flood_degree)
+        for p in self._peers.values():
+            if p is skip:
+                continue
+            if not self._recon_peer_active(p, now):
+                flood.append(p)
+                continue
+            if p.dial_addr is not None and spine_out > 0:
+                spine_out -= 1
+                flood.append(p)
+                continue
+            if p.dial_addr is None and spine_in > 0:
+                spine_in -= 1
+                flood.append(p)
+                continue
+            p.recon_pending[reconcile.short_id(p.recon_salt, txid)] = txid
+            while len(p.recon_pending) > RECON_PENDING_MAX:
+                old_sid = next(iter(p.recon_pending))
+                old = self.mempool.get(p.recon_pending.pop(old_sid))
+                if old is not None:
+                    await self._gossip_peers([p], protocol.encode_tx(old))
+        await self._gossip_peers(flood, payload)
+
+    async def _recon_loop(self) -> None:
+        """The reconciliation heartbeat: every ``recon_interval_s``, age
+        out silent rounds, chase promised-but-undelivered txs (GETTX),
+        run any queued full-pool sync round, then initiate ONE steady-
+        state round, round-robin over outbound recon links.  One
+        initiation per tick, not a thundering herd — with every node
+        ticking, each link still reconciles once per interval on
+        average, from whichever end dialed it."""
+        while self._running:
+            await asyncio.sleep(self.config.recon_interval_s)
+            try:
+                await self._recon_tick()
+            except Exception:
+                # Heartbeat must survive one bad tick: flood fallback
+                # keeps relay correct even if reconciliation is wedged.
+                self.log.exception("recon tick failed")
+
+    async def _recon_tick(self) -> None:
+        now = self.clock.monotonic()
+        # Stall deadline for a round in flight.  Self-supervised HERE
+        # (not in the supervision loop) so the plane ages out silent
+        # responders even when sync supervision is disabled; a few
+        # intervals of slack tolerates a slow link, the send-timeout
+        # floor tolerates a long tick.
+        # Twice the send timeout, not equal to it: a round's SKETCH can
+        # legitimately serialize behind a congested uplink for several
+        # seconds, and aging it out at the first opportunity turns
+        # congestion into demotions into MORE flooding (measured in the
+        # relay-budget A/B before this slack was added).
+        stall_s = max(
+            8 * self.config.recon_interval_s, 2 * GOSSIP_SEND_TIMEOUT_S
+        )
+        chase = []
+        for p in list(self._peers.values()):
+            if (
+                p.recon_inflight_since is not None
+                and now - p.recon_inflight_since > stall_s
+            ):
+                await self._recon_fallback(p)
+            if p.recon_expect and p.recon_inflight_since is None:
+                chase.append(p)
+            if (
+                p.recon_full_pending
+                and p.recon_inflight_since is None
+                and self._recon_peer_active(p, now)
+            ):
+                p.recon_full_pending = False
+                await self._recon_start(p, full=True)
+        if chase:
+            # Announced-but-undelivered short ids: fetch explicitly,
+            # once, from ONE link per tick (round-robin).  The pacing is
+            # load-bearing, not politeness: during a propagation wave
+            # several links announce the SAME tx within one interval,
+            # and chasing them all in the same tick fetches that tx once
+            # per link.  Serialized, the first fetch lands before the
+            # next link's turn and ``_handle_tx``'s cross-link discard
+            # cancels the rest (measured: same-tick chasing re-bought a
+            # 2.4x duplicate-delivery factor the diff announcements had
+            # just eliminated).  The set is cleared either way, so a
+            # peer that never answers GETTX can't grow state or wedge
+            # anything.
+            self._recon_chase_rotate = (
+                self._recon_chase_rotate + 1
+            ) % len(chase)
+            p = chase[self._recon_chase_rotate]
+            sids = sorted(p.recon_expect)[: protocol.MAX_RECON_IDS]
+            p.recon_expect.clear()
+            await self._send_guarded(p, protocol.encode_gettx(sids))
+        if not self._recon_enabled() or self.governor.shedding:
+            # Under shed pressure the tx plane is already being dropped
+            # at admission; initiating new rounds would only add load.
+            return
+        # Initiate even with an empty local queue: the responder's queue
+        # for THIS link rides the same round (its pending freezes into
+        # the sketch window, and the decoded diff books it as "theirs"),
+        # so the dialing side's heartbeat is what drains BOTH
+        # directions.  An idle-link round costs ~30 bytes total.
+        candidates = [
+            p
+            for p in self._peers.values()
+            if p.dial_addr is not None
+            and p.recon_inflight_since is None
+            and self._recon_peer_active(p, now)
+        ]
+        if candidates:
+            self._recon_rotate = (self._recon_rotate + 1) % len(candidates)
+            await self._recon_start(candidates[self._recon_rotate], full=False)
+
+    async def _recon_start(self, peer: _Peer, full: bool) -> None:
+        """Freeze this link's queue into a round and request a sketch.
+        A full round (initial pool sync) additionally folds our whole
+        pool in, so the decoded difference is exactly the symmetric
+        difference of the two mempools."""
+        peer.recon_round = dict(peer.recon_pending)
+        peer.recon_pending.clear()
+        if full:
+            for txid in self.mempool.txids():
+                peer.recon_round.setdefault(
+                    reconcile.short_id(peer.recon_salt, txid), txid
+                )
+        peer.recon_round_full = full
+        peer.recon_inflight_since = self.clock.monotonic()
+        self.metrics.recon_rounds += 1
+        await self._send_guarded(
+            peer, protocol.encode_reqrecon(len(peer.recon_round), full=full)
+        )
+
+    async def _recon_close(self, peer: _Peer, diff) -> None:
+        """Successful decode on the initiator: announce the WHOLE
+        symmetric difference with RECONCILDIFF and book the half we
+        lack as expected.
+
+        Nobody pushes transactions here — that is the round-23 dedup
+        that made the byte budget real.  Each end books the diff ids it
+        doesn't recognize and fetches them with GETTX one heartbeat
+        LATER; a copy arriving from any other link in that window
+        cancels the fetch (``_handle_tx`` discards the id under every
+        link salt), so a tx crossing a well-connected mesh is sent to
+        each node once, not once per racing link.  An id costs 4 bytes
+        where an eager duplicate push costs a whole transaction —
+        measured in the relay-budget A/B, eager pushing tripled the
+        recon arm's bytes."""
+        round_, was_full = peer.recon_round, peer.recon_round_full
+        peer.recon_round = {}
+        peer.recon_round_full = False
+        peer.recon_inflight_since = None
+        peer.recon_failures = 0
+        self.metrics.recon_success += 1
+        # The decoded diff is ≤ sketch capacity (64), comfortably inside
+        # the frame's id cap.
+        await self._send_guarded(
+            peer, protocol.encode_recondiff(True, tuple(diff))
+        )
+        # Book only ids we recognize NOWHERE on this link.  The frozen
+        # round alone is not enough: a tx that arrived (from any link)
+        # after the freeze sits in this link's pending queue, shows up
+        # in the diff as "missing from the round", and booking it would
+        # fetch a copy we already hold — the arrival can't have
+        # cancelled a booking that didn't exist yet.
+        peer.recon_expect.update(
+            sid
+            for sid in diff
+            if sid not in round_
+            and sid not in peer.recon_pending
+            and sid not in peer.recon_window
+            and sid not in peer.recon_served
+        )
+        # Our half stays fetchable: the round becomes the serve station
+        # the peer's deferred GETTX resolves from, without a pool scan.
+        peer.recon_served = round_
+        if was_full:
+            # The supervised initial sync completed over the recon plane.
+            peer.mempool_inflight_since = None
+
+    async def _recon_fallback(self, peer: _Peer) -> None:
+        """Failed round on the initiator side (undecodable sketch or a
+        silent responder): tell the responder (best effort), degrade
+        THIS round to the pre-recon behavior — flood what it carried,
+        or classic cursor paging for a full-pool sync (flooding a whole
+        pool is exactly what reconciliation exists to avoid) — and
+        count toward demotion."""
+        round_, was_full = peer.recon_round, peer.recon_round_full
+        peer.recon_round = {}
+        peer.recon_round_full = False
+        peer.recon_inflight_since = None
+        self._recon_fail(peer)
+        await self._send_guarded(peer, protocol.encode_recondiff(False))
+        if was_full:
+            peer.mempool_inflight_since = self.clock.monotonic()
+            await self._send_guarded(peer, protocol.encode_getmempool(None))
+            return
+        for txid in round_.values():
+            tx = self.mempool.get(txid)
+            if tx is not None:
+                await self._gossip_peers([peer], protocol.encode_tx(tx))
 
     # -- chain/mempool handlers -----------------------------------------
 
@@ -4130,7 +4836,25 @@ class Node:
     async def _handle_tx(self, tx: Transaction, origin: _Peer | None = None) -> None:
         if self.mempool.add(tx):
             self.metrics.txs_accepted += 1
-            await self._gossip(protocol.encode_tx(tx), skip=origin)
+            txid = tx.txid()
+            # Arrival stamp for the propagation budget (bounded: drop
+            # the oldest entry like a poor man's deque-of-dict).
+            if len(self.tx_seen_at) >= 8192:
+                self.tx_seen_at.pop(next(iter(self.tx_seen_at)))
+            self.tx_seen_at[txid] = self.clock.monotonic()
+            # A delivered tx settles every link's RECONCILDIFF IOU for
+            # it, not just the origin's: other links may have announced
+            # the same tx in their own diffs, and discarding it here —
+            # under each link's own salt — is what turns racing
+            # announcements into ONE delivery instead of one per link
+            # (the round-23 dedup; eager cross-link pushes measured 3x
+            # the bytes).
+            for p in self._peers.values():
+                if p.recon_expect and p.recon_salt is not None:
+                    p.recon_expect.discard(
+                        reconcile.short_id(p.recon_salt, txid)
+                    )
+            await self._relay_tx(tx, txid, skip=origin)
 
     async def submit_tx(self, tx: Transaction) -> None:
         """Local API: inject a transaction (CLI/tests)."""
@@ -4359,6 +5083,30 @@ class Node:
             "wire": {
                 "bytes_sent": self.metrics.bytes_sent,
                 "bytes_received": self.metrics.bytes_received,
+                # Per-family relay-byte attribution (round 23): where
+                # this node's outbound bandwidth actually went, keyed by
+                # _RELAY_ACCOUNTING family ("tx" + "recon" = the relay
+                # plane the reconciliation work budgets).
+                "relay_bytes": self.metrics.relay_bytes(),
+            },
+            # Set-reconciliation relay (round 23, node/reconcile.py):
+            # round outcomes plus the per-link plane state.
+            "recon": {
+                "enabled": self._recon_enabled(),
+                "rounds": self.metrics.recon_rounds,
+                "sketches_served": self.metrics.recon_sketches_served,
+                "success": self.metrics.recon_success,
+                "fallbacks": self.metrics.recon_fallbacks,
+                "demotions": self.metrics.recon_demotions,
+                "txs_reconciled": self.metrics.txs_reconciled,
+                "active_links": sum(
+                    1
+                    for p in self._peers.values()
+                    if self._recon_peer_active(p, self.clock.monotonic())
+                ),
+                "pending": sum(
+                    len(p.recon_pending) for p in self._peers.values()
+                ),
             },
             "liveness": {
                 "pings_sent": self.metrics.pings_sent,

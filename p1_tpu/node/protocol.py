@@ -160,6 +160,33 @@ Messages:
              come back as ``{"ok": false, "error": "..."}`` rather
              than a dropped session: a refused maintenance command is
              an answer, not a protocol violation.
+- REQRECON:  u8 full + u32 set size — open one set-reconciliation round
+             (node/reconcile.py, the Erlay-analog relay plane): "my
+             pending-announcement window for you holds N short IDs;
+             sketch yours".  ``full`` = 1 asks the responder to sketch
+             its WHOLE pool (the initial mempool sync sharing the
+             short-ID machinery) instead of just the pending window.
+             The set size feeds the responder's capacity estimate; it
+             is advisory, never trusted past the capacity clamp.
+- SKETCH:    u32 set size + u16 word count + words * u32 syndromes —
+             the reconciliation sketch reply (word count is capped at
+             MAX_CAPACITY + 1; anything larger is a protocol
+             violation, the sketch-poisoning bound).  The requester
+             XORs its own equal-capacity sketch over the same salted
+             short-ID space and decodes the symmetric difference.
+- RECONCILDIFF: u8 success + u16 count + count * u32 short IDs — the
+             round-closing frame from the initiator.  success=1 lists
+             the decoded difference (the responder serves its side as
+             TX pushes and clears its frozen window); success=0 means
+             the difference exceeded the sketch capacity or the bytes
+             did not decode — both sides fall back to FLOOD for the
+             frozen window, so reconciliation failure costs bandwidth,
+             never transactions.
+- GETTX:     u16 count + count * u32 short IDs — fetch transactions by
+             salted short ID (the fallback/fetch half of the exchange:
+             the initiator asks for diff elements it cannot map
+             locally).  Unknown IDs are skipped, not errors — a missed
+             tx arrives on a later round or in a block.
 """
 
 from __future__ import annotations
@@ -174,6 +201,7 @@ from p1_tpu.chain.proof import TxProof
 from p1_tpu.core.block import Block
 from p1_tpu.core.header import HEADER_SIZE, BlockHeader
 from p1_tpu.core.tx import Transaction
+from p1_tpu.node.reconcile import MAX_CAPACITY as RECON_MAX_CAPACITY
 
 class ProtocolError(ValueError):
     """The peer sent bytes that violate the protocol (malformed frame,
@@ -221,8 +249,12 @@ _LEN = struct.Struct(">I")
 #: (SUBSCRIBE/EVENT/UNSUBSCRIBE — watch-filter subscriptions pushed at
 #: block connect with gap-free resume cursors — plus GETFILTERHEADERS/
 #: FILTERHEADERS, the BIP157-analog filter-header commitment chain a
-#: wallet cross-checks untrusted filter streams against).
-PROTOCOL_VERSION = 14
+#: wallet cross-checks untrusted filter streams against); v15 the
+#: bandwidth-scale relay plane (REQRECON/SKETCH/RECONCILDIFF/GETTX —
+#: Erlay-analog set-reconciliation tx gossip over salted short IDs,
+#: node/reconcile.py, with flood kept as the fallback and for block
+#: announces).
+PROTOCOL_VERSION = 15
 _HELLO = struct.Struct(">B32sIHQ")
 
 
@@ -272,6 +304,10 @@ class MsgType(enum.IntEnum):
     UNSUBSCRIBE = 35
     GETFILTERHEADERS = 36
     FILTERHEADERS = 37
+    REQRECON = 38
+    SKETCH = 39
+    RECONCILDIFF = 40
+    GETTX = 41
 
 
 #: The wire version that introduced each frame type — the version-gate
@@ -322,6 +358,10 @@ MSG_SINCE: dict[MsgType, int] = {
     MsgType.UNSUBSCRIBE: 14,
     MsgType.GETFILTERHEADERS: 14,
     MsgType.FILTERHEADERS: 14,
+    MsgType.REQRECON: 15,
+    MsgType.SKETCH: 15,
+    MsgType.RECONCILDIFF: 15,
+    MsgType.GETTX: 15,
 }
 assert set(MSG_SINCE) == set(MsgType), "every frame type needs a version row"
 assert all(1 <= v <= PROTOCOL_VERSION for v in MSG_SINCE.values())
@@ -888,6 +928,61 @@ def encode_mempool(raw_txs: list[bytes], more: bool = False) -> bytes:
     return b"".join(parts)
 
 
+#: SKETCH word ceiling: the codec's capacity clamp plus its reserved
+#: verification syndrome.  Decoding rejects anything larger OUTRIGHT —
+#: an adversarial sketch must not be able to buy unbounded field work.
+MAX_SKETCH_WORDS = RECON_MAX_CAPACITY + 1
+#: RECONCILDIFF/GETTX short-ID ceiling: a decoded difference can never
+#: exceed the capacity clamp, so honest frames stay far below this.
+MAX_RECON_IDS = 256
+
+
+def encode_reqrecon(set_size: int, full: bool = False) -> bytes:
+    if not 0 <= set_size <= 0xFFFFFFFF:
+        raise ValueError("bad reconciliation set size")
+    return bytes([MsgType.REQRECON]) + struct.pack(">BI", int(full), set_size)
+
+
+def encode_sketch(set_size: int, sketch: bytes) -> bytes:
+    """``sketch`` is the serialized codec output (node/reconcile.py) —
+    whole 4-byte words, at least capacity 1, at most the clamp."""
+    if not 0 <= set_size <= 0xFFFFFFFF:
+        raise ValueError("bad reconciliation set size")
+    words = len(sketch) // 4
+    if len(sketch) % 4 or not 2 <= words <= MAX_SKETCH_WORDS:
+        raise ValueError("bad sketch size")
+    return (
+        bytes([MsgType.SKETCH])
+        + struct.pack(">IH", set_size, words)
+        + sketch
+    )
+
+
+def _pack_short_ids(short_ids) -> bytes:
+    ids = list(short_ids)
+    if len(ids) > MAX_RECON_IDS:
+        raise ValueError("too many short IDs for one frame")
+    if any(not 0 <= s <= 0xFFFFFFFF for s in ids):
+        raise ValueError("short ID out of range")
+    return struct.pack(">H", len(ids)) + struct.pack(
+        f">{len(ids)}I", *ids
+    )
+
+
+def encode_recondiff(success: bool, short_ids=()) -> bytes:
+    return (
+        bytes([MsgType.RECONCILDIFF, int(success)])
+        + _pack_short_ids(short_ids)
+    )
+
+
+def encode_gettx(short_ids) -> bytes:
+    ids = list(short_ids)
+    if not ids:
+        raise ValueError("GETTX needs at least one short ID")
+    return bytes([MsgType.GETTX]) + _pack_short_ids(ids)
+
+
 def decode(payload: bytes):
     """(MsgType, decoded body) for one frame payload; raises
     ``ProtocolError`` (a ValueError) on malformed input — the peer loop
@@ -1321,7 +1416,46 @@ def _decode(payload: bytes):
         if off != len(body):
             raise ValueError("trailing bytes in MEMPOOL")
         return mtype, (bool(more), txs)
+    if mtype is MsgType.REQRECON:
+        if len(body) != 5:
+            raise ValueError("bad REQRECON")
+        full, set_size = struct.unpack(">BI", body)
+        if full > 1:
+            raise ValueError("bad REQRECON full flag")
+        return mtype, (bool(full), set_size)
+    if mtype is MsgType.SKETCH:
+        if len(body) < 6:
+            raise ValueError("bad SKETCH")
+        set_size, words = struct.unpack_from(">IH", body)
+        if not 2 <= words <= MAX_SKETCH_WORDS:
+            raise ValueError("bad SKETCH word count")
+        if len(body) != 6 + 4 * words:
+            raise ValueError("bad SKETCH size")
+        return mtype, (set_size, body[6:])
+    if mtype is MsgType.RECONCILDIFF:
+        if len(body) < 3:
+            raise ValueError("bad RECONCILDIFF")
+        success = body[0]
+        if success > 1:
+            raise ValueError("bad RECONCILDIFF flag")
+        return mtype, (bool(success), _unpack_short_ids(body[1:]))
+    if mtype is MsgType.GETTX:
+        ids = _unpack_short_ids(body)
+        if not ids:
+            raise ValueError("empty GETTX")
+        return mtype, ids
     raise AssertionError(mtype)
+
+
+def _unpack_short_ids(body: bytes) -> tuple:
+    if len(body) < 2:
+        raise ValueError("bad short-ID list")
+    (n,) = struct.unpack_from(">H", body)
+    if n > MAX_RECON_IDS:
+        raise ValueError("too many short IDs")
+    if len(body) != 2 + 4 * n:
+        raise ValueError("bad short-ID list size")
+    return struct.unpack_from(f">{n}I", body, 2)
 
 
 async def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:

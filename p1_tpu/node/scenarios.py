@@ -2108,6 +2108,630 @@ def fleet_failover(
     return net.run(main())
 
 
+# -- relay bandwidth budget: flood vs set reconciliation ------------------
+
+
+def _tx_plane_bytes(node) -> int:
+    """Bytes this node has SENT on the transaction plane: TX pushes plus
+    every reconciliation frame (node.py ``_RELAY_ACCOUNTING`` families
+    ``tx`` + ``recon``).  Blocks, serves, control are excluded — the
+    budget under test is tx relay, and nothing else runs during the
+    measured storm anyway."""
+    rb = node.metrics.relay_bytes()
+    return rb.get("tx", 0) + rb.get("recon", 0)
+
+
+_PROP_BUCKETS_MS = (25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000)
+
+
+def _prop_histogram(delays_ms: list[float]) -> dict:
+    """Fixed-bucket histogram + quantiles of per-(tx, node) propagation
+    delays, virtual milliseconds — the per-arm telemetry the A/B report
+    carries so a regression shows WHERE the tail moved, not just that
+    one number crossed another."""
+    buckets = {f"le_{b}ms": 0 for b in _PROP_BUCKETS_MS}
+    buckets["inf"] = 0
+    s = sorted(delays_ms)
+    for d in s:
+        for b in _PROP_BUCKETS_MS:
+            if d <= b:
+                buckets[f"le_{b}ms"] += 1
+                break
+        else:
+            buckets["inf"] += 1
+
+    def pick(q: float) -> float:
+        return round(s[min(len(s) - 1, int(q * len(s)))], 1) if s else 0.0
+
+    return {
+        "count": len(s),
+        "p50_ms": pick(0.50),
+        "p95_ms": pick(0.95),
+        "max_ms": round(s[-1], 1) if s else 0.0,
+        "buckets": buckets,
+    }
+
+
+def relay_budget(
+    nodes: int = 16,
+    seed: int = 0,
+    difficulty: int = 8,
+    degree: int = 6,
+    senders: int = 4,
+    txs_per_sender: int = 48,
+    storm_vs: float = 30.0,
+    egress_bps: float = 64_000.0,
+    recon_interval_s: float = 0.25,
+    recon_flood_degree: int = 0,
+    min_reduction: float = 5.0,
+    wall_limit_s: float | None = 420.0,
+) -> dict:
+    """THE tentpole A/B (round 23): the identical mesh, the identical
+    seeded tx storm, run twice — arm one floods transactions (the
+    pre-round-23 relay), arm two reconciles them (``recon_gossip``) —
+    and the report holds both arms' per-link byte totals and propagation
+    histograms side by side.
+
+    Every host sits behind a shared ``egress_bps`` uplink (the netsim
+    per-host shaping this round added): that is the physical budget
+    flooding actually spends, because a node that pushes a tx to
+    ``degree`` neighbors serializes ``degree`` copies through ONE access
+    link.  The recon arm runs spine-less (``recon_flood_degree=0``, the
+    bandwidth-optimal configuration: every tx push is diff-driven, so
+    nothing is ever sent to a peer that already has it) and must win on
+    BOTH axes at once — bytes AND latency — because the flood arm's
+    duplicates are what saturate the shared uplinks.
+
+    ok = tx-plane bytes per transaction drop by at least
+    ``min_reduction`` (the ISSUE's >=5x budget) AND the recon arm's
+    propagation p95 is equal-or-better — efficiency may not be bought
+    with latency.  An absurd ``min_reduction`` (the impossible-bound
+    control, pinned by tests/test_scenarios.py) must fail."""
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import BLOCK_REWARD, Transaction
+
+    assert txs_per_sender * 2 <= 2 * BLOCK_REWARD, (
+        "storm shape exceeds the two-coinbase wallet budget"
+    )
+    total_txs = senders * txs_per_sender
+    wallets = [
+        Keypair.from_seed_text(f"p1-relay-{seed}-{k}") for k in range(senders)
+    ]
+    payee = Keypair.from_seed_text(f"p1-relay-payee-{seed}")
+    genesis = genesis_hash(difficulty)
+    t0 = time.monotonic()
+
+    def arm(recon: bool) -> dict:
+        net = SimNet(
+            seed=seed,
+            difficulty=difficulty,
+            default_profile=LinkProfile(latency_s=0.01, jitter_s=0.002),
+        )
+
+        async def main():
+            rng = random.Random(seed ^ 0x3E1A)
+            for i in range(nodes):
+                await net.add_node(
+                    peers=[
+                        net.host_name(j)
+                        for j in _topology_peers(rng, i, degree)
+                    ],
+                    recon_gossip=recon,
+                    recon_interval_s=recon_interval_s,
+                    recon_flood_degree=recon_flood_degree,
+                    miner_id="pool",
+                )
+            hosts = list(net.nodes)
+            miner = net.nodes[hosts[0]]
+            assert await net.run_until(
+                net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+            ), "mesh never formed"
+            # Two coinbases per sender wallet: budget for 48 amount-1
+            # fee-1 transfers each.
+            for w in wallets:
+                for _ in range(2):
+                    miner.miner_id = w.account
+                    await net.mine_on(miner, spacing_s=1.0)
+            miner.miner_id = "pool"
+            fund_height = miner.chain.height
+            assert await net.run_until(
+                lambda: net.converged() and min(net.heights()) == fund_height,
+                60, step=0.25, wall_limit_s=wall_limit_s,
+            ), "mesh never converged post-funding"
+
+            # The uplinks close AFTER funding: the storm is the measured
+            # phase, and block sync shouldn't pay the shaped price.
+            for h in hosts:
+                net.net.host_egress[h] = egress_bps
+            base_plane = sum(_tx_plane_bytes(n) for n in net.nodes.values())
+            base_links = dict(net.net.link_bytes)
+            submits: dict[bytes, tuple[str, float]] = {}
+
+            async def sender(k: int) -> None:
+                host = hosts[(k * nodes) // senders]
+                node, w = net.nodes[host], wallets[k]
+                gap = storm_vs / txs_per_sender
+                for s in range(txs_per_sender):
+                    await asyncio.sleep(gap)
+                    tx = Transaction.transfer(
+                        w, payee.account, 1, 1, s, chain=genesis
+                    )
+                    submits[tx.txid()] = (host, net.clock.now)
+                    await node.submit_tx(tx)
+
+            await asyncio.gather(*(sender(k) for k in range(senders)))
+            want = set(submits)
+            delivered = await net.run_until(
+                lambda: all(
+                    want <= n.tx_seen_at.keys()
+                    for n in net.nodes.values()
+                ),
+                180, step=0.25, wall_limit_s=wall_limit_s,
+            )
+            # Measure the instant delivery completes: recon idle rounds
+            # up to here are honestly charged to the recon arm.
+            plane = sum(_tx_plane_bytes(n) for n in net.nodes.values())
+            delays_ms = [
+                1000.0 * (n.tx_seen_at[txid] - t_sub)
+                for txid, (origin, t_sub) in submits.items()
+                for h, n in net.nodes.items()
+                if h != origin and txid in n.tx_seen_at
+            ]
+            link_deltas = [
+                total - base_links.get(key, 0)
+                for key, total in net.net.link_bytes.items()
+            ]
+            recon_stats = {
+                "rounds": sum(
+                    n.metrics.recon_rounds for n in net.nodes.values()
+                ),
+                "success": sum(
+                    n.metrics.recon_success for n in net.nodes.values()
+                ),
+                "fallbacks": sum(
+                    n.metrics.recon_fallbacks for n in net.nodes.values()
+                ),
+                "txs_reconciled": sum(
+                    n.metrics.txs_reconciled for n in net.nodes.values()
+                ),
+            }
+            out = {
+                "arm": "recon" if recon else "flood",
+                "delivered": delivered,
+                "tx_plane_bytes": plane - base_plane,
+                "bytes_per_tx": round((plane - base_plane) / total_txs, 1),
+                "link_bytes_storm_total": sum(link_deltas),
+                "link_bytes_storm_max": max(link_deltas, default=0),
+                "propagation": _prop_histogram(delays_ms),
+                "recon": recon_stats,
+            }
+            if recon:
+                # The framework report (converged / conserved / digest)
+                # must read the nodes BEFORE stop_all pops them.
+                out["_base"] = _report(
+                    net, "relay-budget", t0,
+                    repro_flags=f"--nodes {nodes}",
+                )
+            else:
+                out["trace_digest"] = net.trace_digest()
+            await net.stop_all()
+            return out
+
+        return net.run(main())
+
+    flood = arm(recon=False)
+    recon = arm(recon=True)
+    base = recon.pop("_base")
+    recon["trace_digest"] = base["trace_digest"]
+    reduction = (
+        flood["bytes_per_tx"] / recon["bytes_per_tx"]
+        if recon["bytes_per_tx"]
+        else float("inf")
+    )
+    report = dict(
+        base,
+        total_txs=total_txs,
+        egress_bps=egress_bps,
+        flood=flood,
+        recon=recon,
+        relay_bytes_per_tx={
+            "flood": flood["bytes_per_tx"], "recon": recon["bytes_per_tx"]
+        },
+        reduction=round(reduction, 2),
+        min_reduction=min_reduction,
+    )
+    report["ok"] = bool(
+        flood["delivered"]
+        and recon["delivered"]
+        and reduction >= min_reduction
+        # Equal-or-better: the byte win may not cost latency.
+        and recon["propagation"]["p95_ms"] <= flood["propagation"]["p95_ms"]
+        and recon["recon"]["success"] > 0
+    )
+    return report
+
+
+# -- reconciliation overload: the flood fallback --------------------------
+
+
+def recon_fallback(
+    nodes: int = 5,
+    seed: int = 0,
+    difficulty: int = 8,
+    burst: int = 80,
+    recon_interval_s: float = 1.0,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """Overload the sketch: one node takes a ``burst`` of transactions
+    (> the codec's MAX_CAPACITY=64) inside a single reconciliation
+    interval, with the flood spine OFF (``recon_flood_degree=0``) so the
+    whole burst must ride one round.  The set difference exceeds any
+    sketch the responder can serve, decode fails — DETECTED, by the
+    codec's verification syndrome, not mis-decoded — and the initiator's
+    RECONCILDIFF(failure) makes both ends flood their frozen windows.
+
+    ok = every burst tx reaches every node anyway (flood is the pressure
+    valve), at least one fallback was counted, and NO link was demoted —
+    overload is congestion, not misbehavior, and one failed round must
+    not cost a link its recon plane."""
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import BLOCK_REWARD, Transaction
+    from p1_tpu.node.reconcile import MAX_CAPACITY
+
+    assert burst > MAX_CAPACITY, "burst must exceed sketch capacity"
+    coinbases = (2 * burst + BLOCK_REWARD - 1) // BLOCK_REWARD
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    wallet = Keypair.from_seed_text(f"p1-burst-{seed}")
+    payee = Keypair.from_seed_text(f"p1-burst-payee-{seed}")
+
+    async def main():
+        rng = random.Random(seed ^ 0xFA11)
+        for i in range(nodes):
+            await net.add_node(
+                peers=[
+                    net.host_name(j) for j in _topology_peers(rng, i, 2)
+                ],
+                recon_gossip=True,
+                recon_interval_s=recon_interval_s,
+                recon_flood_degree=0,
+                miner_id="pool",
+            )
+        hosts = list(net.nodes)
+        origin = net.nodes[hosts[0]]
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        for _ in range(coinbases):
+            origin.miner_id = wallet.account
+            await net.mine_on(origin, spacing_s=1.0)
+        origin.miner_id = "pool"
+        fund_height = origin.chain.height
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == fund_height,
+            60, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged post-funding"
+
+        genesis = genesis_hash(difficulty)
+        # The whole burst lands at ONE virtual instant: submit_tx never
+        # sleeps, so no reconciliation tick can slice the burst into
+        # decodable halves.
+        txids = []
+        for s in range(burst):
+            tx = Transaction.transfer(
+                wallet, payee.account, 1, 1, s, chain=genesis
+            )
+            txids.append(tx.txid())
+            await origin.submit_tx(tx)
+        want = set(txids)
+        delivered = await net.run_until(
+            lambda: all(
+                want <= n.tx_seen_at.keys() for n in net.nodes.values()
+            ),
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        fallbacks = sum(
+            n.metrics.recon_fallbacks for n in net.nodes.values()
+        )
+        demotions = sum(
+            n.metrics.recon_demotions for n in net.nodes.values()
+        )
+        report = _report(
+            net, "recon-fallback", t0,
+            repro_flags=f"--burst {burst}",
+            burst=burst,
+            delivered=delivered,
+            recon_fallbacks=fallbacks,
+            recon_demotions=demotions,
+            recon_rounds=sum(
+                n.metrics.recon_rounds for n in net.nodes.values()
+            ),
+        )
+        report["ok"] = bool(
+            delivered
+            and report["converged"]
+            and report["ledger_conserved"]
+            and fallbacks >= 1
+            and demotions == 0
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
+# -- sketch poisoning: the recon plane's byzantine containment ------------
+
+
+def recon_poison(
+    nodes: int = 8,
+    seed: int = 0,
+    difficulty: int = 8,
+    honest_txs: int = 24,
+    storm_vs: float = 30.0,
+    recon_interval_s: float = 0.5,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """A ``sketch_poisoner`` (node/byzantine.py) camps a listening
+    address; the victim node dials it as a configured peer, so the
+    poisoner sits on the victim's OUTBOUND recon rotation — garbage
+    sketches fail every round the victim initiates there, fabricated
+    RECONCILDIFFs promise short ids nothing maps to, and REQRECON/GETTX
+    spam burns responder serves.
+
+    The containment under test: the victim burns RECON_DEMOTE_FAILURES
+    rounds, demotes the link to plain flood (``recon_demotions``), and
+    honest relay NEVER stalls — every honest tx reaches every honest
+    node while reconciliation keeps succeeding on honest links.  ok
+    asserts exactly that, plus that the poisoner really served garbage
+    (its stats say so) and the honest mesh stayed converged."""
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+    from p1_tpu.node.byzantine import new_stats, sketch_poisoner
+
+    POISON_HOST = "66.6.0.66"
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    wallet = Keypair.from_seed_text(f"p1-poison-{seed}")
+    payee = Keypair.from_seed_text(f"p1-poison-payee-{seed}")
+    stats = new_stats()
+
+    async def main():
+        rng = random.Random(seed ^ 0x9013)
+        deadline = net.clock.wall() + storm_vs + 120
+        poison_task = asyncio.ensure_future(
+            sketch_poisoner(
+                POISON_HOST, NODE_PORT, difficulty, deadline, None,
+                stats, transport=net.net.host(POISON_HOST),
+            )
+        )
+        dials = 0
+        for i in range(nodes):
+            peers = [net.host_name(j) for j in _topology_peers(rng, i, 3)]
+            if i == nodes - 1:
+                peers.append(POISON_HOST)  # the victim dials the trap
+            dials += len(peers)
+            await net.add_node(
+                peers=peers,
+                recon_gossip=True,
+                recon_interval_s=recon_interval_s,
+                miner_id="pool",
+            )
+        hosts = list(net.nodes)
+        victim = net.nodes[hosts[-1]]
+        # links_up can't apply: the poisoner end registers no _Peer, so
+        # the poisoner dial contributes 1 registration, not 2.
+        assert await net.run_until(
+            lambda: sum(n.peer_count() for n in net.nodes.values())
+            >= 2 * (dials - 1) + 1,
+            60, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never formed"
+        miner = net.nodes[hosts[0]]
+        for _ in range(2):
+            miner.miner_id = wallet.account
+            await net.mine_on(miner, spacing_s=1.0)
+        miner.miner_id = "pool"
+        fund_height = miner.chain.height
+        assert await net.run_until(
+            lambda: net.converged() and min(net.heights()) == fund_height,
+            60, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never converged post-funding"
+
+        genesis = genesis_hash(difficulty)
+        txids = []
+        gap = storm_vs / honest_txs
+        for s in range(honest_txs):
+            await asyncio.sleep(gap)
+            tx = Transaction.transfer(
+                wallet, payee.account, 1, 1, s, chain=genesis
+            )
+            txids.append(tx.txid())
+            await net.nodes[hosts[s % (nodes - 1)]].submit_tx(tx)
+        want = set(txids)
+        delivered = await net.run_until(
+            lambda: all(
+                want <= n.tx_seen_at.keys() for n in net.nodes.values()
+            ),
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        poison_task.cancel()
+        try:
+            await poison_task
+        except asyncio.CancelledError:
+            pass
+        honest_success = sum(
+            n.metrics.recon_success for n in net.nodes.values()
+        )
+        report = _report(
+            net, "recon-poison", t0,
+            honest_txs=honest_txs,
+            delivered=delivered,
+            victim_demotions=victim.metrics.recon_demotions,
+            victim_fallbacks=victim.metrics.recon_fallbacks,
+            honest_recon_success=honest_success,
+            poisoner_attacks=dict(stats["attacks"]),
+        )
+        report["ok"] = bool(
+            delivered
+            and report["converged"]
+            and report["ledger_conserved"]
+            # The attack really ran: garbage sketches were served and
+            # the victim paid with demotion, not with stalled relay.
+            and stats["attacks"].get("garbage_sketch", 0) >= 1
+            and victim.metrics.recon_demotions >= 1
+            # ... while reconciliation kept working between honest ends.
+            and honest_success > 0
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
+# -- mixed-version mesh: recon activates by version bits ------------------
+
+
+def recon_mixed(
+    nodes: int = 8,
+    seed: int = 0,
+    difficulty: int = 8,
+    vb_window: int = 8,
+    vb_threshold: int = 6,
+    txs_per_phase: int = 8,
+    recon_interval_s: float = 0.5,
+    wall_limit_s: float | None = 300.0,
+) -> dict:
+    """Recon rides PR 17's evolution contract: upgraded nodes carry a
+    "txrecon" version-bits deployment AND ``recon_gossip=True``, one
+    straggler runs the legacy table with flood-only relay.  Before the
+    deployment is ACTIVE the upgraded nodes must keep flooding (zero
+    reconciliation rounds — the wire stays the shared dialect); after
+    the miners' signals lock it in and activate it, reconciliation
+    starts among upgraded links while the straggler keeps receiving
+    every tx by flood and by answering sketches it never initiates.
+
+    ok = both phases' txs reach EVERY node including the straggler, no
+    rounds ran pre-activation, rounds succeed post-activation, and the
+    mixed mesh never forked."""
+    from p1_tpu.core.genesis import genesis_hash
+    from p1_tpu.core.keys import Keypair
+    from p1_tpu.core.tx import Transaction
+
+    deploy = (("txrecon", 0, vb_window, vb_window * 16),)
+    activation_height = 3 * vb_window
+    net = SimNet(seed=seed, difficulty=difficulty)
+    t0 = time.monotonic()
+    wallet = Keypair.from_seed_text(f"p1-mixed-{seed}")
+    payee = Keypair.from_seed_text(f"p1-mixed-payee-{seed}")
+
+    async def main():
+        rng = random.Random(seed ^ 0x717C)
+        for i in range(nodes - 1):
+            await net.add_node(
+                peers=[net.host_name(j) for j in _topology_peers(rng, i, 3)],
+                recon_gossip=True,
+                recon_interval_s=recon_interval_s,
+                deployments=deploy,
+                vb_window=vb_window,
+                vb_threshold=vb_threshold,
+                miner_id="pool",
+            )
+        hosts = list(net.nodes)
+        straggler = await net.add_node(peers=[hosts[0], hosts[-1]])
+        assert await net.run_until(
+            net.links_up, 60, step=0.25, wall_limit_s=wall_limit_s
+        ), "mesh never formed"
+        miner = net.nodes[hosts[0]]
+        for _ in range(2):
+            miner.miner_id = wallet.account
+            await net.mine_on(miner, spacing_s=1.0)
+        miner.miner_id = "pool"
+        genesis = genesis_hash(difficulty)
+
+        async def submit_wave(first_seq: int) -> set[bytes]:
+            ids = set()
+            for s in range(first_seq, first_seq + txs_per_phase):
+                tx = Transaction.transfer(
+                    wallet, payee.account, 1, 1, s, chain=genesis
+                )
+                ids.add(tx.txid())
+                await net.nodes[hosts[s % (nodes - 1)]].submit_tx(tx)
+                await asyncio.sleep(0.5)
+            return ids
+
+        # Phase A: pre-activation.  Upgraded nodes have recon configured
+        # but the deployment gate holds it shut.
+        pre = await submit_wave(0)
+        pre_delivered = await net.run_until(
+            lambda: all(
+                pre <= n.tx_seen_at.keys() for n in net.nodes.values()
+            ),
+            60, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        rounds_pre = sum(
+            n.metrics.recon_rounds for n in net.nodes.values()
+        )
+
+        # Every block an upgraded miner seals signals bit 0; the
+        # straggler just follows.  Walk the ladder to ACTIVE.
+        while miner.chain.height < activation_height:
+            await net.mine_on(
+                net.nodes[hosts[miner.chain.height % (nodes - 1)]],
+                spacing_s=1.0,
+            )
+        assert await net.run_until(
+            lambda: net.converged()
+            and min(net.heights()) >= activation_height,
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        ), "mesh never reached activation height"
+        state = miner.versionbits.states_report(miner.chain)["txrecon"][
+            "state"
+        ]
+
+        # Phase B: post-activation.  Recon runs among upgraded links;
+        # the straggler still sees everything.
+        post = await submit_wave(txs_per_phase)
+        post_delivered = await net.run_until(
+            lambda: all(
+                post <= n.tx_seen_at.keys() for n in net.nodes.values()
+            ),
+            120, step=0.25, wall_limit_s=wall_limit_s,
+        )
+        success_post = sum(
+            n.metrics.recon_success for n in net.nodes.values()
+        )
+        settled = await net.run_until(
+            net.converged, 60, step=0.25, wall_limit_s=wall_limit_s
+        )
+        report = _report(
+            net, "recon-mixed", t0,
+            activation_state=state,
+            activation_height=activation_height,
+            pre_delivered=pre_delivered,
+            post_delivered=post_delivered,
+            recon_rounds_pre_activation=rounds_pre,
+            recon_success_post_activation=success_post,
+            straggler_txs_seen=len(straggler.tx_seen_at),
+        )
+        report["ok"] = bool(
+            pre_delivered
+            and post_delivered
+            and settled
+            and report["ledger_conserved"]
+            and state == "active"
+            # The wire contract held: silent pre-activation, live after.
+            and rounds_pre == 0
+            and success_post > 0
+        )
+        await net.stop_all()
+        return report
+
+    return net.run(main())
+
+
 SCENARIOS = {
     "partition-heal": partition_heal,
     "flash-crowd": flash_crowd,
@@ -2123,6 +2747,10 @@ SCENARIOS = {
     "version-activation": version_activation,
     "fleet-failover": fleet_failover,
     "soak": soak,
+    "relay-budget": relay_budget,
+    "recon-fallback": recon_fallback,
+    "recon-poison": recon_poison,
+    "recon-mixed": recon_mixed,
 }
 
 

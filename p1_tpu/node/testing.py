@@ -135,6 +135,12 @@ class FaultPlan:
     hello_height: int | None = None
     #: MEMPOOL reply shape: the ``more`` flag on served pages.
     mempool_more: bool = False
+    #: Answer GETMEMPOOL with EMPTY pages that claim ``more=True``
+    #: forever — the round-23 initial-sync starvation: each page is
+    #: well-formed and "progress" by frame count, but the tail never
+    #: arrives and the pool never advances.  The page supervisor must
+    #: read it as a stall (zero NEW txs per page), not as progress.
+    mempool_empty_tail: bool = False
     #: Snapshot-serving pathologies (chain/snapshot.py, GETSNAPSHOT).
     #: ``snapshot_lie`` corrupts the SERVED STATE: "balance" inflates
     #: one account by 1000 with the manifest root computed over the lie
@@ -568,6 +574,8 @@ class HostilePeer:
             blocks = [] if plan.empty_replies else self._after(body)
             return protocol.encode_headers([b.header for b in blocks])
         if mtype is MsgType.GETMEMPOOL:
+            if plan.mempool_empty_tail:
+                return protocol.encode_mempool([], more=True)
             raws = [tx.serialize() for tx in self.mempool_txs]
             return protocol.encode_mempool(raws, more=plan.mempool_more)
         if mtype is MsgType.GETBLOCKTXN:

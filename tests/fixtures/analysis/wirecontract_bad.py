@@ -9,7 +9,10 @@ one per member, each anchored at the member's enum line:
 - BLOCK is in neither ``_SHED_DROPS`` nor ``_SHED_KEEPS`` — the
   negative control the acceptance criteria name (key ``BLOCK:shed``);
 - TX has no ``_dispatch`` arm (key ``TX:dispatch``);
-- STATUS has no ``MSG_SINCE`` version row (key ``STATUS:version``).
+- STATUS has no ``MSG_SINCE`` version row (key ``STATUS:version``);
+- HELLO has no ``_RELAY_ACCOUNTING`` family (key ``HELLO:relay``) —
+  round 23's seventh aspect: unaccounted egress is bandwidth the
+  propagation budget can't see.
 """
 
 import enum
@@ -18,7 +21,7 @@ PROTOCOL_VERSION = 9
 
 
 class MsgType(enum.IntEnum):
-    HELLO = 1
+    HELLO = 1  # LINT
     BLOCK = 2  # LINT
     TX = 3  # LINT
     STATUS = 4  # LINT
@@ -63,6 +66,12 @@ _ADMISSION_EXEMPT = frozenset({MsgType.HELLO, MsgType.STATUS})
 _SHED_DROPS = frozenset({MsgType.TX})
 
 _SHED_KEEPS = frozenset({MsgType.HELLO, MsgType.STATUS})
+
+_RELAY_ACCOUNTING = {
+    MsgType.BLOCK: "block",
+    MsgType.TX: "tx",
+    MsgType.STATUS: "control",
+}
 
 MSG_SINCE = {
     MsgType.HELLO: 1,

@@ -186,7 +186,12 @@ class TestHistoricalReproductions:
         )
         keys = {f.key for f in findings}
         assert "BLOCK:shed" in keys, keys
-        assert keys == {"BLOCK:shed", "TX:dispatch", "STATUS:version"}
+        assert keys == {
+            "BLOCK:shed",
+            "TX:dispatch",
+            "STATUS:version",
+            "HELLO:relay",
+        }
 
 
 class TestSettlement:
@@ -357,6 +362,22 @@ class TestInterprocedural:
             )
             findings = list(RULES["wire-contract"].check_package(idx))
             assert [f.key for f in findings] == [expect], (needle, findings)
+
+    def test_wire_contract_guards_the_relay_accounting_table(self):
+        """Round-23 mutation control: every frame type's egress must
+        land in a relay.bytes.* family — drop GETTX's row from the
+        PARSED node.py and the gate must fail at exactly GETTX:relay
+        (the runtime assert beside the table enforces it too; the rule
+        fails BEFORE the code ever runs)."""
+        src = (PKG_ROOT / "node" / "node.py").read_text()
+        idx = self._package_index()
+        mutated = src.replace('MsgType.GETTX: "recon",', "", 1)
+        assert mutated != src
+        idx.trees["node/node.py"] = ast.parse(
+            mutated, filename="node/node.py"
+        )
+        findings = list(RULES["wire-contract"].check_package(idx))
+        assert [f.key for f in findings] == ["GETTX:relay"], findings
 
     def test_transitive_blocking_grants_read_as_the_roadmap2_work_list(self):
         """Acceptance: every transitive-blocking grant names a concrete

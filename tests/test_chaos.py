@@ -372,6 +372,23 @@ class TestInvariantSweep:
                 failures.append((seed, report["violations"]))
         assert not failures, failures
 
+    def test_recon_sweep_holds_every_invariant(self):
+        """Round 23: the same schedule corpus with set-reconciliation
+        relay ON mesh-wide (recon=True — no deployment table, recon
+        from block 0).  Crashes, partitions, and reorgs land on nodes
+        whose tx relay is sketch rounds + deferred GETTX fetches, and
+        every invariant (convergence, conservation, mempool checkpoint
+        consistency) must hold exactly as under flood.  Opt-in kwarg,
+        so the seed-stable digest corpus above is untouched."""
+        failures = []
+        for seed in range(10):
+            report = chaos.run_chaos(
+                seed, nodes=5, n_events=10, recon=True
+            )
+            if not report["ok"]:
+                failures.append((seed, report["violations"]))
+        assert not failures, failures
+
     @pytest.mark.slow
     def test_wide_sweep_200_schedules(self):
         failures = []

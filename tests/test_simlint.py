@@ -63,6 +63,18 @@ class TestWallClockLint:
         ), [str(f) for f in report.findings if f.file == "chain/snapshot.py"]
         assert "chain/snapshot.py" not in GRANTS["wall-clock"]
 
+    def test_recon_codec_is_clock_free_with_zero_grants(self):
+        """Round 23's module ships lint-covered and CLEAN: the sketch
+        codec is pure GF(2^32) arithmetic over bytes — no clock, no
+        rng, no loop — and every consumer-side timing decision (round
+        cadence, stall aging, demotion windows) lives in node/node.py
+        under ITS existing grant, reading time through ``Node.clock``."""
+        report = _wallclock_report()
+        assert not any(
+            f.file == "node/reconcile.py" for f in report.findings
+        ), [str(f) for f in report.findings if f.file == "node/reconcile.py"]
+        assert "node/reconcile.py" not in GRANTS["wall-clock"]
+
     def test_node_core_is_fully_seam_routed(self):
         """The headline: the node's consensus/session core reads NO
         host clock at all — every deadline, ban window, telemetry stamp

@@ -506,6 +506,50 @@ class TestMempoolPageSupervision:
 
         run(scenario())
 
+    def test_mempool_empty_tail_reads_as_a_stall_not_progress(self):
+        """Round 23: a peer answering every GETMEMPOOL with an EMPTY
+        page claiming more=True — each page is well-formed and arrives
+        on time, so the in-flight deadline never fires, but the pool
+        never advances.  Pre-round-23 this silently ENDED the sync (a
+        zero-cost park); it must now demote the chatty-useless peer,
+        count a mempool_sync_stalls, and re-solicit from the other
+        connected peer — without a ban (nothing was malformed)."""
+
+        async def scenario():
+            chain5 = make_blocks(5, DIFF)
+            parker = HostilePeer(
+                chain5, plan=FaultPlan(mempool_empty_tail=True)
+            )
+            quiet = HostilePeer(chain5, plan=FaultPlan(hello_height=0))
+            await parker.start()
+            await quiet.start()
+            victim = Node(
+                _config(
+                    peers=[
+                        f"127.0.0.1:{parker.port}",
+                        f"127.0.0.1:{quiet.port}",
+                    ]
+                )
+            )
+            await victim.start()
+            try:
+                assert await wait_until(
+                    lambda: victim.metrics.mempool_sync_stalls >= 1,
+                    timeout=20,
+                ), "empty-tail pages never read as a stall"
+                assert await wait_until(
+                    lambda: quiet.requests[MsgType.GETMEMPOOL] >= 1,
+                    timeout=10,
+                ), "pool sync never rerouted off the parker"
+                assert victim.metrics.sync_demotions >= 1
+                assert not victim._banned_until
+            finally:
+                await victim.stop()
+                await parker.stop()
+                await quiet.stop()
+
+        run(scenario())
+
 
 class TestHeadersClientFailover:
     """The same supervisor generalized over the light client's headers

@@ -257,6 +257,16 @@ STATUS_KEYS = [
     "queries.proof_cache.invalidated",
     "queries.proof_cache.misses",
     "queries.proofs_served",
+    "recon",
+    "recon.active_links",
+    "recon.demotions",
+    "recon.enabled",
+    "recon.fallbacks",
+    "recon.pending",
+    "recon.rounds",
+    "recon.sketches_served",
+    "recon.success",
+    "recon.txs_reconciled",
     "reorgs",
     "snapshot",
     "snapshot.base_height",
@@ -333,6 +343,7 @@ STATUS_KEYS = [
     "wire",
     "wire.bytes_received",
     "wire.bytes_sent",
+    "wire.relay_bytes",
 ]
 
 
